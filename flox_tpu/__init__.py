@@ -1,0 +1,26 @@
+"""flox_tpu: TPU-native grouped reductions and scans.
+
+A from-scratch framework with the capabilities of the reference flox library
+(/root/reference/flox/__init__.py:25-36 defines the parity API surface),
+built on JAX/XLA: device-resident group codes, jit-compiled segment-reduce
+kernels, and shard_map/collective execution strategies over a TPU mesh.
+"""
+
+from . import kernels
+from .dtypes import INF, NA, NINF
+from .factorize import factorize_, factorize_single
+from .multiarray import MultiArray
+from .options import set_options
+
+__all__ = [
+    "INF",
+    "NA",
+    "NINF",
+    "MultiArray",
+    "factorize_",
+    "factorize_single",
+    "kernels",
+    "set_options",
+]
+
+__version__ = "0.1.0"

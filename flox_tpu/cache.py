@@ -1,0 +1,13 @@
+"""Host-side memoization (parity: /root/reference/flox/cache.py:3-12).
+
+The reference memoizes chunk-boundary analysis with a cachey cache keyed by
+dask tokens. Here the cached inputs are hashable tuples (label fingerprints,
+shard counts), so a plain LRU suffices; a `memoize` name is kept so the call
+sites read the same.
+"""
+
+from __future__ import annotations
+
+import functools
+
+memoize = functools.lru_cache(maxsize=512)

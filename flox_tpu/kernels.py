@@ -1,0 +1,747 @@
+"""The "jax" engine: grouped-reduction kernels on XLA (L1).
+
+This is the TPU replacement for the reference's engine layer
+(/root/reference/flox/aggregate_flox.py, aggregate_npg.py): one function per
+reduction with the uniform plugin signature
+
+    f(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw)
+
+where ``group_idx`` is an integer code array of shape ``(N,)`` (code ``-1``
+means "missing label"), ``array`` has shape ``(..., N)`` (the reduced axes
+flattened into the trailing dim), and ``size`` is the **static** number of
+groups. Returns shape ``(..., size)`` (quantile adds a leading q-dim).
+
+Design notes (why this is not a port):
+
+* The reference's engines are sort+``ufunc.reduceat`` (aggregate_flox.py:133-192)
+  or bincount tricks (numpy_groupies). On TPU the natural primitive is the
+  XLA segment reduction (``jax.ops.segment_sum`` family) — a single fused
+  scatter-reduce that XLA lowers efficiently; no host-side argsort needed for
+  the common reductions.
+* Missing labels: code ``-1`` is clamped to an extra trailing segment which
+  is sliced off — the device-shape-stable analogue of the reference's
+  nan-sentinel size bump (factorize.py:201-210).
+* Order statistics (quantile/median/mode) use ``jax.lax.sort`` with
+  ``num_keys=2`` for a (group, value) lexicographic sort — the TPU-native
+  replacement for the reference's complex-number partition trick
+  (aggregate_flox.py:50-130), which does not translate to XLA.
+* Grouped scans (cumsum/ffill) use a segmented binary operator under
+  ``jax.lax.associative_scan`` — log-depth on device, and the same operator
+  is reused across shards by the distributed Blelloch scan.
+* Everything here is shape-static and jit-safe; ``core.chunk_reduce`` traces
+  the full multi-kernel bundle into ONE jitted program so XLA fuses the
+  shared factorize/scatter work across outputs (e.g. mean = sum+count in one
+  pass).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .multiarray import MultiArray
+
+__all__ = ["KERNELS", "generic_kernel"]
+
+_BIG = np.iinfo(np.int32).max
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _to_leading(array):
+    """(..., N) -> (N, ...) so segment ops reduce axis 0."""
+    return jnp.moveaxis(jnp.asarray(array), -1, 0)
+
+
+def _from_leading(out):
+    return jnp.moveaxis(out, 0, -1)
+
+
+def _safe_codes(group_idx, size: int):
+    codes = jnp.asarray(group_idx).astype(jnp.int32).reshape(-1)
+    return jnp.where(codes < 0, size, codes)
+
+
+def _seg(op: str, data, codes, size: int):
+    """Segment-reduce ``data`` (N, ...) by ``codes`` (N,) into (size, ...).
+
+    Allocates one extra segment for missing labels and slices it off, so the
+    output shape depends only on the static ``size``.
+    """
+    fn = {
+        "sum": jax.ops.segment_sum,
+        "prod": jax.ops.segment_prod,
+        "max": jax.ops.segment_max,
+        "min": jax.ops.segment_min,
+    }[op]
+    out = fn(data, codes, num_segments=size + 1)
+    return out[:size]
+
+
+def _counts(codes, size: int, mask=None, dtype=jnp.int32):
+    """Per-group element counts, optionally restricted by ``mask`` (N, ...)."""
+    if mask is None:
+        ones = jnp.ones(codes.shape, dtype=dtype)
+    else:
+        ones = mask.astype(dtype)
+    return _seg("sum", ones, codes, size)
+
+
+def _fill_empty(out, present, fill_value):
+    """Replace groups with no contributing elements by ``fill_value``."""
+    if fill_value is None:
+        return out
+    present = _bcast_present(jnp.asarray(present), out)
+    return jnp.where(present, out, jnp.asarray(fill_value).astype(out.dtype))
+
+
+def _nan_mask(array):
+    if jnp.issubdtype(array.dtype, jnp.floating) or jnp.issubdtype(array.dtype, jnp.complexfloating):
+        return ~jnp.isnan(array)
+    return None  # non-float: nothing is NaN
+
+
+def _maybe_cast(array, dtype):
+    if dtype is not None and array.dtype != np.dtype(dtype):
+        return array.astype(dtype)
+    return array
+
+
+def _iota_like(data):
+    """(N, ...) index-along-axis-0 array broadcast to data's shape."""
+    n = data.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return jnp.broadcast_to(idx.reshape((n,) + (1,) * (data.ndim - 1)), data.shape)
+
+
+# ---------------------------------------------------------------------------
+# simple reductions
+# ---------------------------------------------------------------------------
+
+
+def _make_addlike(op: str, identity, skipna: bool):
+    def kernel(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+        codes = _safe_codes(group_idx, size)
+        data = _to_leading(array)
+        mask = _nan_mask(data) if skipna else None
+        if mask is not None:
+            data = jnp.where(mask, data, jnp.asarray(identity, dtype=data.dtype))
+        data = _maybe_cast(data, dtype)
+        out = _seg(op, data, codes, size)
+        if fill_value is not None and fill_value != identity:
+            # numpy semantics: nansum of an all-NaN group is the identity (0),
+            # so "empty" means zero *total* elements, not zero non-NaN ones.
+            present = _counts(codes, size) > 0
+            out = _fill_empty(out, present, fill_value)
+        return _from_leading(out)
+
+    return kernel
+
+
+sum_ = _make_addlike("sum", 0, skipna=False)
+nansum = _make_addlike("sum", 0, skipna=True)
+prod = _make_addlike("prod", 1, skipna=False)
+nanprod = _make_addlike("prod", 1, skipna=True)
+
+
+def _make_minmax(op: str, skipna: bool):
+    def kernel(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+        codes = _safe_codes(group_idx, size)
+        data = _to_leading(array)
+        data = _maybe_cast(data, dtype)
+        mask = _nan_mask(data)
+        if skipna and mask is not None:
+            ident = jnp.asarray(-jnp.inf if op == "max" else jnp.inf, dtype=data.dtype)
+            data = jnp.where(mask, data, ident)
+        elif not skipna and mask is not None:
+            # NaN propagates through min/max in numpy; segment_min/max on TPU
+            # would otherwise drop it. Force-propagate by mapping NaN to the
+            # absorbing element.
+            absorb = jnp.asarray(jnp.inf if op == "max" else -jnp.inf, dtype=data.dtype)
+            has_nan = _seg("max", (~mask).astype(jnp.int8), codes, size) > 0
+            data = jnp.where(mask, data, absorb)
+            out = _seg(op, data, codes, size)
+            out = jnp.where(has_nan, jnp.asarray(jnp.nan, dtype=out.dtype), out)
+            present = _counts(codes, size) > 0
+            out = _fill_empty(out, _bcast_present(present, out), fill_value)
+            return _from_leading(out)
+        out = _seg(op, data, codes, size)
+        present = _counts(codes, size, mask=mask if skipna else None) > 0
+        out = _fill_empty(out, _bcast_present(present, out), fill_value)
+        return _from_leading(out)
+
+    return kernel
+
+
+def _bcast_present(present, out):
+    """Broadcast a (size,)-or-(size, ...) presence mask against out."""
+    if present.ndim < out.ndim:
+        present = present.reshape(present.shape + (1,) * (out.ndim - present.ndim))
+    return present
+
+
+max_ = _make_minmax("max", skipna=False)
+nanmax = _make_minmax("max", skipna=True)
+min_ = _make_minmax("min", skipna=False)
+nanmin = _make_minmax("min", skipna=True)
+
+
+def nanlen(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    """Count of non-NaN elements per group (the reference's 'nanlen')."""
+    codes = _safe_codes(group_idx, size)
+    data = _to_leading(array)
+    mask = _nan_mask(data)
+    out = _counts(codes, size, mask=mask, dtype=dtype or jnp.int32)
+    if mask is None and out.ndim < data.ndim:
+        out = jnp.broadcast_to(
+            out.reshape(out.shape + (1,) * (data.ndim - out.ndim)), (size,) + data.shape[1:]
+        )
+    if fill_value is not None and fill_value != 0:
+        out = _fill_empty(out, out > 0, fill_value)
+    return _from_leading(out)
+
+
+def len_(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    codes = _safe_codes(group_idx, size)
+    data = _to_leading(array)
+    out = _counts(codes, size, dtype=dtype or jnp.int32)
+    out = jnp.broadcast_to(
+        out.reshape(out.shape + (1,) * (data.ndim - out.ndim)), (size,) + data.shape[1:]
+    )
+    return _from_leading(out)
+
+
+def _mean_impl(group_idx, array, *, size, fill_value, dtype, skipna):
+    codes = _safe_codes(group_idx, size)
+    data = _to_leading(array)
+    mask = _nan_mask(data) if skipna else None
+    if dtype is None and not jnp.issubdtype(data.dtype, jnp.floating):
+        dtype = jnp.result_type(data.dtype, jnp.float32)
+    sdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
+    sdata = _maybe_cast(sdata, dtype)
+    total = _seg("sum", sdata, codes, size)
+    cnt = _counts(codes, size, mask=mask, dtype=sdata.dtype)
+    cnt = _bcast_present(cnt, total)
+    out = total / cnt
+    out = _fill_empty(out, cnt > 0, fill_value if fill_value is not None else jnp.nan)
+    return _from_leading(out)
+
+
+def mean(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _mean_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, skipna=False)
+
+
+def nanmean(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _mean_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, skipna=True)
+
+
+def _sum_of_squares(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, skipna=False, **kw):
+    arr = jnp.asarray(array)
+    return (nansum if skipna else sum_)(
+        group_idx, arr * arr, axis=axis, size=size, fill_value=fill_value, dtype=dtype
+    )
+
+
+sum_of_squares = partial(_sum_of_squares, skipna=False)
+nansum_of_squares = partial(_sum_of_squares, skipna=True)
+
+
+# ---------------------------------------------------------------------------
+# variance: single-pass-per-chunk triple, numerically shifted by the group
+# mean (the TPU analogue of the reference's var_chunk, aggregations.py:348-389)
+# ---------------------------------------------------------------------------
+
+
+def _var_impl(group_idx, array, *, size, fill_value, dtype, ddof, skipna, std):
+    codes = _safe_codes(group_idx, size)
+    data = _to_leading(array)
+    mask = _nan_mask(data) if skipna else None
+    if dtype is None and not jnp.issubdtype(data.dtype, jnp.floating):
+        dtype = jnp.result_type(data.dtype, jnp.float32)
+    zdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
+    zdata = _maybe_cast(zdata, dtype)
+    cnt = _counts(codes, size, mask=mask, dtype=zdata.dtype)
+    total = _seg("sum", zdata, codes, size)
+    cnt_b = _bcast_present(cnt, total)
+    mean_g = total / jnp.where(cnt_b > 0, cnt_b, 1)
+    # gather each element's group mean and accumulate squared deviations
+    gathered = jnp.take(jnp.concatenate([mean_g, jnp.zeros((1,) + mean_g.shape[1:], mean_g.dtype)]), codes, axis=0)
+    dev = zdata - gathered
+    if mask is not None:
+        dev = jnp.where(mask, dev, jnp.zeros((), dev.dtype))
+    m2 = _seg("sum", dev * dev, codes, size)
+    denom = cnt_b - ddof
+    out = m2 / jnp.where(denom > 0, denom, 1)
+    out = jnp.where(denom > 0, out, jnp.asarray(jnp.nan, out.dtype))
+    if std:
+        out = jnp.sqrt(out)
+    out = _fill_empty(out, cnt_b > 0, fill_value if fill_value is not None else jnp.nan)
+    return _from_leading(out)
+
+
+def var(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, ddof=0, **kw):
+    return _var_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, ddof=ddof, skipna=False, std=False)
+
+
+def nanvar(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, ddof=0, **kw):
+    return _var_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, ddof=ddof, skipna=True, std=False)
+
+
+def std(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, ddof=0, **kw):
+    return _var_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, ddof=ddof, skipna=False, std=True)
+
+
+def nanstd(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, ddof=0, **kw):
+    return _var_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, ddof=ddof, skipna=True, std=True)
+
+
+def var_chunk(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, skipna=True, **kw):
+    """Per-chunk variance statistics: MultiArray (sum_sq_dev, sum, count).
+
+    The deviations are taken about the *chunk's* per-group mean, so the
+    combine stage needs only the Chan-style merge (see parallel.mapreduce /
+    aggregations._var_combine) — this is the numerically-stable single-pass
+    strategy of the reference (aggregations.py:348-451), expressed as a
+    pytree so collectives apply leaf-wise.
+    """
+    codes = _safe_codes(group_idx, size)
+    data = _to_leading(array)
+    mask = _nan_mask(data) if skipna else None
+    if dtype is None and not jnp.issubdtype(data.dtype, jnp.floating):
+        dtype = jnp.result_type(data.dtype, jnp.float32)
+    zdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
+    zdata = _maybe_cast(zdata, dtype)
+    cnt = _counts(codes, size, mask=mask, dtype=zdata.dtype)
+    total = _seg("sum", zdata, codes, size)
+    cnt_b = _bcast_present(cnt, total)
+    mean_g = total / jnp.where(cnt_b > 0, cnt_b, 1)
+    gathered = jnp.take(
+        jnp.concatenate([mean_g, jnp.zeros((1,) + mean_g.shape[1:], mean_g.dtype)]), codes, axis=0
+    )
+    dev = zdata - gathered
+    if mask is not None:
+        dev = jnp.where(mask, dev, jnp.zeros((), dev.dtype))
+    m2 = _seg("sum", dev * dev, codes, size)
+    if cnt_b.shape != total.shape:
+        cnt_b = jnp.broadcast_to(cnt_b, total.shape)
+    return MultiArray(
+        (_from_leading(m2), _from_leading(total), _from_leading(cnt_b))
+    )
+
+
+# ---------------------------------------------------------------------------
+# bool reductions
+# ---------------------------------------------------------------------------
+
+
+def all_(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    codes = _safe_codes(group_idx, size)
+    data = _to_leading(array).astype(bool).astype(jnp.int8)
+    out = _seg("min", data, codes, size).astype(bool)
+    present = _counts(codes, size) > 0
+    out = jnp.where(_bcast_present(present, out), out, True if fill_value is None else fill_value)
+    return _from_leading(out)
+
+
+def any_(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    codes = _safe_codes(group_idx, size)
+    data = _to_leading(array).astype(bool).astype(jnp.int8)
+    out = _seg("max", data, codes, size).astype(bool)
+    present = _counts(codes, size) > 0
+    out = jnp.where(_bcast_present(present, out), out, False if fill_value is None else fill_value)
+    return _from_leading(out)
+
+
+# ---------------------------------------------------------------------------
+# argreductions and positional first/last
+# ---------------------------------------------------------------------------
+
+
+def _arg_impl(group_idx, array, *, size, fill_value, skipna, arg_of_max):
+    codes = _safe_codes(group_idx, size)
+    data = _to_leading(array)
+    mask = _nan_mask(data)
+    key = data
+    if mask is not None:
+        if skipna:
+            ident = jnp.asarray(-jnp.inf if arg_of_max else jnp.inf, dtype=data.dtype)
+            key = jnp.where(mask, data, ident)
+        else:
+            # NaN propagates: map NaN to the absorbing element so a NaN-bearing
+            # group resolves to a NaN position. (Known divergence from numpy:
+            # if a group contains both inf and NaN, the earlier of the two wins
+            # the tie rather than strictly the first NaN.)
+            absorb = jnp.asarray(jnp.inf if arg_of_max else -jnp.inf, dtype=data.dtype)
+            key = jnp.where(mask, data, absorb)
+    best = _seg("max" if arg_of_max else "min", key, codes, size)
+    best_per_elem = jnp.take(
+        jnp.concatenate([best, jnp.zeros((1,) + best.shape[1:], best.dtype)]), codes, axis=0
+    )
+    iota = _iota_like(key)
+    cand = jnp.where(key == best_per_elem, iota, _BIG)
+    if skipna and mask is not None:
+        cand = jnp.where(mask, cand, _BIG)
+    out = _seg("min", cand, codes, size)
+    valid_counts = _counts(codes, size, mask=mask if skipna else None)
+    present = _bcast_present(valid_counts, out) > 0
+    fv = -1 if fill_value is None else fill_value
+    out = jnp.where(present & (out < _BIG), out, fv)
+    return _from_leading(out)
+
+
+def argmax(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _arg_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False, arg_of_max=True)
+
+
+def argmin(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _arg_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False, arg_of_max=False)
+
+
+def nanargmax(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _arg_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True, arg_of_max=True)
+
+
+def nanargmin(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _arg_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True, arg_of_max=False)
+
+
+def _firstlast_impl(group_idx, array, *, size, fill_value, skipna, last):
+    codes = _safe_codes(group_idx, size)
+    data = _to_leading(array)
+    mask = _nan_mask(data) if skipna else None
+    iota = _iota_like(data)
+    if mask is not None:
+        iota = jnp.where(mask, iota, -1 if last else _BIG)
+    pos = _seg("max" if last else "min", iota, codes, size)
+    valid = (pos >= 0) & (pos < _BIG)
+    gather_at = jnp.clip(pos, 0, data.shape[0] - 1)
+    out = jnp.take_along_axis(data, gather_at, axis=0)
+    fv = fill_value if fill_value is not None else (jnp.nan if jnp.issubdtype(data.dtype, jnp.floating) else 0)
+    out = jnp.where(valid, out, jnp.asarray(fv).astype(out.dtype))
+    return _from_leading(out)
+
+
+def first(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _firstlast_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False, last=False)
+
+
+def last(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _firstlast_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False, last=True)
+
+
+def nanfirst(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _firstlast_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True, last=False)
+
+
+def nanlast(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _firstlast_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True, last=True)
+
+
+# ---------------------------------------------------------------------------
+# order statistics: quantile / median / mode via a (group, value) lex sort.
+#
+# jax.lax.sort with num_keys=2 gives a per-column lexicographic sort along
+# axis 0 — the shape-static TPU replacement for the reference's complex-
+# number partition trick (aggregate_flox.py:50-130).
+# ---------------------------------------------------------------------------
+
+
+def _group_sort(codes, data):
+    """Sort (codes, data) lexicographically along axis 0; NaNs sort last
+    within each group (lax.sort total order puts NaN after +inf)."""
+    codes_b = jnp.broadcast_to(
+        codes.reshape((codes.shape[0],) + (1,) * (data.ndim - 1)), data.shape
+    ).astype(jnp.int32)
+    iota = _iota_like(data)
+    sorted_codes, sorted_data, sorted_iota = jax.lax.sort(
+        (codes_b, data, iota), dimension=0, num_keys=2
+    )
+    return sorted_codes, sorted_data, sorted_iota
+
+
+def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna, method="linear"):
+    codes = _safe_codes(group_idx, size)
+    data = _to_leading(array)
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        default_float = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        data = data.astype(dtype if dtype is not None else default_float)
+    mask = _nan_mask(data)
+    if not skipna and mask is not None:
+        # NaN propagates: a group containing any NaN yields NaN.
+        group_has_nan = _seg("max", (~mask).astype(jnp.int8), codes, size) > 0
+    else:
+        group_has_nan = None
+    qs = np.atleast_1d(np.asarray(q, dtype=np.float64))
+    scalar_q = np.ndim(q) == 0
+
+    _, sorted_data, _ = _group_sort(codes, data)
+    full_counts = _counts(codes, size)  # (size,)
+    offsets = jnp.cumsum(full_counts) - full_counts  # exclusive, (size,)
+    nn = _counts(codes, size, mask=mask)  # non-NaN counts, (size, ...) or (size,)
+    # broadcast offsets across trailing dims; keep them INTEGER — only the
+    # within-group position goes through float, so gather indices stay exact
+    # even when the total length exceeds float32's integer range.
+    off_b = offsets.reshape((size,) + (1,) * (sorted_data.ndim - 1))
+    nn_full = jnp.broadcast_to(
+        _bcast_present(nn, sorted_data[:1]), (size,) + sorted_data.shape[1:]
+    )
+
+    outs = []
+    nmax = sorted_data.shape[0]
+    for qi in qs:
+        pos = qi * (nn_full - 1).astype(sorted_data.dtype)  # within-group, float
+        lo_in = jnp.floor(pos).astype(jnp.int32)
+        hi_in = jnp.ceil(pos).astype(jnp.int32)
+        lo = off_b + lo_in
+        hi = off_b + hi_in
+        lo_c = jnp.clip(lo, 0, nmax - 1)
+        hi_c = jnp.clip(hi, 0, nmax - 1)
+        v_lo = jnp.take_along_axis(sorted_data, lo_c, axis=0)
+        v_hi = jnp.take_along_axis(sorted_data, hi_c, axis=0)
+        frac = pos - lo_in
+        if method == "linear":
+            val = v_lo + frac * (v_hi - v_lo)
+        elif method == "lower":
+            val = v_lo
+        elif method == "higher":
+            val = v_hi
+        elif method == "nearest":
+            val = jnp.where(frac <= 0.5, v_lo, v_hi)
+        elif method == "midpoint":
+            val = (v_lo + v_hi) / 2
+        else:
+            raise ValueError(f"Unsupported quantile method: {method!r}")
+        empty = nn_full <= 0
+        fv = fill_value if fill_value is not None else jnp.nan
+        val = jnp.where(empty, jnp.asarray(fv).astype(val.dtype), val)
+        if group_has_nan is not None:
+            val = jnp.where(
+                _bcast_present(group_has_nan, val), jnp.asarray(jnp.nan, val.dtype), val
+            )
+        outs.append(_from_leading(val))
+    if scalar_q:
+        return outs[0]
+    return jnp.stack(outs, axis=0)
+
+
+def quantile(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, q, method="linear", **kw):
+    return _quantile_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, q=q, skipna=False, method=method)
+
+
+def nanquantile(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, q, method="linear", **kw):
+    return _quantile_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, q=q, skipna=True, method=method)
+
+
+def median(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _quantile_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, q=0.5, skipna=False)
+
+
+def nanmedian(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _quantile_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, q=0.5, skipna=True)
+
+
+def _mode_impl(group_idx, array, *, size, fill_value, skipna):
+    codes = _safe_codes(group_idx, size)
+    data = _to_leading(array)
+    mask = _nan_mask(data)
+    sorted_codes, sorted_data, _ = _group_sort(codes, data)
+    smask = None
+    if mask is not None:
+        smask = ~jnp.isnan(sorted_data)
+    n = sorted_data.shape[0]
+    iota = _iota_like(sorted_data)
+    prev_same = jnp.concatenate(
+        [
+            jnp.zeros((1,) + sorted_data.shape[1:], bool),
+            (sorted_data[1:] == sorted_data[:-1]) & (sorted_codes[1:] == sorted_codes[:-1]),
+        ]
+    )
+    # run start index per position: cumulative max of start markers
+    start_marker = jnp.where(prev_same, -1, iota)
+    run_start = jax.lax.cummax(start_marker, axis=0)
+    next_diff = jnp.concatenate(
+        [prev_same[1:], jnp.zeros((1,) + sorted_data.shape[1:], bool)]
+    )
+    end_marker = jnp.where(next_diff, n, iota)
+    run_end = jax.lax.cummin(end_marker[::-1], axis=0)[::-1]
+    run_len = run_end - run_start + 1
+    if smask is not None and skipna:
+        run_len = jnp.where(smask, run_len, -1)
+    elif smask is not None:
+        # Non-skipping mode with NaN present: scipy.stats.mode propagates NaN.
+        pass
+    # codes are identical across trailing columns; segment ids must be 1-D
+    codes1d = sorted_codes if sorted_codes.ndim == 1 else sorted_codes[(slice(None),) + (0,) * (sorted_codes.ndim - 1)]
+    best_len = _seg("max", run_len, codes1d, size)
+    best_per_elem = jnp.take(
+        jnp.concatenate([best_len, jnp.zeros((1,) + best_len.shape[1:], best_len.dtype)]),
+        codes1d,
+        axis=0,
+    )
+    cand = jnp.where((run_len == best_per_elem) & (run_len > 0), iota, _BIG)
+    pos = _seg("min", cand, codes1d, size)
+    valid = pos < _BIG
+    out = jnp.take_along_axis(sorted_data, jnp.clip(pos, 0, n - 1), axis=0)
+    if smask is not None and not skipna:
+        has_nan = _seg("max", (~smask).astype(jnp.int8), codes1d, size) > 0
+        out = jnp.where(_bcast_present(has_nan, out), jnp.asarray(jnp.nan, out.dtype), out)
+    fv = fill_value if fill_value is not None else (jnp.nan if jnp.issubdtype(out.dtype, jnp.floating) else 0)
+    out = jnp.where(valid, out, jnp.asarray(fv).astype(out.dtype))
+    return _from_leading(out)
+
+
+def mode(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _mode_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False)
+
+
+def nanmode(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+    return _mode_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True)
+
+
+# ---------------------------------------------------------------------------
+# grouped scans: segmented associative_scan (log-depth on device).
+#
+# The segmented-scan operator ``((v1,f1),(v2,f2)) -> (f2 ? v2 : v1⊕v2, f1|f2)``
+# is associative for any associative ⊕; flags mark group-run starts after a
+# stable sort by code. The same operator drives the cross-shard Blelloch
+# combine in parallel/scan.py (reference analogue: aggregations.py:792-846).
+# ---------------------------------------------------------------------------
+
+
+def _segmented_scan(values, flags, op, reverse=False):
+    def combine(a, b):
+        va, fa = a
+        vb, fb = b
+        return jnp.where(fb, vb, op(va, vb)), fa | fb
+
+    out, _ = jax.lax.associative_scan(combine, (values, flags), axis=0, reverse=reverse)
+    return out
+
+
+def _grouped_scan_setup(group_idx, array):
+    """Stable-sort by code; return permutation machinery + flags."""
+    codes = jnp.asarray(group_idx).astype(jnp.int32).reshape(-1)
+    data = _to_leading(array)
+    perm = jnp.argsort(codes, stable=True)
+    inv = jnp.argsort(perm)
+    sorted_codes = codes[perm]
+    sorted_data = jnp.take(data, perm, axis=0)
+    starts = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_codes[1:] != sorted_codes[:-1]]
+    )
+    flags = jnp.broadcast_to(
+        starts.reshape((starts.shape[0],) + (1,) * (data.ndim - 1)), data.shape
+    )
+    return sorted_codes, sorted_data, flags, inv
+
+
+def _cumsum_impl(group_idx, array, *, size, dtype, skipna):
+    _, sorted_data, flags, inv = _grouped_scan_setup(group_idx, array)
+    mask = _nan_mask(sorted_data) if skipna else None
+    vals = sorted_data if mask is None else jnp.where(mask, sorted_data, jnp.zeros((), sorted_data.dtype))
+    vals = _maybe_cast(vals, dtype)
+    scanned = _segmented_scan(vals, flags, jnp.add)
+    return _from_leading(jnp.take(scanned, inv, axis=0))
+
+
+def cumsum(group_idx, array, *, axis=-1, size=None, fill_value=None, dtype=None, **kw):
+    return _cumsum_impl(group_idx, array, size=size, dtype=dtype, skipna=False)
+
+
+def nancumsum(group_idx, array, *, axis=-1, size=None, fill_value=None, dtype=None, **kw):
+    return _cumsum_impl(group_idx, array, size=size, dtype=dtype, skipna=True)
+
+
+def _ffill_impl(group_idx, array, *, reverse):
+    codes = jnp.asarray(group_idx).astype(jnp.int32).reshape(-1)
+    data = _to_leading(array)
+    if reverse:
+        codes = codes[::-1]
+        data = data[::-1]
+    sorted_codes, sorted_data, flags, inv = _grouped_scan_setup(codes, _from_leading(data))
+    mask = _nan_mask(sorted_data)
+    if mask is None:
+        out = sorted_data
+    else:
+        iota = _iota_like(sorted_data)
+        valid_idx = jnp.where(mask, iota, -1)
+        last_valid = _segmented_scan(valid_idx, flags, jnp.maximum)
+        gathered = jnp.take_along_axis(sorted_data, jnp.clip(last_valid, 0, None), axis=0)
+        out = jnp.where(last_valid >= 0, gathered, jnp.asarray(jnp.nan, sorted_data.dtype))
+    out = jnp.take(out, inv, axis=0)
+    if reverse:
+        out = out[::-1]
+    return _from_leading(out)
+
+
+def ffill(group_idx, array, *, axis=-1, size=None, fill_value=None, dtype=None, **kw):
+    return _ffill_impl(group_idx, array, reverse=False)
+
+
+def bfill(group_idx, array, *, axis=-1, size=None, fill_value=None, dtype=None, **kw):
+    return _ffill_impl(group_idx, array, reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# registry + dispatch
+# ---------------------------------------------------------------------------
+
+KERNELS: dict[str, Callable[..., Any]] = {
+    "sum": sum_,
+    "nansum": nansum,
+    "prod": prod,
+    "nanprod": nanprod,
+    "max": max_,
+    "nanmax": nanmax,
+    "min": min_,
+    "nanmin": nanmin,
+    "mean": mean,
+    "nanmean": nanmean,
+    "var": var,
+    "nanvar": nanvar,
+    "std": std,
+    "nanstd": nanstd,
+    "var_chunk": var_chunk,
+    "count": nanlen,
+    "nanlen": nanlen,
+    "len": len_,
+    "all": all_,
+    "any": any_,
+    "argmax": argmax,
+    "argmin": argmin,
+    "nanargmax": nanargmax,
+    "nanargmin": nanargmin,
+    "first": first,
+    "last": last,
+    "nanfirst": nanfirst,
+    "nanlast": nanlast,
+    "median": median,
+    "nanmedian": nanmedian,
+    "quantile": quantile,
+    "nanquantile": nanquantile,
+    "mode": mode,
+    "nanmode": nanmode,
+    "sum_of_squares": sum_of_squares,
+    "nansum_of_squares": nansum_of_squares,
+    "cumsum": cumsum,
+    "nancumsum": nancumsum,
+    "ffill": ffill,
+    "bfill": bfill,
+}
+
+
+def generic_kernel(func: str, group_idx, array, **kwargs):
+    """Engine entry point for the 'jax' engine (plugin-boundary parity with
+    generic_aggregate, aggregations.py:60-133)."""
+    try:
+        fn = KERNELS[func]
+    except KeyError:
+        raise NotImplementedError(f"jax engine has no kernel for {func!r}") from None
+    return fn(group_idx, array, **kwargs)

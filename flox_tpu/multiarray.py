@@ -1,0 +1,80 @@
+"""MultiArray: a tuple-of-arrays that flows through the reduction machinery
+as one value (parity: /root/reference/flox/multiarray.py:9-97, used by the
+single-pass variance path, aggregations.py:348-451).
+
+TPU-native twist: registered as a JAX pytree, so a MultiArray intermediate
+(the variance triple ``(sum_sq_dev, sum, count)``) passes transparently
+through ``jit`` / ``shard_map`` and collectives apply leaf-wise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+try:  # register as pytree when jax is importable
+    import jax.tree_util as _jtu
+except ImportError:  # pragma: no cover
+    _jtu = None
+
+
+class MultiArray:
+    __slots__ = ("arrays",)
+
+    def __init__(self, arrays) -> None:
+        self.arrays = tuple(arrays)
+
+    def __len__(self) -> int:
+        return len(self.arrays)
+
+    def __iter__(self):
+        return iter(self.arrays)
+
+    def __getitem__(self, i):
+        return self.arrays[i]
+
+    @property
+    def shape(self):
+        return self.arrays[0].shape
+
+    @property
+    def ndim(self):
+        return self.arrays[0].ndim
+
+    @property
+    def dtype(self):
+        return self.arrays[0].dtype
+
+    def astype(self, dtype, **kwargs) -> "MultiArray":
+        return MultiArray(tuple(a.astype(dtype, **kwargs) for a in self.arrays))
+
+    def reshape(self, *shape) -> "MultiArray":
+        return MultiArray(tuple(a.reshape(*shape) for a in self.arrays))
+
+    def squeeze(self, axis=None) -> "MultiArray":
+        return MultiArray(tuple(a.squeeze(axis) for a in self.arrays))
+
+    def map(self, fn: Callable[[Any], Any]) -> "MultiArray":
+        return MultiArray(tuple(fn(a) for a in self.arrays))
+
+    def __repr__(self) -> str:
+        return f"MultiArray({self.arrays!r})"
+
+
+def concatenate(arrays, axis=0):
+    """Concatenate supporting MultiArray leaves (multiarray.py:60-71 parity)."""
+    first = arrays[0]
+    if isinstance(first, MultiArray):
+        return MultiArray(
+            tuple(np.concatenate([a.arrays[i] for a in arrays], axis=axis) for i in range(len(first)))
+        )
+    return np.concatenate(arrays, axis=axis)
+
+
+if _jtu is not None:
+    _jtu.register_pytree_node(
+        MultiArray,
+        lambda ma: (ma.arrays, None),
+        lambda _, children: MultiArray(children),
+    )

@@ -1,0 +1,54 @@
+"""Global options (parity: /root/reference/flox/options.py:9-65).
+
+The reference exposes two dask-rechunk thresholds; the TPU build keeps those
+semantics for its resharding analogue and adds device-policy knobs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+OPTIONS: dict[str, Any] = {
+    # Resharding-for-blockwise is applied automatically only when the change
+    # it would make is small (same spirit as options.py:9-18).
+    "rechunk_blockwise_num_chunks_threshold": 0.25,
+    "rechunk_blockwise_chunk_size_threshold": 1.5,
+    # TPU policy knobs (no reference analogue):
+    # accumulate float32 inputs in float64 when x64 is enabled, else use
+    # compensated (Kahan) summation inside kernels.
+    "accumulate_f64": True,
+    # default engine for device arrays
+    "default_engine": "jax",
+}
+
+_VALIDATORS = {
+    "rechunk_blockwise_num_chunks_threshold": lambda x: 0 < x <= 1,
+    "rechunk_blockwise_chunk_size_threshold": lambda x: x >= 1,
+    "accumulate_f64": lambda x: isinstance(x, bool),
+    "default_engine": lambda x: x in ("jax", "numpy"),
+}
+
+
+class set_options:
+    """Context manager / global setter for options (options.py:21-65 parity).
+
+    >>> import flox_tpu
+    >>> with flox_tpu.set_options(accumulate_f64=False):
+    ...     pass
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        self.old: dict[str, Any] = {}
+        for k, v in kwargs.items():
+            if k not in OPTIONS:
+                raise ValueError(f"argument name {k!r} is not in the set of valid options {set(OPTIONS)!r}")
+            if k in _VALIDATORS and not _VALIDATORS[k](v):
+                raise ValueError(f"option {k!r} given an invalid value: {v!r}")
+            self.old[k] = OPTIONS[k]
+        OPTIONS.update(kwargs)
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *args: Any) -> None:
+        OPTIONS.update(self.old)

@@ -1,0 +1,40 @@
+"""Shared type definitions (parity: /root/reference/flox/types.py:28-42 and
+the TypeAlias block at core.py:62-93, trimmed to what the TPU build uses)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any, Literal, TypedDict, Union
+
+import numpy as np
+
+if TYPE_CHECKING:
+    import jax
+
+T_Array = Union[np.ndarray, "jax.Array"]
+T_Axes = tuple[int, ...]
+T_Engine = Literal["jax", "numpy"]
+T_Method = Literal["map-reduce", "blockwise", "cohorts"]
+T_ScanMethod = Literal["blelloch", "blockwise"]
+T_Func = str
+T_ExpectedGroups = Any  # pd.Index | array-like | tuple thereof | None
+
+
+class IntermediateDict(TypedDict):
+    """Per-chunk reduction output: discovered groups + one array per chunk-func."""
+
+    groups: tuple[T_Array, ...]
+    intermediates: list[T_Array]
+
+
+class FinalResultsDict(TypedDict, total=False):
+    groups: T_Array
+
+
+@dataclass(frozen=True)
+class FactorProps:
+    """Bookkeeping emitted by factorization (parity: types.py:42 FactorProps)."""
+
+    offset_group: bool  # labels were offset per leading-position (partial-axis reduce)
+    nan_sentinel: bool  # -1 codes were remapped to an extra trailing group
+    nanmask: Any  # host bool mask of NaN-labelled positions (or None)

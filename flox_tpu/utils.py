@@ -1,0 +1,115 @@
+"""Duck-array and null-handling utilities (L0).
+
+Parity target: /root/reference/flox/xrutils.py (isnull/notnull at
+xrutils.py:149-187, duck-array predicates at xrutils.py:85-146), re-thought
+for a JAX world: the load-bearing split here is *host arrays* (numpy, where
+labels/metadata live and object/datetime dtypes are legal) vs *device arrays*
+(jax, always numeric, traced under jit).
+"""
+
+from __future__ import annotations
+
+import importlib
+from collections.abc import Iterable
+from typing import Any
+
+import numpy as np
+
+from . import dtypes
+
+
+def module_available(name: str) -> bool:
+    try:
+        importlib.import_module(name)
+    except ImportError:
+        return False
+    return True
+
+
+HAS_XARRAY = module_available("xarray")
+HAS_MATPLOTLIB = module_available("matplotlib")
+
+
+def is_jax_array(x: Any) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+def is_duck_array(value: Any) -> bool:
+    if isinstance(value, np.ndarray):
+        return True
+    return (
+        hasattr(value, "ndim")
+        and hasattr(value, "shape")
+        and hasattr(value, "dtype")
+        and (hasattr(value, "__array_function__") or hasattr(value, "__array_namespace__"))
+    )
+
+
+def asarray_host(x: Any) -> np.ndarray:
+    """Materialize on host as numpy (labels, metadata, finalize-side work)."""
+    if isinstance(x, np.ndarray):
+        return x
+    if is_jax_array(x):
+        return np.asarray(x)
+    return np.asarray(x)
+
+def asarray_device(x: Any):
+    """Put on device as a jnp array, viewing datetimes as int64."""
+    import jax.numpy as jnp
+
+    if is_jax_array(x):
+        return x
+    x = np.asarray(x)
+    if dtypes.is_datetime_like(x.dtype):
+        x = x.view("int64")
+    return jnp.asarray(x)
+
+
+def isnull(data: Any):
+    """Elementwise missing-mask valid for any dtype (host or device).
+
+    Parity: xrutils.isnull (xrutils.py:149-168) — NaN for floats/complex,
+    NaT for datetimes, never-null for ints/bools; object arrays checked via
+    pandas on host.
+    """
+    if is_jax_array(data):
+        import jax.numpy as jnp
+
+        if jnp.issubdtype(data.dtype, jnp.floating) or jnp.issubdtype(
+            data.dtype, jnp.complexfloating
+        ):
+            return jnp.isnan(data)
+        return jnp.zeros(data.shape, dtype=bool)
+    data = np.asarray(data)
+    dtype = data.dtype
+    if np.issubdtype(dtype, np.floating) or np.issubdtype(dtype, np.complexfloating):
+        return np.isnan(data)
+    if dtypes.is_datetime_like(dtype):
+        return np.isnat(data)
+    if dtype.kind == "O":
+        import pandas as pd
+
+        return pd.isnull(data)
+    return np.zeros(data.shape, dtype=bool)
+
+
+def notnull(data: Any):
+    return ~isnull(data)
+
+
+def is_scalar(value: Any) -> bool:
+    return np.ndim(value) == 0 and not isinstance(value, (list, tuple, dict, set))
+
+
+def normalize_axis_tuple(axis: int | Iterable[int], ndim: int) -> tuple[int, ...]:
+    if np.isscalar(axis):
+        axis = (int(axis),)  # type: ignore[arg-type]
+    return tuple(sorted(ax % ndim for ax in axis))  # type: ignore[union-attr]
+
+
+def moveaxis_to_end(array, axes: tuple[int, ...]):
+    """Move ``axes`` to the trailing positions, preserving their order."""
+    keep = [ax for ax in range(array.ndim) if ax not in axes]
+    return array.transpose(keep + list(axes)), tuple(keep)

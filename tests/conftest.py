@@ -1,0 +1,34 @@
+"""Test harness configuration.
+
+Mirrors the reference's strategy (SURVEY.md §4): all tests single-process,
+with "distributed" correctness exercised on a virtual 8-device CPU mesh
+(``xla_force_host_platform_device_count``) — the TPU analogue of running
+dask with the synchronous scheduler (reference tests/test_core.py:65).
+float64 is enabled so results are comparable bit-for-bit with numpy oracles.
+"""
+
+import os
+
+# The environment pre-imports jax at interpreter startup (sitecustomize), so
+# env vars are too late; jax.config.update still works before first backend use.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def ndevices() -> int:
+    return len(jax.devices())
+
+
+@pytest.fixture(scope="module", params=["jax", "numpy"])
+def engine(request):
+    """Run engine-parameterized tests per engine (reference conftest.py:22-32)."""
+    return request.param

@@ -1,0 +1,253 @@
+"""Unit tests for the jax engine kernels against per-group numpy oracles.
+
+Oracle strategy mirrors the reference's (tests/test_core.py:86-113): apply
+the plain numpy function to each group's masked slice.
+"""
+
+import numpy as np
+import pytest
+import scipy.stats
+
+from flox_tpu import kernels
+
+
+def oracle(func, values, codes, size, **kw):
+    """Per-group loop with plain numpy — the independent reference result."""
+    np_func = {
+        "sum": np.sum,
+        "nansum": np.nansum,
+        "prod": np.prod,
+        "nanprod": np.nanprod,
+        "max": np.max,
+        "nanmax": np.nanmax,
+        "min": np.min,
+        "nanmin": np.nanmin,
+        "mean": np.mean,
+        "nanmean": np.nanmean,
+        "var": np.var,
+        "nanvar": np.nanvar,
+        "std": np.std,
+        "nanstd": np.nanstd,
+        "median": np.median,
+        "nanmedian": np.nanmedian,
+        "all": np.all,
+        "any": np.any,
+        "argmax": np.argmax,
+        "argmin": np.argmin,
+        "nanargmax": np.nanargmax,
+        "nanargmin": np.nanargmin,
+    }[func]
+    out = []
+    for g in range(size):
+        grp = values[..., codes == g]
+        if grp.shape[-1] == 0:
+            out.append(np.full(values.shape[:-1], np.nan))
+            continue
+        with np.errstate(invalid="ignore"), np.testing.suppress_warnings() as sup:
+            sup.filter(RuntimeWarning)
+            if func.startswith(("arg", "nanarg")):
+                res = np.apply_along_axis(lambda s: np_func(s), -1, grp)
+                # convert group-local index to flat index
+                flat_positions = np.flatnonzero(codes == g)
+                res = flat_positions[res]
+            else:
+                res = np_func(grp, axis=-1, **kw)
+        out.append(res)
+    return np.stack(out, axis=-1).astype(np.float64)
+
+
+RNG = np.random.default_rng(42)
+
+
+@pytest.fixture(params=["1d", "2d", "nan", "empty-group", "nan-labels"])
+def case(request):
+    n, size = 57, 5
+    codes = RNG.integers(0, size, n).astype(np.int64)
+    values = RNG.normal(size=(n,)).astype(np.float64)
+    if request.param == "2d":
+        values = RNG.normal(size=(3, n))
+    elif request.param == "nan":
+        values[RNG.random(n) < 0.3] = np.nan
+    elif request.param == "empty-group":
+        codes[codes == 2] = 1  # group 2 has no members
+    elif request.param == "nan-labels":
+        codes[RNG.random(n) < 0.2] = -1
+    return values, codes, size
+
+
+SIMPLE_FUNCS = [
+    "sum", "nansum", "prod", "nanprod", "max", "nanmax", "min", "nanmin",
+    "mean", "nanmean", "var", "nanvar", "std", "nanstd",
+]
+
+
+@pytest.mark.parametrize("func", SIMPLE_FUNCS)
+def test_simple_reductions(case, func):
+    values, codes, size = case
+    got = np.asarray(kernels.generic_kernel(func, codes, values, size=size, fill_value=np.nan))
+    expected = np.full(values.shape[:-1] + (size,), np.nan)
+    for g in range(size):
+        sel = codes == g
+        if not sel.any():
+            continue
+        grp = values[..., sel]
+        with np.errstate(invalid="ignore"), np.testing.suppress_warnings() as sup:
+            sup.filter(RuntimeWarning)
+            expected[..., g] = getattr(np, func)(grp, axis=-1)
+    np.testing.assert_allclose(got, expected, rtol=1e-12, atol=1e-12)
+
+
+def test_count(case):
+    values, codes, size = case
+    got = np.asarray(kernels.generic_kernel("nanlen", codes, values, size=size))
+    expected = np.zeros(values.shape[:-1] + (size,))
+    for g in range(size):
+        grp = values[..., codes == g]
+        expected[..., g] = np.sum(~np.isnan(grp), axis=-1)
+    np.testing.assert_array_equal(got, expected)
+
+
+@pytest.mark.parametrize("func", ["argmax", "argmin", "nanargmax", "nanargmin"])
+def test_argreductions(case, func):
+    values, codes, size = case
+    got = np.asarray(kernels.generic_kernel(func, codes, values, size=size, fill_value=-1))
+    expected = np.full(values.shape[:-1] + (size,), -1, dtype=np.int64)
+    for g in range(size):
+        sel = np.flatnonzero(codes == g)
+        if sel.size == 0:
+            continue
+        grp = values[..., sel]
+        with np.errstate(invalid="ignore"):
+            if func.startswith("nanarg"):
+                valid = ~np.all(np.isnan(grp), axis=-1)
+                local = np.full(grp.shape[:-1], 0, dtype=np.int64)
+                safe = np.where(np.isnan(grp), -np.inf if "max" in func else np.inf, grp)
+                local = np.argmax(safe, -1) if "max" in func else np.argmin(safe, -1)
+                res = np.where(valid, sel[local], -1)
+            else:
+                local = np.argmax(grp, -1) if "max" in func else np.argmin(grp, -1)
+                res = sel[local]
+        expected[..., g] = res
+    np.testing.assert_array_equal(got, expected)
+
+
+@pytest.mark.parametrize("func", ["first", "last", "nanfirst", "nanlast"])
+def test_first_last(case, func):
+    values, codes, size = case
+    got = np.asarray(kernels.generic_kernel(func, codes, values, size=size, fill_value=np.nan))
+    expected = np.full(values.shape[:-1] + (size,), np.nan)
+    for g in range(size):
+        sel = np.flatnonzero(codes == g)
+        if sel.size == 0:
+            continue
+        grp = values[..., sel]
+        if func.startswith("nan"):
+            valid = ~np.isnan(grp)
+            order = range(grp.shape[-1]) if "first" in func else range(grp.shape[-1] - 1, -1, -1)
+            res = np.full(grp.shape[:-1], np.nan)
+            done = np.zeros(grp.shape[:-1], dtype=bool)
+            for i in order:
+                pick = valid[..., i] & ~done
+                res = np.where(pick, grp[..., i], res)
+                done |= valid[..., i]
+        else:
+            res = grp[..., 0] if func == "first" else grp[..., -1]
+        expected[..., g] = res
+    np.testing.assert_allclose(got, expected, rtol=0, atol=0, equal_nan=True)
+
+
+@pytest.mark.parametrize("q", [0.5, 0.9, [0.25, 0.75]])
+def test_quantile(case, q):
+    values, codes, size = case
+    got = np.asarray(kernels.generic_kernel("nanquantile", codes, values, size=size, q=q))
+    qs = np.atleast_1d(q)
+    expected = np.full((len(qs),) + values.shape[:-1] + (size,), np.nan)
+    for g in range(size):
+        grp = values[..., codes == g]
+        if grp.shape[-1] == 0 or np.all(np.isnan(grp)):
+            continue
+        with np.testing.suppress_warnings() as sup:
+            sup.filter(RuntimeWarning)
+            expected[..., g] = np.nanquantile(grp, qs, axis=-1)
+    if np.ndim(q) == 0:
+        expected = expected[0]
+    np.testing.assert_allclose(got, expected, rtol=1e-12, atol=1e-12, equal_nan=True)
+
+
+def test_median(case):
+    values, codes, size = case
+    got = np.asarray(kernels.generic_kernel("nanmedian", codes, values, size=size))
+    expected = np.full(values.shape[:-1] + (size,), np.nan)
+    for g in range(size):
+        grp = values[..., codes == g]
+        if grp.shape[-1] == 0 or np.all(np.isnan(grp)):
+            continue
+        with np.testing.suppress_warnings() as sup:
+            sup.filter(RuntimeWarning)
+            expected[..., g] = np.nanmedian(grp, axis=-1)
+    np.testing.assert_allclose(got, expected, rtol=1e-12, atol=1e-12, equal_nan=True)
+
+
+def test_mode():
+    codes = np.array([0, 0, 0, 1, 1, 1, 1, 2, 0])
+    values = np.array([3.0, 1.0, 3.0, 5.0, 5.0, 2.0, 2.0, 7.0, 1.0])
+    got = np.asarray(kernels.generic_kernel("mode", codes, values, size=3))
+    # group 0: [3,1,3,1] -> tie between 1 (x2) and 3 (x2) -> smallest = 1
+    # group 1: [5,5,2,2] -> tie -> 2 ; group 2: [7] -> 7
+    np.testing.assert_array_equal(got, [1.0, 2.0, 7.0])
+
+
+def test_nanmode():
+    codes = np.array([0, 0, 0, 1, 1])
+    values = np.array([np.nan, 2.0, 2.0, np.nan, np.nan])
+    got = np.asarray(kernels.generic_kernel("nanmode", codes, values, size=2, fill_value=np.nan))
+    np.testing.assert_allclose(got, [2.0, np.nan], equal_nan=True)
+
+
+def test_bool_all_any():
+    codes = np.array([0, 0, 1, 1, 2])
+    values = np.array([True, False, True, True, False])
+    got_all = np.asarray(kernels.generic_kernel("all", codes, values, size=4))
+    got_any = np.asarray(kernels.generic_kernel("any", codes, values, size=4))
+    np.testing.assert_array_equal(got_all, [False, True, False, True])
+    np.testing.assert_array_equal(got_any, [True, True, False, False])
+
+
+def test_cumsum():
+    codes = np.array([0, 1, 0, 1, 0])
+    values = np.array([1.0, 10.0, 2.0, 20.0, 3.0])
+    got = np.asarray(kernels.generic_kernel("cumsum", codes, values, size=2))
+    np.testing.assert_allclose(got, [1.0, 10.0, 3.0, 30.0, 6.0])
+
+
+def test_nancumsum_2d():
+    codes = np.array([0, 1, 0, 1])
+    values = np.array([[1.0, np.nan, 2.0, 5.0], [4.0, 1.0, np.nan, 1.0]])
+    got = np.asarray(kernels.generic_kernel("nancumsum", codes, values, size=2))
+    np.testing.assert_allclose(got, [[1.0, 0.0, 3.0, 5.0], [4.0, 1.0, 4.0, 2.0]])
+
+
+def test_ffill_bfill():
+    codes = np.array([0, 1, 0, 1, 0, 1])
+    values = np.array([np.nan, 1.0, 2.0, np.nan, np.nan, np.nan])
+    got_f = np.asarray(kernels.generic_kernel("ffill", codes, values, size=2))
+    np.testing.assert_allclose(got_f, [np.nan, 1.0, 2.0, 1.0, 2.0, 1.0], equal_nan=True)
+    got_b = np.asarray(kernels.generic_kernel("bfill", codes, values, size=2))
+    np.testing.assert_allclose(got_b, [2.0, 1.0, 2.0, np.nan, np.nan, np.nan], equal_nan=True)
+
+
+def test_var_chunk_triple():
+    codes = np.array([0, 0, 1, 1, 1])
+    values = np.array([1.0, 3.0, 2.0, 4.0, 6.0])
+    ma = kernels.generic_kernel("var_chunk", codes, values, size=2)
+    m2, total, cnt = (np.asarray(a) for a in ma)
+    np.testing.assert_allclose(total, [4.0, 12.0])
+    np.testing.assert_allclose(cnt, [2.0, 3.0])
+    np.testing.assert_allclose(m2, [2.0, 8.0])  # sum (x - mean)^2
+
+
+def test_nan_labels_excluded():
+    codes = np.array([0, -1, 0, 1])
+    values = np.array([1.0, 100.0, 2.0, 3.0])
+    got = np.asarray(kernels.generic_kernel("sum", codes, values, size=2))
+    np.testing.assert_allclose(got, [3.0, 3.0])
