@@ -7,18 +7,26 @@ kernels, and shard_map/collective execution strategies over a TPU mesh.
 """
 
 from . import kernels
+from .aggregations import Aggregation, Scan, is_supported_aggregation
+from .core import groupby_reduce
+from .scan import groupby_scan
 from .dtypes import INF, NA, NINF
 from .factorize import factorize_, factorize_single
 from .multiarray import MultiArray
 from .options import set_options
 
 __all__ = [
+    "Aggregation",
     "INF",
     "NA",
     "NINF",
     "MultiArray",
+    "Scan",
     "factorize_",
     "factorize_single",
+    "groupby_reduce",
+    "groupby_scan",
+    "is_supported_aggregation",
     "kernels",
     "set_options",
 ]

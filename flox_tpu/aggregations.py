@@ -1,0 +1,398 @@
+"""Aggregation blueprints, registry, and engine dispatch (L2).
+
+Parity target: /root/reference/flox/aggregations.py — the ``Aggregation``
+declarative blueprint (aggregations.py:161-301), the ~30-entry registry
+(881-922), ``generic_aggregate`` engine dispatch (60-133), the single-pass
+variance machinery (348-526), scans (716-922) and
+``_initialize_aggregation`` (925-1030).
+
+TPU-first deltas:
+
+* Combines are expressed as *collective-friendly* elementwise merge ops over
+  dense, shape-static intermediates ("sum" → ``lax.psum``, "max" → ``pmax``,
+  the variance triple → a two-phase psum Chan merge) rather than
+  concatenate-then-regroup.
+* ``reindex=True`` semantics are baked in: every intermediate is dense over
+  ``expected_groups``, which is what XLA fusion and mesh collectives need.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Callable, Literal, Sequence
+
+import numpy as np
+
+from . import dtypes, utils
+from .multiarray import MultiArray
+
+__all__ = [
+    "Aggregation",
+    "Scan",
+    "AGGREGATIONS",
+    "SCANS",
+    "generic_aggregate",
+    "_initialize_aggregation",
+    "_initialize_scan",
+    "is_supported_aggregation",
+]
+
+
+def generic_aggregate(
+    group_idx,
+    array,
+    *,
+    engine: str,
+    func: str | Callable,
+    axis: int = -1,
+    size: int,
+    fill_value=None,
+    dtype=None,
+    **kwargs,
+):
+    """Engine dispatcher (parity: aggregations.py:60-133)."""
+    if callable(func):
+        return func(
+            group_idx, array, axis=axis, size=size, fill_value=fill_value, dtype=dtype, **kwargs
+        )
+    if engine == "jax":
+        from . import kernels
+
+        return kernels.generic_kernel(
+            func, group_idx, array, axis=axis, size=size, fill_value=fill_value, dtype=dtype, **kwargs
+        )
+    if engine == "numpy":
+        from . import engine_numpy
+
+        return engine_numpy.generic_kernel(
+            func, group_idx, array, axis=axis, size=size, fill_value=fill_value, dtype=dtype, **kwargs
+        )
+    raise ValueError(f"Unknown engine {engine!r}; expected 'jax' or 'numpy'.")
+
+
+# ---------------------------------------------------------------------------
+# Aggregation blueprint
+# ---------------------------------------------------------------------------
+
+# Combine ops understood by the tree/collective combiner. "sum"/"max"/"min"/
+# "prod" merge dense intermediates elementwise; "var" is the Chan-style
+# triple merge; "arg" merges (value, global-index) pairs; "first"/"last"
+# merge (value, global-position) picking the extreme position.
+T_Combine = Literal["sum", "max", "min", "prod", "var", "argmax", "argmin", "first", "last", "concat"]
+
+
+@dataclass
+class Aggregation:
+    """Declarative recipe for one grouped reduction.
+
+    Stages (parity with aggregations.py:161-301):
+
+    * ``numpy``:   kernels for the single-device eager path (fused, direct).
+    * ``chunk``:   kernels run per shard/block producing dense intermediates.
+    * ``combine``: merge ops applied across shards/blocks (collectives).
+    * ``finalize``: maps combined intermediates -> final result.
+    """
+
+    name: str
+    numpy: tuple[str | Callable, ...] = ()
+    chunk: tuple[str | Callable, ...] | None = None
+    combine: tuple[T_Combine, ...] | None = None
+    finalize: Callable | None = None
+    preprocess: Callable | None = None
+    fill_value: dict[str, Any] = field(default_factory=dict)  # {"intermediate": (...), "numpy": (...)}
+    final_fill_value: Any = dtypes.NA
+    dtypes_: dict[str, Any] = field(default_factory=dict)
+    final_dtype: Any = None
+    reduction_type: Literal["reduce", "argreduce"] = "reduce"
+    preserves_dtype: bool = False
+    new_dims_func: Callable | None = None  # finalize_kwargs -> tuple of new dim sizes
+    # resolved by _initialize_aggregation:
+    finalize_kwargs: dict[str, Any] = field(default_factory=dict)
+    min_count: int = 0
+
+    def __post_init__(self):
+        if not self.numpy:
+            self.numpy = (self.name,)
+        if self.chunk is None and self.combine is None:
+            # blockwise-only aggregation (median/quantile/mode/first/last on
+            # float): must see all data for a group at once
+            pass
+
+    @property
+    def blockwise_only(self) -> bool:
+        return self.chunk is None
+
+    def new_dims(self) -> tuple[int, ...]:
+        if self.new_dims_func is None:
+            return ()
+        return self.new_dims_func(**self.finalize_kwargs)
+
+
+# --- finalize helpers -------------------------------------------------------
+
+
+def _mean_finalize(total, count, **kw):
+    import numpy as _np
+
+    if hasattr(total, "device"):  # jax array
+        import jax.numpy as jnp
+
+        return total / count
+    with _np.errstate(invalid="ignore", divide="ignore"):
+        return total / count
+
+
+def _var_finalize(ma: MultiArray, ddof=0, **kw):
+    m2, total, count = ma.arrays
+    denom = count - ddof
+    if hasattr(m2, "device"):
+        import jax.numpy as jnp
+
+        out = m2 / jnp.where(denom > 0, denom, 1)
+        return jnp.where(denom > 0, out, jnp.asarray(jnp.nan, out.dtype))
+    import numpy as _np
+
+    with _np.errstate(invalid="ignore", divide="ignore"):
+        out = m2 / _np.where(denom > 0, denom, 1)
+    return _np.where(denom > 0, out, _np.nan)
+
+
+def _std_finalize(ma: MultiArray, ddof=0, **kw):
+    out = _var_finalize(ma, ddof=ddof)
+    if hasattr(out, "device"):
+        import jax.numpy as jnp
+
+        return jnp.sqrt(out)
+    return np.sqrt(out)
+
+
+def _pick_second(a, b, **kw):
+    return b
+
+
+def _quantile_new_dims(q=0.5, **kw):
+    return () if np.ndim(q) == 0 else (len(q),)
+
+
+# --- registry ---------------------------------------------------------------
+
+
+def _agg(name, **kw) -> Aggregation:
+    return Aggregation(name, **kw)
+
+
+AGGREGATIONS: dict[str, Aggregation] = {}
+
+
+def _register(agg: Aggregation) -> None:
+    AGGREGATIONS[agg.name] = agg
+
+
+# counts
+_register(_agg("count", numpy=("nanlen",), chunk=("nanlen",), combine=("sum",),
+               fill_value={"intermediate": (0,), "numpy": (0,)}, final_fill_value=0,
+               final_dtype=np.intp))
+
+# sums / products
+for nm, skipna in [("sum", False), ("nansum", True)]:
+    _register(_agg(nm, chunk=(nm,), combine=("sum",),
+                   fill_value={"intermediate": (0,), "numpy": (0,)}, final_fill_value=0))
+for nm in ["prod", "nanprod"]:
+    _register(_agg(nm, chunk=(nm,), combine=("prod",),
+                   fill_value={"intermediate": (1,), "numpy": (1,)}, final_fill_value=1))
+
+# mean family: chunk = (sum, count), combine = (sum, sum), finalize = divide
+for nm, sum_k, len_k in [("mean", "sum", "len"), ("nanmean", "nansum", "nanlen")]:
+    _register(_agg(nm, numpy=(nm,), chunk=(sum_k, len_k), combine=("sum", "sum"),
+                   finalize=_mean_finalize,
+                   fill_value={"intermediate": (0, 0), "numpy": (np.nan,)},
+                   final_fill_value=dtypes.NA, final_dtype=None))
+
+# var/std family: chunk = var_chunk triple, combine = Chan merge
+for nm, skipna, fin in [("var", False, _var_finalize), ("nanvar", True, _var_finalize),
+                        ("std", False, _std_finalize), ("nanstd", True, _std_finalize)]:
+    _register(_agg(nm, numpy=(nm,),
+                   chunk=(("var_chunk", {"skipna": skipna}),), combine=("var",), finalize=fin,
+                   fill_value={"intermediate": (0,), "numpy": (np.nan,)},
+                   final_fill_value=dtypes.NA))
+
+# min/max
+for nm, comb, sentinel in [("max", "max", dtypes.NINF), ("nanmax", "max", dtypes.NINF),
+                           ("min", "min", dtypes.INF), ("nanmin", "min", dtypes.INF)]:
+    _register(_agg(nm, chunk=(nm,), combine=(comb,),
+                   fill_value={"intermediate": (sentinel,), "numpy": (dtypes.NA,)},
+                   final_fill_value=dtypes.NA, preserves_dtype=True))
+
+# bool reductions
+_register(_agg("all", chunk=("all",), combine=("min",),
+               fill_value={"intermediate": (True,), "numpy": (True,)}, final_fill_value=True,
+               final_dtype=np.bool_))
+_register(_agg("any", chunk=("any",), combine=("max",),
+               fill_value={"intermediate": (False,), "numpy": (False,)}, final_fill_value=False,
+               final_dtype=np.bool_))
+
+# argreductions: eager path = direct kernel; chunked path pairs the extreme
+# value with its global index (parity: aggregations.py:549-632)
+for nm in ["argmax", "argmin", "nanargmax", "nanargmin"]:
+    base = nm.removeprefix("nan")
+    val_k = nm.replace("arg", "")  # max / nanmax / ...
+    _register(_agg(nm, numpy=(nm,), chunk=(val_k, nm), combine=(base,),
+                   finalize=_pick_second, reduction_type="argreduce",
+                   fill_value={"intermediate": (dtypes.NINF if "max" in nm else dtypes.INF, -1),
+                               "numpy": (-1,)},
+                   final_fill_value=-1, final_dtype=np.intp))
+
+# first/last: order-dependent; combine by tracking the global position
+for nm, comb in [("first", "first"), ("last", "last"),
+                 ("nanfirst", "first"), ("nanlast", "last")]:
+    _register(_agg(nm, chunk=(nm,), combine=(comb,),
+                   fill_value={"intermediate": (dtypes.NA,), "numpy": (dtypes.NA,)},
+                   final_fill_value=dtypes.NA, preserves_dtype=True))
+
+# order statistics: blockwise-only (chunk=None), like the reference
+# (aggregations.py:672-712) — they need every element of a group at once.
+for nm in ["median", "nanmedian"]:
+    _register(_agg(nm, chunk=None, combine=None,
+                   fill_value={"numpy": (dtypes.NA,)}, final_fill_value=dtypes.NA))
+for nm in ["quantile", "nanquantile"]:
+    _register(_agg(nm, chunk=None, combine=None,
+                   fill_value={"numpy": (dtypes.NA,)}, final_fill_value=dtypes.NA,
+                   new_dims_func=_quantile_new_dims))
+for nm in ["mode", "nanmode"]:
+    _register(_agg(nm, chunk=None, combine=None,
+                   fill_value={"numpy": (dtypes.NA,)}, final_fill_value=dtypes.NA,
+                   preserves_dtype=True))
+
+
+def is_supported_aggregation(func: str) -> bool:
+    """Public capability probe (parity: aggregations.py:1033-1054)."""
+    return func in AGGREGATIONS
+
+
+# ---------------------------------------------------------------------------
+# initialization: resolve dtypes and fill values against the input array
+# ---------------------------------------------------------------------------
+
+
+def _initialize_aggregation(
+    func: str | Aggregation,
+    dtype,
+    array_dtype,
+    fill_value,
+    min_count: int,
+    finalize_kwargs: dict[str, Any] | None,
+) -> Aggregation:
+    """Resolve a registry template into a concrete plan
+    (parity: aggregations.py:925-1030)."""
+    if isinstance(func, Aggregation):
+        agg = copy.deepcopy(func)
+    else:
+        try:
+            agg = copy.deepcopy(AGGREGATIONS[func])
+        except KeyError:
+            raise ValueError(f"Unsupported aggregation: {func!r}") from None
+
+    array_dtype = np.dtype(array_dtype)
+    agg.finalize_kwargs = dict(finalize_kwargs or {})
+    agg.min_count = min_count
+
+    # final dtype
+    if agg.final_dtype is not None and dtype is None:
+        final = np.dtype(agg.final_dtype)
+    else:
+        final = dtypes.normalize_dtype(
+            dtype, array_dtype, preserves_dtype=agg.preserves_dtype, fill_value=fill_value
+        )
+        if not agg.preserves_dtype and agg.name not in ("sum", "nansum", "prod", "nanprod"):
+            # mean/var/etc. of int data is float
+            if agg.name not in ("count", "all", "any") and final.kind in "iub":
+                final = np.result_type(final, np.float64 if utils.x64_enabled() else np.float32)
+    agg.final_dtype = final
+
+    # resolve final fill value; with min_count the default must be a missing
+    # marker (NaN), not the reduction identity — that's the whole point of
+    # min_count (parity: core.py:1026-1038 + aggregations.py:1005-1014)
+    if fill_value is None:
+        fill_value = dtypes.NA if min_count > 0 else agg.final_fill_value
+    if fill_value in (dtypes.NA, dtypes.INF, dtypes.NINF):
+        promoted, na = dtypes.maybe_promote(final)
+        if fill_value is dtypes.NA:
+            # only promote if some group can actually be missing; the caller
+            # decides — record the NA-resolved value for use at finalize time
+            fill_value = dtypes.get_fill_value(promoted, dtypes.NA)
+        else:
+            fill_value = dtypes.get_fill_value(final, fill_value)
+    agg.final_fill_value = fill_value
+
+    # resolve intermediate fills against the working dtype
+    work_dtype = final if not agg.preserves_dtype else array_dtype
+    inter = agg.fill_value.get("intermediate", ())
+    agg.fill_value["intermediate"] = tuple(
+        dtypes.get_fill_value(work_dtype, fv) if fv in (dtypes.NA, dtypes.INF, dtypes.NINF) else fv
+        for fv in inter
+    )
+
+    # min_count: append a count intermediate so finalize can mask
+    # (parity: aggregations.py:1005-1014)
+    if min_count > 0 and agg.chunk is not None and "nanlen" not in _chunk_names(agg):
+        agg.chunk = tuple(agg.chunk) + ("nanlen",)
+        agg.combine = tuple(agg.combine) + ("sum",)
+        agg.fill_value["intermediate"] = tuple(agg.fill_value["intermediate"]) + (0,)
+
+    return agg
+
+
+def _chunk_names(agg: Aggregation) -> tuple[str, ...]:
+    out = []
+    for c in agg.chunk or ():
+        if isinstance(c, tuple):
+            out.append(c[0])
+        elif isinstance(c, str):
+            out.append(c)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# scans (parity: aggregations.py:716-922)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Scan:
+    """Blueprint for a grouped scan.
+
+    * ``scan``: the within-block grouped scan kernel.
+    * ``reduction``: per-block per-group summary carried across blocks
+      (cumsum -> "sum" of the block; ffill -> "nanlast" value).
+    * ``binary_op``: how an incoming carry combines with block values.
+    * ``identity``: carry for groups not yet seen.
+    """
+
+    name: str
+    scan: str
+    reduction: str
+    binary_op: Callable | None
+    identity: Any
+    # "apply_binary_op": add carry to scanned block; "ffill": where-NaN fill
+    mode: Literal["apply_binary_op", "ffill"] = "apply_binary_op"
+    preserves_dtype: bool = False
+
+
+SCANS: dict[str, Scan] = {
+    "cumsum": Scan("cumsum", scan="cumsum", reduction="sum", binary_op=None, identity=0),
+    "nancumsum": Scan("nancumsum", scan="nancumsum", reduction="nansum", binary_op=None, identity=0),
+    "ffill": Scan("ffill", scan="ffill", reduction="nanlast", binary_op=None, identity=np.nan,
+                  mode="ffill", preserves_dtype=True),
+    "bfill": Scan("bfill", scan="bfill", reduction="nanfirst", binary_op=None, identity=np.nan,
+                  mode="ffill", preserves_dtype=True),
+}
+
+
+def _initialize_scan(func: str | Scan) -> Scan:
+    if isinstance(func, Scan):
+        return copy.deepcopy(func)
+    try:
+        return copy.deepcopy(SCANS[func])
+    except KeyError:
+        raise ValueError(f"Unsupported scan: {func!r}") from None

@@ -45,6 +45,14 @@ class _Sentinel:
     def __repr__(self) -> str:
         return f"<{self._name}>"
 
+    # Sentinels are singletons compared by identity; they must survive the
+    # deepcopy that _initialize_aggregation applies to registry templates.
+    def __copy__(self) -> "_Sentinel":
+        return self
+
+    def __deepcopy__(self, memo) -> "_Sentinel":
+        return self
+
 
 #: Resolves to the greatest representable value of the target dtype.
 INF = _Sentinel("INF")

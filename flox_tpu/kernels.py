@@ -102,9 +102,15 @@ def _fill_empty(out, present, fill_value):
     return jnp.where(present, out, jnp.asarray(fill_value).astype(out.dtype))
 
 
-def _nan_mask(array):
+_NAT_INT = np.iinfo(np.int64).min  # NaT viewed as int64 (core passes nat=True)
+
+
+def _nan_mask(array, nat: bool = False):
     if jnp.issubdtype(array.dtype, jnp.floating) or jnp.issubdtype(array.dtype, jnp.complexfloating):
         return ~jnp.isnan(array)
+    if nat and jnp.issubdtype(array.dtype, jnp.signedinteger):
+        # datetime64 data arrives viewed as int64; INT64_MIN is NaT
+        return array != jnp.asarray(_NAT_INT, dtype=array.dtype)
     return None  # non-float: nothing is NaN
 
 
@@ -130,7 +136,7 @@ def _make_addlike(op: str, identity, skipna: bool):
     def kernel(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
         codes = _safe_codes(group_idx, size)
         data = _to_leading(array)
-        mask = _nan_mask(data) if skipna else None
+        mask = _nan_mask(data, kw.get("nat", False)) if skipna else None
         if mask is not None:
             data = jnp.where(mask, data, jnp.asarray(identity, dtype=data.dtype))
         data = _maybe_cast(data, dtype)
@@ -156,19 +162,31 @@ def _make_minmax(op: str, skipna: bool):
         codes = _safe_codes(group_idx, size)
         data = _to_leading(array)
         data = _maybe_cast(data, dtype)
-        mask = _nan_mask(data)
+        nat = kw.get("nat", False)
+        mask = _nan_mask(data, nat)
+        isint = not jnp.issubdtype(data.dtype, jnp.floating)
         if skipna and mask is not None:
-            ident = jnp.asarray(-jnp.inf if op == "max" else jnp.inf, dtype=data.dtype)
+            if isint:
+                info = np.iinfo(np.dtype(str(data.dtype)))
+                ident = jnp.asarray(info.min if op == "max" else info.max, dtype=data.dtype)
+            else:
+                ident = jnp.asarray(-jnp.inf if op == "max" else jnp.inf, dtype=data.dtype)
             data = jnp.where(mask, data, ident)
         elif not skipna and mask is not None:
-            # NaN propagates through min/max in numpy; segment_min/max on TPU
-            # would otherwise drop it. Force-propagate by mapping NaN to the
-            # absorbing element.
-            absorb = jnp.asarray(jnp.inf if op == "max" else -jnp.inf, dtype=data.dtype)
+            # NaN/NaT propagates through min/max in numpy; segment_min/max on
+            # TPU would otherwise drop it. Force-propagate by mapping the
+            # missing marker to the absorbing element.
+            if isint:
+                info = np.iinfo(np.dtype(str(data.dtype)))
+                absorb = jnp.asarray(info.max if op == "max" else info.min, dtype=data.dtype)
+                missing_marker = jnp.asarray(_NAT_INT, dtype=data.dtype)
+            else:
+                absorb = jnp.asarray(jnp.inf if op == "max" else -jnp.inf, dtype=data.dtype)
+                missing_marker = jnp.asarray(jnp.nan, dtype=data.dtype)
             has_nan = _seg("max", (~mask).astype(jnp.int8), codes, size) > 0
             data = jnp.where(mask, data, absorb)
             out = _seg(op, data, codes, size)
-            out = jnp.where(has_nan, jnp.asarray(jnp.nan, dtype=out.dtype), out)
+            out = jnp.where(has_nan, missing_marker, out)
             present = _counts(codes, size) > 0
             out = _fill_empty(out, _bcast_present(present, out), fill_value)
             return _from_leading(out)
@@ -197,7 +215,7 @@ def nanlen(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw
     """Count of non-NaN elements per group (the reference's 'nanlen')."""
     codes = _safe_codes(group_idx, size)
     data = _to_leading(array)
-    mask = _nan_mask(data)
+    mask = _nan_mask(data, kw.get("nat", False))
     out = _counts(codes, size, mask=mask, dtype=dtype or jnp.int32)
     if mask is None and out.ndim < data.ndim:
         out = jnp.broadcast_to(
@@ -364,21 +382,30 @@ def any_(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
 # ---------------------------------------------------------------------------
 
 
-def _arg_impl(group_idx, array, *, size, fill_value, skipna, arg_of_max):
+def _arg_impl(group_idx, array, *, size, fill_value, skipna, arg_of_max, nat=False):
     codes = _safe_codes(group_idx, size)
     data = _to_leading(array)
-    mask = _nan_mask(data)
+    mask = _nan_mask(data, nat)
     key = data
     if mask is not None:
+        isint = not jnp.issubdtype(data.dtype, jnp.floating)
         if skipna:
-            ident = jnp.asarray(-jnp.inf if arg_of_max else jnp.inf, dtype=data.dtype)
+            if isint:
+                info = np.iinfo(np.dtype(str(data.dtype)))
+                ident = jnp.asarray(info.min if arg_of_max else info.max, dtype=data.dtype)
+            else:
+                ident = jnp.asarray(-jnp.inf if arg_of_max else jnp.inf, dtype=data.dtype)
             key = jnp.where(mask, data, ident)
         else:
             # NaN propagates: map NaN to the absorbing element so a NaN-bearing
             # group resolves to a NaN position. (Known divergence from numpy:
             # if a group contains both inf and NaN, the earlier of the two wins
             # the tie rather than strictly the first NaN.)
-            absorb = jnp.asarray(jnp.inf if arg_of_max else -jnp.inf, dtype=data.dtype)
+            if isint:
+                info = np.iinfo(np.dtype(str(data.dtype)))
+                absorb = jnp.asarray(info.max if arg_of_max else info.min, dtype=data.dtype)
+            else:
+                absorb = jnp.asarray(jnp.inf if arg_of_max else -jnp.inf, dtype=data.dtype)
             key = jnp.where(mask, data, absorb)
     best = _seg("max" if arg_of_max else "min", key, codes, size)
     best_per_elem = jnp.take(
@@ -397,25 +424,25 @@ def _arg_impl(group_idx, array, *, size, fill_value, skipna, arg_of_max):
 
 
 def argmax(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
-    return _arg_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False, arg_of_max=True)
+    return _arg_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False, arg_of_max=True, nat=kw.get("nat", False))
 
 
 def argmin(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
-    return _arg_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False, arg_of_max=False)
+    return _arg_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False, arg_of_max=False, nat=kw.get("nat", False))
 
 
 def nanargmax(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
-    return _arg_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True, arg_of_max=True)
+    return _arg_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True, arg_of_max=True, nat=kw.get("nat", False))
 
 
 def nanargmin(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
-    return _arg_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True, arg_of_max=False)
+    return _arg_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True, arg_of_max=False, nat=kw.get("nat", False))
 
 
-def _firstlast_impl(group_idx, array, *, size, fill_value, skipna, last):
+def _firstlast_impl(group_idx, array, *, size, fill_value, skipna, last, nat=False):
     codes = _safe_codes(group_idx, size)
     data = _to_leading(array)
-    mask = _nan_mask(data) if skipna else None
+    mask = _nan_mask(data, nat) if skipna else None
     iota = _iota_like(data)
     if mask is not None:
         iota = jnp.where(mask, iota, -1 if last else _BIG)
@@ -429,19 +456,19 @@ def _firstlast_impl(group_idx, array, *, size, fill_value, skipna, last):
 
 
 def first(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
-    return _firstlast_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False, last=False)
+    return _firstlast_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False, last=False, nat=kw.get("nat", False))
 
 
 def last(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
-    return _firstlast_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False, last=True)
+    return _firstlast_impl(group_idx, array, size=size, fill_value=fill_value, skipna=False, last=True, nat=kw.get("nat", False))
 
 
 def nanfirst(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
-    return _firstlast_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True, last=False)
+    return _firstlast_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True, last=False, nat=kw.get("nat", False))
 
 
 def nanlast(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
-    return _firstlast_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True, last=True)
+    return _firstlast_impl(group_idx, array, size=size, fill_value=fill_value, skipna=True, last=True, nat=kw.get("nat", False))
 
 
 # ---------------------------------------------------------------------------

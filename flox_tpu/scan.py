@@ -1,0 +1,146 @@
+"""Grouped scans: ``groupby_scan`` (parity: /root/reference/flox/scan.py:101-370).
+
+Supported scans (matching the reference registry, aggregations.py:849-920):
+``cumsum``, ``nancumsum``, ``ffill``, ``bfill``.
+
+TPU-first architecture: the reference implements grouped scans as a Blelloch
+scan over dask blocks (dask.py:576-663) whose within-block kernel is a
+sorted cumulative op (aggregate_flox.py:269-329). Here the within-device
+kernel is a *segmented* ``lax.associative_scan`` (kernels.py), which is
+already log-depth over the whole axis — on a single chip there is no block
+decomposition at all, and across a mesh the same segmented operator is
+applied to per-shard carries (parallel/scan.py).
+
+Multi-dimensional labels are handled with the offset-codes trick
+(factorize.offset_labels): each non-scanned label row gets a disjoint code
+range, so one flat segmented scan handles every row without crossing rows.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Sequence
+
+import numpy as np
+
+from . import factorize as fct
+from . import utils
+from .aggregations import Scan, _initialize_scan
+from .core import _convert_expected_groups_to_index, _normalize_expected, _normalize_isbin
+from .options import OPTIONS
+
+logger = logging.getLogger("flox_tpu")
+
+__all__ = ["groupby_scan"]
+
+
+def groupby_scan(
+    array,
+    *by,
+    func: str | Scan,
+    expected_groups=None,
+    axis: int = -1,
+    dtype=None,
+    method: str | None = None,
+    engine: str | None = None,
+):
+    """Grouped scan along ``axis``; output has the same shape as ``array``.
+
+    Parity: scan.py:101-315 — single-axis validation (scan.py:176-177),
+    early factorization (210-220), integer dtype promotion for cumsum
+    (272-283). Positions with missing labels (NaN-by) yield NaN.
+    """
+    if not by:
+        raise TypeError("Must pass at least one `by`")
+    if np.ndim(axis) != 0:
+        raise ValueError("groupby_scan supports a single axis only (like the reference).")
+    engine = engine or OPTIONS["default_engine"]
+    nby = len(by)
+
+    bys = [utils.asarray_host(b) for b in by]
+    bys = list(np.broadcast_arrays(*bys)) if nby > 1 else bys
+    array_is_jax = utils.is_jax_array(array)
+    arr = array if array_is_jax else np.asarray(array)
+
+    bndim = bys[0].ndim
+    if arr.shape[-bndim:] != bys[0].shape:
+        raise ValueError(
+            f"`by` has shape {bys[0].shape} which does not align with the trailing "
+            f"dimensions of `array` with shape {arr.shape}."
+        )
+
+    axis_n = axis % arr.ndim
+    first_by_ax = arr.ndim - bndim
+    if axis_n < first_by_ax:
+        raise ValueError("Scan axis must be covered by the `by` labels.")
+    rel_axis = axis_n - first_by_ax
+
+    expected = _normalize_expected(expected_groups, nby)
+    expected_idx = _convert_expected_groups_to_index(expected, _normalize_isbin(False, nby), sort=True)
+
+    # move the scan axis to the end of both array and labels
+    if rel_axis != bndim - 1:
+        by_order = [d for d in range(bndim) if d != rel_axis] + [rel_axis]
+        bys = [b.transpose(by_order) for b in bys]
+        arr_order = list(range(first_by_ax)) + [first_by_ax + d for d in by_order]
+        arr = arr.transpose(arr_order)
+
+    codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
+        bys, axes=(bndim - 1,), expected_groups=expected_idx, sort=True
+    )
+    # factorize_ offsets codes when bndim > 1 (disjoint ranges per row);
+    # codes now flatten alongside the trailing by-span of the array.
+    codes_flat = np.asarray(codes).reshape(-1)
+    span = codes_flat.shape[0]
+    lead_shape = arr.shape[: arr.ndim - bndim]
+    arr_flat = arr.reshape(lead_shape + (span,))
+
+    scan = _initialize_scan(func)
+
+    # dtype promotion for accumulating scans (parity: scan.py:272-283)
+    arr_dtype = np.dtype(arr.dtype) if not array_is_jax else np.dtype(str(arr.dtype))
+    if scan.name in ("cumsum", "nancumsum") and dtype is None:
+        if arr_dtype.kind in "iub":
+            dtype = np.result_type(arr_dtype, np.int_)
+    out = _apply_scan(scan, arr_flat, codes_flat, engine=engine, dtype=dtype)
+
+    # missing labels scan to NaN (they belong to no group)
+    if (np.asarray(codes_flat) < 0).any():
+        nanmask = codes_flat < 0
+        out = _mask_positions(out, nanmask)
+
+    out = out.reshape(arr.shape) if out.shape != arr.shape else out
+    out = out.reshape(lead_shape + bys[0].shape)
+    # undo the axis transpose
+    if rel_axis != bndim - 1:
+        inv = np.argsort(arr_order)
+        out = out.transpose(tuple(inv))
+    return out
+
+
+def _apply_scan(scan: Scan, arr_flat, codes_flat, *, engine, dtype):
+    from .aggregations import generic_aggregate
+
+    return generic_aggregate(
+        codes_flat,
+        arr_flat,
+        engine=engine,
+        func=scan.scan,
+        size=int(codes_flat.max()) + 1 if codes_flat.size else 1,
+        dtype=dtype,
+    )
+
+
+def _mask_positions(out, nanmask):
+    if utils.is_jax_array(out):
+        import jax.numpy as jnp
+
+        if not jnp.issubdtype(out.dtype, jnp.floating):
+            out = out.astype(jnp.float64 if utils.x64_enabled() else jnp.float32)
+        return jnp.where(jnp.asarray(nanmask), jnp.nan, out)
+    out = np.asarray(out)
+    if not np.issubdtype(out.dtype, np.floating):
+        out = out.astype(np.float64)
+    return np.where(nanmask, np.nan, out)
+
+

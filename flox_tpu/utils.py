@@ -36,6 +36,16 @@ def is_jax_array(x: Any) -> bool:
     return isinstance(x, jax.Array)
 
 
+def x64_enabled() -> bool:
+    """Whether jax is configured for 64-bit dtypes (True when jax is absent)."""
+    try:
+        import jax
+
+        return bool(jax.config.jax_enable_x64)
+    except ImportError:  # pragma: no cover
+        return True
+
+
 def is_duck_array(value: Any) -> bool:
     if isinstance(value, np.ndarray):
         return True

@@ -1,0 +1,354 @@
+"""End-to-end groupby_reduce tests against per-group numpy oracles.
+
+Modeled on the reference's giant parametrized sweep
+(tests/test_core.py:222-388): {func × engine × 1d/2d × NaN-in-data ×
+NaN-in-by × expected/None × finalize_kwargs} compared against plain numpy
+applied to each group's masked slice.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+import scipy.stats
+
+from flox_tpu.core import groupby_reduce
+
+RNG = np.random.default_rng(123)
+
+ALL_FUNCS = [
+    "sum", "nansum", "prod", "nanprod", "mean", "nanmean", "var", "nanvar",
+    "std", "nanstd", "max", "nanmax", "min", "nanmin", "argmax", "nanargmax",
+    "argmin", "nanargmin", "any", "all", "count",
+    "first", "last", "nanfirst", "nanlast",
+    "median", "nanmedian", "quantile", "nanquantile", "mode", "nanmode",
+]
+
+
+def _np_oracle(func):
+    """func name -> plain numpy callable over axis=-1 (independent oracle)."""
+    if func == "count":
+        return lambda g, **kw: np.sum(~np.isnan(g), axis=-1)
+    if func in ("first", "nanfirst"):
+        def first_(g, **kw):
+            if func == "first":
+                return g[..., 0]
+            out = np.full(g.shape[:-1], np.nan)
+            for idx in np.ndindex(g.shape[:-1]):
+                valid = g[idx][~np.isnan(g[idx])]
+                if valid.size:
+                    out[idx] = valid[0]
+            return out
+        return first_
+    if func in ("last", "nanlast"):
+        def last_(g, **kw):
+            if func == "last":
+                return g[..., -1]
+            out = np.full(g.shape[:-1], np.nan)
+            for idx in np.ndindex(g.shape[:-1]):
+                valid = g[idx][~np.isnan(g[idx])]
+                if valid.size:
+                    out[idx] = valid[-1]
+            return out
+        return last_
+    if func in ("mode", "nanmode"):
+        def mode_(g, **kw):
+            nan_policy = "omit" if func == "nanmode" else "propagate"
+            res = scipy.stats.mode(g, axis=-1, nan_policy=nan_policy, keepdims=False)
+            return res.mode
+        return mode_
+    if func in ("quantile", "nanquantile"):
+        base = np.nanquantile if func == "nanquantile" else np.quantile
+        return lambda g, q=0.5, **kw: base(g, q, axis=-1)
+    np_func = getattr(np, func)
+    return lambda g, **kw: np_func(g, axis=-1, **kw)
+
+
+def compare(result, expected, func):
+    result = np.asarray(result)
+    rtol, atol = 1e-12, 1e-12
+    np.testing.assert_allclose(
+        result.astype(np.float64),
+        np.asarray(expected).astype(np.float64),
+        rtol=rtol,
+        atol=atol,
+        equal_nan=True,
+    )
+
+
+def reference_loop(func, values, codes, size, **kw):
+    """Apply the oracle per group; NaN where undefined."""
+    oracle = _np_oracle(func)
+    q = kw.get("q")
+    lead = values.shape[:-1]
+    extra = (len(q),) if q is not None and np.ndim(q) > 0 else ()
+    out = np.full(extra + lead + (size,), np.nan)
+    for g in range(size):
+        sel = np.flatnonzero(codes == g)
+        if sel.size == 0:
+            if func in ("sum", "nansum"):
+                out[..., g] = 0
+            elif func in ("prod", "nanprod"):
+                out[..., g] = 1
+            elif func == "count":
+                out[..., g] = 0
+            elif func == "all":
+                out[..., g] = 1
+            elif func == "any":
+                out[..., g] = 0
+            elif "arg" in func:
+                out[..., g] = -1
+            continue
+        grp = values[..., sel]
+        with np.errstate(invalid="ignore", divide="ignore"), np.testing.suppress_warnings() as sup:
+            sup.filter(RuntimeWarning)
+            if "arg" in func:
+                if func.startswith("nanarg"):
+                    allnan = np.all(np.isnan(grp), axis=-1)
+                    safe = np.where(
+                        np.isnan(grp), -np.inf if "max" in func else np.inf, grp
+                    )
+                    local = np.argmax(safe, -1) if "max" in func else np.argmin(safe, -1)
+                    res = np.where(allnan, -1, sel[local])
+                else:
+                    local = np.argmax(grp, -1) if "max" in func else np.argmin(grp, -1)
+                    res = sel[local]
+            elif func.startswith("nan") and func not in ("nanfirst", "nanlast", "nanmode", "nanquantile", "nanmedian"):
+                allnan = np.all(np.isnan(grp), axis=-1)
+                res = _np_oracle(func)(grp, **kw)
+                if func in ("nanmean", "nanvar", "nanstd", "nanmedian"):
+                    res = np.where(allnan, np.nan, res)
+            else:
+                res = _np_oracle(func)(grp, **kw)
+            if func in ("nanquantile",) and np.ndim(kw.get("q", 0.5)) > 0:
+                out[..., g] = res
+                continue
+        out[..., g] = res
+    return out
+
+
+@pytest.mark.parametrize("shape", ["1d", "2d"])
+@pytest.mark.parametrize("add_nan", [False, True])
+@pytest.mark.parametrize("func", ALL_FUNCS)
+def test_groupby_reduce_all(engine, func, shape, add_nan):
+    n, size = 60, 4
+    codes = RNG.integers(0, size, n)
+    labels = codes.astype(np.int64)
+    values = np.round(RNG.normal(size=(3, n) if shape == "2d" else (n,)), 1)
+    if add_nan:
+        values[..., RNG.random(n) < 0.25] = np.nan
+    if add_nan and func in ("argmax", "argmin"):
+        pytest.skip("NaN-propagating argreductions: inf/NaN tie edge documented")
+    if add_nan and func in ("mode",):
+        pytest.skip("scipy mode propagate with partial NaN differs per version")
+
+    fkw = {}
+    if func in ("var", "nanvar", "std", "nanstd"):
+        fkw = {"ddof": 1}
+    if func in ("quantile", "nanquantile"):
+        fkw = {"q": 0.7}
+
+    result, groups = groupby_reduce(values, labels, func=func, engine=engine, finalize_kwargs=fkw)
+    np.testing.assert_array_equal(groups, np.arange(size))
+
+    expected = reference_loop(func, values, codes, size, **fkw)
+    # ddof guard: groups with n<=ddof give NaN in both
+    compare(result, expected, func)
+
+
+@pytest.mark.parametrize("func", ["sum", "nanmean", "max", "count"])
+def test_expected_groups_reindex(engine, func):
+    labels = np.array([1, 1, 3, 3, 5])
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    result, groups = groupby_reduce(
+        vals, labels, func=func, engine=engine, expected_groups=np.array([1, 2, 3, 4, 5])
+    )
+    np.testing.assert_array_equal(groups, [1, 2, 3, 4, 5])
+    res = np.asarray(result).astype(float)
+    if func == "sum":
+        np.testing.assert_allclose(res, [3, 0, 7, 0, 5])
+    elif func == "count":
+        np.testing.assert_allclose(res, [2, 0, 2, 0, 1])
+    elif func == "nanmean":
+        np.testing.assert_allclose(res, [1.5, np.nan, 3.5, np.nan, 5.0], equal_nan=True)
+    elif func == "max":
+        np.testing.assert_allclose(res, [2, np.nan, 4, np.nan, 5], equal_nan=True)
+
+
+def test_nan_labels_dropped(engine):
+    labels = np.array([0.0, np.nan, 0.0, 1.0])
+    vals = np.array([1.0, 100.0, 2.0, 3.0])
+    result, groups = groupby_reduce(vals, labels, func="sum", engine=engine)
+    np.testing.assert_allclose(np.asarray(result).astype(float), [3.0, 3.0])
+    np.testing.assert_array_equal(groups, [0.0, 1.0])
+
+
+def test_binning(engine):
+    vals = np.array([0.5, 1.5, 2.5, 3.5, 4.5])
+    result, bins = groupby_reduce(
+        vals, vals, func="count", engine=engine,
+        expected_groups=np.array([0.0, 2.0, 4.0, 6.0]), isbin=True,
+    )
+    assert isinstance(bins, pd.IntervalIndex)
+    np.testing.assert_array_equal(np.asarray(result), [2, 2, 1])
+
+
+def test_multi_by_product_grid(engine):
+    by1 = np.array([0, 0, 1, 1, 0, 1])
+    by2 = np.array(["a", "b", "a", "b", "a", "a"])
+    vals = np.arange(6.0)
+    result, g1, g2 = groupby_reduce(vals, by1, by2, func="sum", engine=engine)
+    np.testing.assert_array_equal(g1, [0, 1])
+    np.testing.assert_array_equal(g2, ["a", "b"])
+    # grid: (0,a)=0+4, (0,b)=1, (1,a)=2+5, (1,b)=3
+    np.testing.assert_allclose(np.asarray(result).astype(float), [[4, 1], [7, 3]])
+
+
+def test_partial_axis_reduction(engine):
+    # labels 2d, reduce only the last axis -> per-row group spaces
+    labels = np.array([[0, 1, 0], [1, 1, 0]])
+    vals = np.arange(6.0).reshape(2, 3)
+    result, groups = groupby_reduce(vals, labels, func="sum", engine=engine, axis=-1)
+    np.testing.assert_allclose(np.asarray(result).astype(float), [[2, 1], [5, 7]])
+
+
+def test_axis_beyond_by(engine):
+    # reduce over an axis the labels don't span: labels broadcast
+    labels = np.array([0, 1, 0])
+    vals = np.arange(6.0).reshape(2, 3)
+    result, groups = groupby_reduce(vals, labels, func="sum", engine=engine, axis=(0, 1))
+    np.testing.assert_allclose(np.asarray(result).astype(float), [0 + 2 + 3 + 5, 1 + 4])
+
+
+def test_min_count(engine):
+    labels = np.array([0, 0, 1])
+    vals = np.array([1.0, np.nan, np.nan])
+    result, _ = groupby_reduce(vals, labels, func="nansum", engine=engine, min_count=1)
+    np.testing.assert_allclose(np.asarray(result).astype(float), [1.0, np.nan], equal_nan=True)
+    result, _ = groupby_reduce(vals, labels, func="nansum", engine=engine, min_count=2)
+    np.testing.assert_allclose(np.asarray(result).astype(float), [np.nan, np.nan], equal_nan=True)
+
+
+def test_sort_false(engine):
+    labels = np.array([3, 1, 3, 2])
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    _, groups_sorted = groupby_reduce(vals, labels, func="sum", engine=engine, sort=True)
+    np.testing.assert_array_equal(groups_sorted, [1, 2, 3])
+    _, groups_unsorted = groupby_reduce(vals, labels, func="sum", engine=engine, sort=False)
+    np.testing.assert_array_equal(groups_unsorted, [3, 1, 2])
+
+
+def test_datetime_minmax(engine):
+    dt = np.array(["2020-01-03", "2020-01-01", "2020-01-02", "NaT"], dtype="datetime64[ns]")
+    labels = np.array([0, 0, 1, 1])
+    result, _ = groupby_reduce(dt, labels, func="min", engine=engine)
+    assert result.dtype == dt.dtype
+    np.testing.assert_array_equal(
+        result, np.array(["2020-01-01", "NaT"], dtype="datetime64[ns]")
+    )
+    result, _ = groupby_reduce(dt, labels, func="nanmin", engine=engine)
+    np.testing.assert_array_equal(
+        result, np.array(["2020-01-01", "2020-01-02"], dtype="datetime64[ns]")
+    )
+
+
+def test_bool_input(engine):
+    labels = np.array([0, 0, 1, 1])
+    vals = np.array([True, False, True, True])
+    result, _ = groupby_reduce(vals, labels, func="sum", engine=engine)
+    np.testing.assert_array_equal(np.asarray(result), [1, 2])
+    result, _ = groupby_reduce(vals, labels, func="all", engine=engine)
+    np.testing.assert_array_equal(np.asarray(result), [False, True])
+
+
+def test_dtype_request(engine):
+    labels = np.array([0, 1, 0])
+    vals = np.array([1, 2, 3], dtype=np.int32)
+    result, _ = groupby_reduce(vals, labels, func="sum", engine=engine, dtype=np.float32)
+    assert np.asarray(result).dtype == np.float32
+
+
+def test_fill_value_absent_groups(engine):
+    labels = np.array([0, 0])
+    vals = np.array([1.0, 2.0])
+    result, _ = groupby_reduce(
+        vals, labels, func="sum", engine=engine,
+        expected_groups=np.array([0, 1]), fill_value=-999.0,
+    )
+    np.testing.assert_allclose(np.asarray(result).astype(float), [3.0, -999.0])
+
+
+def test_jax_input_array(engine):
+    import jax.numpy as jnp
+
+    labels = np.array([0, 1, 0])
+    vals = jnp.asarray([1.0, 2.0, 3.0])
+    result, _ = groupby_reduce(vals, labels, func="sum", engine="jax")
+    np.testing.assert_allclose(np.asarray(result), [4.0, 2.0])
+
+
+def test_quantile_multi_q(engine):
+    labels = np.array([0, 0, 0, 1, 1, 1])
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+    result, _ = groupby_reduce(
+        vals, labels, func="quantile", engine=engine, finalize_kwargs={"q": [0.0, 0.5, 1.0]}
+    )
+    np.testing.assert_allclose(
+        np.asarray(result), [[1.0, 4.0], [2.0, 5.0], [3.0, 6.0]]
+    )
+
+
+# --- regression tests for review findings -----------------------------------
+
+
+def test_datetime_ns_precision(engine):
+    # max of ns-resolution timestamps must be exact (no float roundtrip)
+    dt = np.array(
+        ["2000-01-01T00:00:00.123456789", "2000-01-01T00:00:00.123456456"],
+        dtype="datetime64[ns]",
+    )
+    out, _ = groupby_reduce(dt, np.array([0, 0]), func="max", engine=engine)
+    assert out[0] == dt[0]
+
+
+def test_datetime_nat_leading_dims(engine):
+    # NaT exclusion must be per-element, not collapsed across leading dims
+    dt2 = np.array(
+        [["NaT", "2000-01-02", "2000-01-03", "NaT"],
+         ["2000-01-05", "2000-01-06", "2000-01-07", "2000-01-08"]],
+        dtype="datetime64[ns]",
+    )
+    by = np.array([0, 0, 1, 1])
+    out, _ = groupby_reduce(dt2, by, func="nanmin", engine=engine)
+    expected = np.array(
+        [["2000-01-02", "2000-01-03"], ["2000-01-05", "2000-01-07"]],
+        dtype="datetime64[ns]",
+    )
+    np.testing.assert_array_equal(out, expected)
+    # non-skipna: NaT propagates
+    out, _ = groupby_reduce(dt2, by, func="min", engine=engine)
+    assert np.isnat(out[0]).all() and not np.isnat(out[1]).any()
+
+
+def test_min_count_int_input(engine):
+    # min_count on integer input must produce NaN, not a silent 0
+    r, _ = groupby_reduce(
+        np.array([1, 2, 3, 4]), np.array([0, 0, 1, 2]),
+        func="nansum", min_count=2, engine=engine,
+    )
+    np.testing.assert_allclose(np.asarray(r).astype(float), [3, np.nan, np.nan], equal_nan=True)
+
+
+def test_jit_bundle_cache_stable():
+    # NaN fills must not defeat the jit program cache
+    from flox_tpu.core import _jitted_bundle
+
+    _jitted_bundle.cache_clear()
+    for _ in range(3):
+        groupby_reduce(np.arange(6.0), np.array([0, 1, 0, 1, 0, 1]), func="mean", engine="jax")
+    info = _jitted_bundle.cache_info()
+    assert info.misses == 1 and info.hits == 2
+
+
+def test_invalid_method():
+    with pytest.raises(ValueError, match="method"):
+        groupby_reduce(np.arange(4.0), np.array([0, 1, 0, 1]), func="sum", method="bogus")
