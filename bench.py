@@ -1,0 +1,80 @@
+"""Headline benchmark: ERA5 hourly -> monthly-mean climatology on one chip.
+
+Metric (BASELINE.json): ERA5 ``groupby('time.month').mean()`` GB/s/chip.
+Baseline: the in-repo host numpy engine (``ufunc.at``/bincount — the same
+primitive family as the reference's numpy_groupies engine) on the identical
+workload. Prints ONE JSON line.
+
+Scale knobs (env):
+  FLOX_TPU_BENCH_NLAT / NLON / NTIME — workload shape (default 181x360x26304,
+  ~6.8 GB float32: 3 years of hourly steps on a 1-degree grid).
+  FLOX_TPU_BENCH_REPS — timed repetitions (default 5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from flox_tpu.kernels import generic_kernel
+
+    nlat = int(os.environ.get("FLOX_TPU_BENCH_NLAT", 181))
+    nlon = int(os.environ.get("FLOX_TPU_BENCH_NLON", 360))
+    ntime = int(os.environ.get("FLOX_TPU_BENCH_NTIME", 24 * 365 * 3))
+    reps = int(os.environ.get("FLOX_TPU_BENCH_REPS", 5))
+
+    # month-of-year labels for 3 years of hourly stamps (12 groups)
+    hours = np.arange(ntime, dtype=np.int64)
+    day = hours // 24
+    month = ((day % 365) // 30.44).astype(np.int32) % 12
+    size = 12
+
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(nlat, nlon, ntime)).astype(np.float32)
+    nbytes = data.nbytes
+
+    # --- TPU/jax path: data + codes pre-placed on device -------------------
+    dev_data = jax.device_put(data.reshape(nlat * nlon, ntime))
+    dev_codes = jax.device_put(month)
+
+    fn = jax.jit(lambda c, v: generic_kernel("nanmean", c, v, size=size))
+    fn(dev_codes, dev_data).block_until_ready()  # compile + warm
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn(dev_codes, dev_data).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    t_dev = min(times)
+    gbps = nbytes / t_dev / 1e9
+
+    # --- host numpy baseline (one rep; same workload) -----------------------
+    from flox_tpu import engine_numpy
+
+    host_data = data.reshape(nlat * nlon, ntime)
+    t0 = time.perf_counter()
+    engine_numpy.generic_kernel("nanmean", month, host_data, size=size)
+    t_host = time.perf_counter() - t0
+    gbps_host = nbytes / t_host / 1e9
+
+    print(
+        json.dumps(
+            {
+                "metric": "ERA5 groupby(time.month).mean() GB/s/chip",
+                "value": round(gbps, 2),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / gbps_host, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

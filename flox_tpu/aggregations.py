@@ -110,6 +110,7 @@ class Aggregation:
     # resolved by _initialize_aggregation:
     finalize_kwargs: dict[str, Any] = field(default_factory=dict)
     min_count: int = 0
+    appended_count: bool = False  # a trailing nanlen was added for min_count
 
     def __post_init__(self):
         if not self.numpy:
@@ -132,12 +133,16 @@ class Aggregation:
 # --- finalize helpers -------------------------------------------------------
 
 
+def _is_jaxish(x) -> bool:
+    import jax
+
+    return isinstance(x, (jax.Array, jax.core.Tracer))
+
+
 def _mean_finalize(total, count, **kw):
     import numpy as _np
 
-    if hasattr(total, "device"):  # jax array
-        import jax.numpy as jnp
-
+    if _is_jaxish(total):
         return total / count
     with _np.errstate(invalid="ignore", divide="ignore"):
         return total / count
@@ -146,7 +151,7 @@ def _mean_finalize(total, count, **kw):
 def _var_finalize(ma: MultiArray, ddof=0, **kw):
     m2, total, count = ma.arrays
     denom = count - ddof
-    if hasattr(m2, "device"):
+    if _is_jaxish(m2):
         import jax.numpy as jnp
 
         out = m2 / jnp.where(denom > 0, denom, 1)
@@ -160,7 +165,7 @@ def _var_finalize(ma: MultiArray, ddof=0, **kw):
 
 def _std_finalize(ma: MultiArray, ddof=0, **kw):
     out = _var_finalize(ma, ddof=ddof)
-    if hasattr(out, "device"):
+    if _is_jaxish(out):
         import jax.numpy as jnp
 
         return jnp.sqrt(out)
@@ -325,8 +330,11 @@ def _initialize_aggregation(
             fill_value = dtypes.get_fill_value(final, fill_value)
     agg.final_fill_value = fill_value
 
-    # resolve intermediate fills against the working dtype
-    work_dtype = final if not agg.preserves_dtype else array_dtype
+    # resolve intermediate fills against the working dtype; argreductions'
+    # first intermediate is the extreme VALUE (array dtype), not the index
+    work_dtype = (
+        array_dtype if (agg.preserves_dtype or agg.reduction_type == "argreduce") else final
+    )
     inter = agg.fill_value.get("intermediate", ())
     agg.fill_value["intermediate"] = tuple(
         dtypes.get_fill_value(work_dtype, fv) if fv in (dtypes.NA, dtypes.INF, dtypes.NINF) else fv
@@ -339,6 +347,7 @@ def _initialize_aggregation(
         agg.chunk = tuple(agg.chunk) + ("nanlen",)
         agg.combine = tuple(agg.combine) + ("sum",)
         agg.fill_value["intermediate"] = tuple(agg.fill_value["intermediate"]) + (0,)
+        agg.appended_count = True
 
     return agg
 

@@ -244,14 +244,19 @@ def groupby_reduce(
     engine: str | None = None,
     reindex=None,
     finalize_kwargs: dict | None = None,
+    mesh=None,
+    axis_name: str = "data",
 ):
     """GroupBy reduction (parity: core.py:739-1222; same signature contract).
 
     Returns ``(result, *groups)`` where ``result`` has the reduced axes
     replaced by one axis per grouper (plus any new dims, e.g. quantile's q).
 
-    On a single device this runs the fused eager path; sharded inputs /
-    explicit ``method`` go through the mesh runtime (parallel/).
+    ``method=None`` runs the fused eager path on one device. Passing
+    ``method`` ("map-reduce" | "cohorts" | "blockwise") runs the reduction
+    as one SPMD program over ``mesh`` (default: a 1-D mesh over all
+    devices), sharding the reduced axis and combining with collectives —
+    the TPU analogue of the reference's dask execution methods (core.py:89).
     """
     if not by:
         raise TypeError("Must pass at least one `by`")
@@ -355,15 +360,36 @@ def groupby_reduce(
     arr_flat = arr.reshape(lead_shape + (span,))
     codes_flat = np.asarray(codes).reshape(-1)
 
-    # -- eager reduction ---------------------------------------------------
-    result = _reduce_blockwise(
-        arr_flat,
-        codes_flat,
-        agg,
-        size=size,
-        engine=engine,
-        datetime_dtype=datetime_dtype,
-    )
+    if method is not None:
+        # -- sharded SPMD reduction over the mesh ---------------------------
+        if datetime_dtype is not None and not utils.x64_enabled():
+            raise ValueError(
+                "datetime inputs on the mesh path need jax_enable_x64 "
+                "(int64 timestamps cannot survive the int32 downcast)."
+            )
+        from .parallel.mapreduce import sharded_groupby_reduce
+
+        result = sharded_groupby_reduce(
+            arr_flat,
+            codes_flat,
+            agg,
+            size=size,
+            mesh=mesh,
+            axis_name=axis_name,
+            method=method,
+            nat=datetime_dtype is not None,
+        )
+        result = _astype_final(result, agg, datetime_dtype)
+    else:
+        # -- eager single-device reduction ---------------------------------
+        result = _reduce_blockwise(
+            arr_flat,
+            codes_flat,
+            agg,
+            size=size,
+            engine=engine,
+            datetime_dtype=datetime_dtype,
+        )
 
     # -- reshape: (..., size) -> (..., *keep_by, *grp_shape) ---------------
     out_shape = lead_shape + keep_by_shape + grp_shape

@@ -1,0 +1,28 @@
+"""Distributed execution over a TPU mesh (L5).
+
+This package is the TPU-native replacement for the reference's chunked-array
+backends (/root/reference/flox/dask.py, cubed.py, dask_array_ops.py): instead
+of building lazy task graphs whose combine is concatenate-then-reduce, the
+whole map-reduce is ONE jitted SPMD program — ``shard_map`` over a
+``jax.sharding.Mesh``, with XLA collectives as the combine:
+
+=====================  ==========================================
+reference (dask)        flox_tpu (mesh)
+=====================  ==========================================
+blockwise chunk_reduce  shard-local chunk_reduce inside shard_map
+``_simple_combine``     ``lax.psum`` / ``pmax`` / ``pmin``
+``_grouped_combine``    all_gather + static fold (small tails)
+cohorts graph surgery   ``lax.psum_scatter`` group ownership
+Blelloch scan binop     per-shard carries exchanged via all_gather
+=====================  ==========================================
+
+Dense, shape-static intermediates over ``expected_groups`` (the reference's
+``reindex=True``) are load-bearing here: they are what make every shard's
+contribution identical in shape, which is exactly what collectives need.
+"""
+
+from .mesh import make_mesh
+from .mapreduce import sharded_groupby_reduce
+from .scan import sharded_groupby_scan
+
+__all__ = ["make_mesh", "sharded_groupby_reduce", "sharded_groupby_scan"]

@@ -1,0 +1,521 @@
+"""Sharded groupby reductions: one SPMD program per aggregation (L5).
+
+The reference's three dask execution methods (core.py:89, dask.py:325-573)
+map onto mesh programs as follows:
+
+* ``map-reduce``: shard-local ``chunk_reduce`` producing dense (size,)
+  intermediates, then XLA collectives as the tree combine — ``psum`` for
+  additive intermediates (the reference's ``_simple_combine``,
+  dask.py:90-144), ``pmax``/``pmin`` for extrema, a two-phase psum for the
+  variance triple (the collective form of the Chan merge the reference does
+  pairwise in ``_var_combine``, aggregations.py:392-451), and
+  all_gather+fold for order-dependent tails (first/last/prod — the
+  reference's ``_grouped_combine`` cases, dask.py:233-317).
+* ``cohorts``: ``psum_scatter`` distributes *group ownership* — each device
+  combines and finalizes ``size/ndev`` groups, then the result is
+  all-gathered. Communication drops from O(size × ndev) to O(size), the
+  same economics that motivate the reference's cohort graph surgery
+  (cohorts.py:109-301) — but as a single collective, not N subgraphs.
+* ``blockwise``: no combine at all — valid when each group's members are
+  entirely within one shard (after rechunk.reshard_for_blockwise); each shard
+  finalizes its own groups and owners are selected by nonzero counts
+  (parity: dask.py:520-541). This is also how order statistics
+  (median/quantile/mode) run on a mesh, since they need whole groups.
+
+Everything here is traced under one ``jax.jit``: factorized codes go in,
+the finalized dense result comes out, and XLA overlaps the per-shard
+reduction with the collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import numpy as np
+
+from .. import utils
+from ..aggregations import Aggregation
+from ..multiarray import MultiArray
+from .mesh import make_mesh
+
+_BIG = np.iinfo(np.int32).max
+
+
+# ---------------------------------------------------------------------------
+# local building blocks (traced inside shard_map)
+# ---------------------------------------------------------------------------
+
+
+def _local_chunk(agg: Aggregation, codes_sh, arr_sh, size: int, nat: bool):
+    """Run the agg's chunk kernels on this shard -> list of intermediates."""
+    from ..kernels import generic_kernel
+
+    inters = []
+    fills = agg.fill_value.get("intermediate", ())
+    for entry, fv in zip(agg.chunk, list(fills) + [None] * len(agg.chunk)):
+        if isinstance(entry, tuple):
+            name, extra = entry[0], dict(entry[1])
+        else:
+            name, extra = entry, {}
+        if nat:
+            extra["nat"] = True
+        extra.update(agg.finalize_kwargs if name.startswith("var_chunk") else {})
+        inters.append(
+            generic_kernel(name, codes_sh, arr_sh, size=size, fill_value=fv, **extra)
+        )
+    return inters
+
+
+def _local_counts(codes_sh, arr_sh, size: int, skipna: bool, nat: bool):
+    from ..kernels import generic_kernel
+
+    func = "nanlen" if skipna else "len"
+    kw = {"nat": True} if nat else {}
+    return generic_kernel(func, codes_sh, arr_sh, size=size, **kw)
+
+
+def _local_firstlast(codes_sh, arr_sh, size: int, *, skipna: bool, last: bool, nat: bool, offset):
+    """(value, global position) per group for the first/last combine."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels import _from_leading, _iota_like, _nan_mask, _safe_codes, _seg, _to_leading
+
+    codes = _safe_codes(codes_sh, size)
+    data = _to_leading(arr_sh)
+    mask = _nan_mask(data, nat) if skipna else None
+    iota = _iota_like(data) + offset  # global positions
+    if mask is not None:
+        iota = jnp.where(mask, iota, -1 if last else _BIG)
+    pos = _seg("max" if last else "min", iota, codes, size)
+    ok = (pos >= 0) & (pos < _BIG)
+    local_idx = jnp.clip(pos - offset, 0, data.shape[0] - 1)
+    val = jnp.take_along_axis(data, local_idx, axis=0)
+    # positions from other shards will be resolved by the combine; mark
+    # invalid local picks so they lose
+    pos = jnp.where(ok, pos, -1 if last else _BIG)
+    return _from_leading(val), _from_leading(pos)
+
+
+# ---------------------------------------------------------------------------
+# combines (collectives)
+# ---------------------------------------------------------------------------
+
+
+def _combine_simple(op: str, x, axis_name: str, nat: bool = False):
+    import jax
+    import jax.numpy as jnp
+
+    if op == "sum":
+        return jax.lax.psum(x, axis_name)
+    if op in ("max", "min"):
+        out = jax.lax.pmax(x, axis_name) if op == "max" else jax.lax.pmin(x, axis_name)
+        # XLA's all-reduce max/min DROPS NaN; numpy's min/max propagate it.
+        # Re-inject the missing marker where any shard's intermediate had it
+        # (NaN for floats, INT64_MIN==NaT for datetime views).
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            has_nan = jax.lax.psum(jnp.isnan(x).astype(jnp.int32), axis_name) > 0
+            out = jnp.where(has_nan, jnp.asarray(jnp.nan, out.dtype), out)
+        elif nat and jnp.issubdtype(x.dtype, jnp.signedinteger):
+            marker = jnp.asarray(np.iinfo(np.int64).min, dtype=x.dtype)
+            has_nat = jax.lax.psum((x == marker).astype(jnp.int32), axis_name) > 0
+            out = jnp.where(has_nat, marker, out)
+        return out
+    if op == "prod":
+        gathered = jax.lax.all_gather(x, axis_name)  # (ndev, size, ...)
+        return gathered.prod(axis=0)
+    raise ValueError(f"Unknown combine op {op!r}")
+
+
+def _combine_var(ma: MultiArray, axis_name: str):
+    """Collective Chan merge: two psums instead of pairwise host folds."""
+    import jax
+    import jax.numpy as jnp
+
+    m2, total, n = ma.arrays
+    big_n = jax.lax.psum(n, axis_name)
+    big_t = jax.lax.psum(total, axis_name)
+    mu = big_t / jnp.where(big_n > 0, big_n, 1)
+    mu_d = total / jnp.where(n > 0, n, 1)
+    adj = n * (mu_d - mu) ** 2
+    big_m2 = jax.lax.psum(m2 + adj, axis_name)
+    return MultiArray((big_m2, big_t, big_n))
+
+
+def _combine_arg(val, idx, axis_name: str, arg_of_max: bool, nat: bool = False):
+    import jax
+    import jax.numpy as jnp
+
+    gv = _combine_simple("max" if arg_of_max else "min", val, axis_name, nat=nat)
+    hit = val == gv
+    if jnp.issubdtype(val.dtype, jnp.floating):
+        # NaN-propagating argreductions: the winning value may be NaN, and
+        # NaN != NaN — shards whose extreme is NaN must still contend
+        hit = hit | (jnp.isnan(val) & jnp.isnan(gv))
+    cand = jnp.where(hit & (idx >= 0), idx, _BIG)
+    gidx = jax.lax.pmin(cand, axis_name)
+    return gv, jnp.where(gidx < _BIG, gidx, -1)
+
+
+def _combine_firstlast(val, pos, axis_name: str, last: bool):
+    import jax
+    import jax.numpy as jnp
+
+    vals = jax.lax.all_gather(val, axis_name)  # (ndev, ..., size)
+    poss = jax.lax.all_gather(pos, axis_name)
+    pick = jnp.argmax(poss, axis=0) if last else jnp.argmin(poss, axis=0)
+    val_g = jnp.take_along_axis(vals, pick[None], axis=0)[0]
+    pos_g = jnp.take_along_axis(poss, pick[None], axis=0)[0]
+    ok = (pos_g >= 0) & (pos_g < _BIG)
+    return val_g, ok
+
+
+# ---------------------------------------------------------------------------
+# the SPMD program
+# ---------------------------------------------------------------------------
+
+
+def _pad_to(n: int, multiple: int) -> int:
+    return (-n) % multiple
+
+
+@functools.lru_cache(maxsize=256)
+def _cached_mesh_default():
+    return make_mesh()
+
+
+def sharded_groupby_reduce(
+    array,
+    codes,
+    agg: Aggregation,
+    *,
+    size: int,
+    mesh=None,
+    axis_name: str = "data",
+    method: str = "map-reduce",
+    nat: bool = False,
+):
+    """Run one grouped reduction as a sharded SPMD program.
+
+    ``array``: (..., N) (host or device), sharded over the trailing axis;
+    ``codes``: (N,) int64 with -1 = missing. Returns the finalized dense
+    result, replicated: shape (*new_dims, ..., size).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        mesh = _cached_mesh_default()
+    ndev = mesh.devices.size
+
+    if agg.blockwise_only and method != "blockwise":
+        raise NotImplementedError(
+            f"{agg.name!r} needs whole groups on one shard; use method='blockwise' "
+            "with shard-local groups (rechunk.reshard_for_blockwise prepares that "
+            "layout — the reference forces blockwise for these too, core.py:685-709)."
+        )
+
+    if agg.appended_count:
+        # the mesh programs compute counts themselves; the appended nanlen
+        # would otherwise leak into agg.finalize as a stray positional arg
+        import copy as _copy
+
+        agg = _copy.deepcopy(agg)
+        agg.chunk = agg.chunk[:-1]
+        agg.combine = agg.combine[:-1]
+        agg.fill_value["intermediate"] = agg.fill_value["intermediate"][:-1]
+        agg.appended_count = False
+
+    if nat:
+        # the NINF-resolved empty-shard fill (iinfo.min) is byte-identical to
+        # the NaT marker; shift it so absent-on-shard groups are not mistaken
+        # for NaT-containing ones by the combine's marker re-injection
+        _nat = np.iinfo(np.int64).min
+        agg.fill_value["intermediate"] = tuple(
+            (fv + 1 if isinstance(fv, (int, np.integer)) and fv == _nat else fv)
+            for fv in agg.fill_value.get("intermediate", ())
+        )
+
+    arr = utils.asarray_device(array)
+    codes_dev = jnp.asarray(np.asarray(codes), dtype=jnp.int32)
+    n = codes_dev.shape[0]
+    pad = _pad_to(n, ndev)
+    if pad:
+        codes_dev = jnp.concatenate([codes_dev, jnp.full((pad,), -1, dtype=jnp.int32)])
+        widths = [(0, 0)] * (arr.ndim - 1) + [(0, pad)]
+        arr = jnp.pad(arr, widths)
+    shard_len = codes_dev.shape[0] // ndev
+
+    # pad the group axis for psum_scatter ownership slicing
+    size_pad = size + _pad_to(n=size, multiple=ndev) if method == "cohorts" else size
+
+    in_specs = (
+        P(*([None] * (arr.ndim - 1) + [axis_name])),
+        P(axis_name),
+    )
+    out_specs = P()  # replicated
+
+    cache_key = (
+        _agg_cache_key(agg), size, size_pad, method, axis_name, shard_len, nat,
+        mesh, arr.ndim,
+    )
+    fn = _PROGRAM_CACHE.get(cache_key)
+    if fn is None:
+        program = _build_program(
+            agg, size=size, size_pad=size_pad, method=method, axis_name=axis_name,
+            shard_len=shard_len, nat=nat,
+        )
+        # check_vma=False: outputs are replicated by construction (psum /
+        # all_gather), but the static checker cannot infer that through
+        # argmin/take_along_axis owner-selection.
+        fn = jax.jit(
+            jax.shard_map(
+                program, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+            )
+        )
+        if len(_PROGRAM_CACHE) > 256:
+            _PROGRAM_CACHE.clear()
+        _PROGRAM_CACHE[cache_key] = fn
+    return fn(arr, codes_dev)
+
+
+_PROGRAM_CACHE: dict = {}
+
+
+def _agg_cache_key(agg: Aggregation):
+    """Hashable identity of a resolved Aggregation for the program cache.
+    Registry-derived aggs with equal keys trace identical programs."""
+
+    def h(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(h(x) for x in v)
+        if isinstance(v, float) and np.isnan(v):
+            return "__nan__"
+        if isinstance(v, dict):
+            return tuple(sorted((k, h(x)) for k, x in v.items()))
+        if callable(v):
+            return getattr(v, "__qualname__", repr(v))
+        return repr(v) if isinstance(v, np.generic) else v
+
+    return (
+        agg.name,
+        h(agg.chunk),
+        h(agg.combine),
+        h(agg.numpy),
+        h(agg.fill_value.get("intermediate", ())),
+        h(agg.final_fill_value),
+        str(agg.final_dtype),
+        h(agg.finalize_kwargs),
+        agg.min_count,
+        agg.reduction_type,
+    )
+
+
+def _apply_final_fill(result, counts, agg: Aggregation):
+    """Mask groups below the contribution threshold with the final fill.
+
+    Shared by every mesh program (map-reduce/cohorts finalize AND blockwise)
+    so the promotion rules cannot drift apart.
+    """
+    import jax.numpy as jnp
+
+    final_fill = agg.final_fill_value
+    if isinstance(final_fill, str):
+        raise TypeError("string fill values are not supported on device")
+    threshold = max(agg.min_count, 1)
+    empty = counts < threshold
+    empty_b = jnp.broadcast_to(
+        empty.reshape(empty.shape + (1,) * (result.ndim - empty.ndim))
+        if empty.ndim < result.ndim
+        else empty,
+        result.shape,
+    )
+    # host-side NaN check: under shard_map tracing even constants are tracers
+    try:
+        fill_is_nan = bool(np.isnan(final_fill))
+    except (TypeError, ValueError):
+        fill_is_nan = False
+    fv = jnp.asarray(final_fill)
+    if jnp.issubdtype(fv.dtype, jnp.floating) and not jnp.issubdtype(
+        result.dtype, jnp.floating
+    ):
+        if not fill_is_nan:
+            fv = fv.astype(result.dtype)  # identity fills stay integral
+        else:
+            result = result.astype(jnp.float64 if utils.x64_enabled() else jnp.float32)
+    return jnp.where(empty_b, fv.astype(result.dtype), result)
+
+
+def _build_program(agg, *, size, size_pad, method, axis_name, shard_len, nat):
+    import jax
+    import jax.numpy as jnp
+
+    skipna = agg.name.startswith("nan") or agg.name == "count"
+    # min_count thresholds count non-NaN contributions (the reference appends
+    # nanlen regardless of skipna, aggregations.py:1005-1014)
+    count_skipna = skipna or agg.min_count > 0
+
+    def finalize(combined, counts):
+        if agg.reduction_type == "argreduce":
+            result = combined[1]
+        elif agg.finalize is not None:
+            result = agg.finalize(*combined, **agg.finalize_kwargs)
+        else:
+            result = combined[0]
+        return _apply_final_fill(result, counts, agg)
+
+    def mapreduce_program(arr_sh, codes_sh):
+        counts_local = _local_counts(codes_sh, arr_sh, size, count_skipna, nat)
+        counts = jax.lax.psum(counts_local, axis_name)
+
+        if agg.reduction_type == "argreduce":
+            val_f, arg_f = agg.chunk  # e.g. ("max", "argmax")
+            from ..kernels import generic_kernel
+
+            kw = {"nat": True} if nat else {}
+            val = generic_kernel(
+                val_f, codes_sh, arr_sh, size=size,
+                fill_value=agg.fill_value["intermediate"][0], **kw,
+            )
+            local_arg = generic_kernel(arg_f, codes_sh, arr_sh, size=size, fill_value=-1, **kw)
+            offset = jax.lax.axis_index(axis_name).astype(jnp.int64 if utils.x64_enabled() else jnp.int32) * shard_len
+            gidx = jnp.where(local_arg >= 0, local_arg + offset, -1)
+            gv, garg = _combine_arg(
+                val, gidx, axis_name, arg_of_max="max" in agg.chunk[1],
+                nat=nat and not skipna,
+            )
+            return finalize((gv, garg), counts)
+
+        if agg.combine == ("first",) or agg.combine == ("last",):
+            last = agg.combine == ("last",)
+            offset = jax.lax.axis_index(axis_name).astype(jnp.int32) * shard_len
+            val, pos = _local_firstlast(
+                codes_sh, arr_sh, size, skipna=skipna, last=last, nat=nat, offset=offset
+            )
+            val_g, ok = _combine_firstlast(val, pos, axis_name, last)
+            return finalize((val_g,), counts)
+
+        inters = _local_chunk(agg, codes_sh, arr_sh, size, nat)
+        combined = []
+        for inter, op in zip(inters, agg.combine):
+            if op == "var":
+                combined.append(_combine_var(inter, axis_name))
+            else:
+                # marker re-injection only for propagating (non-skipna) aggs:
+                # skipna identity fills (iinfo.min for int nanmax) would
+                # otherwise be mistaken for NaT
+                combined.append(
+                    _combine_simple(op, inter, axis_name, nat=nat and not skipna)
+                )
+        return finalize(combined, counts)
+
+    def cohorts_program(arr_sh, codes_sh):
+        # psum_scatter needs every intermediate to be additive; route others
+        # through map-reduce (matching how the reference falls back to
+        # map-reduce when cohort detection finds nothing to exploit)
+        if agg.reduction_type == "argreduce" or not all(
+            op in ("sum", "var") for op in (agg.combine or ())
+        ):
+            return mapreduce_program(arr_sh, codes_sh)
+
+        from ..kernels import generic_kernel
+
+        def pad_groups(x):
+            if size_pad == size:
+                return x
+            widths = [(0, 0)] * (x.ndim - 1) + [(0, size_pad - size)]
+            return jnp.pad(x, widths)
+
+        counts_local = pad_groups(_local_counts(codes_sh, arr_sh, size, count_skipna, nat))
+        counts_own = jax.lax.psum_scatter(
+            jnp.moveaxis(counts_local, -1, 0), axis_name, scatter_dimension=0, tiled=True
+        )
+        counts_own = jnp.moveaxis(counts_own, 0, -1)
+
+        inters = _local_chunk(agg, codes_sh, arr_sh, size, nat)
+        owned = []
+        for inter, op in zip(inters, agg.combine):
+            if op == "var":
+                # scatter each leaf; the Chan adjustment needs the scattered
+                # totals, so do it leaf-wise after scattering sums
+                m2, total, nn = inter.arrays
+                mu_d = total / jnp.where(nn > 0, nn, 1)
+                big_t = _pscatter(pad_groups(total), axis_name)
+                big_n = _pscatter(pad_groups(nn), axis_name)
+                # mu over owned slice must be compared against each shard's
+                # mu_d — requires the adjustment before scattering:
+                # psum_scatter(m2 + n*(mu_d - mu)^2) with mu broadcast back.
+                mu = big_t / jnp.where(big_n > 0, big_n, 1)
+                mu_full = _unscatter_broadcast(mu, axis_name)
+                adj = nn * (mu_d - _crop(mu_full, nn.shape[-1])) ** 2
+                big_m2 = _pscatter(pad_groups(m2 + adj), axis_name)
+                owned.append(MultiArray((big_m2, big_t, big_n)))
+            else:
+                owned.append(_pscatter(pad_groups(inter), axis_name))
+
+        result_own = finalize(owned, counts_own)
+        # replicate: gather the owned slices back into the full group axis
+        full = jax.lax.all_gather(jnp.moveaxis(result_own, -1, 0), axis_name, tiled=True)
+        return _crop(jnp.moveaxis(full, 0, -1), size)
+
+    def blockwise_program(arr_sh, codes_sh):
+        from ..kernels import generic_kernel
+
+        counts_local = _local_counts(codes_sh, arr_sh, size, count_skipna, nat)
+        kw = dict(agg.finalize_kwargs)
+        if nat:
+            kw["nat"] = True
+        locals_ = [
+            generic_kernel(f, codes_sh, arr_sh, size=size, fill_value=None, **kw)
+            for f in agg.numpy
+        ]
+        result_local = locals_[1] if agg.reduction_type == "argreduce" and len(locals_) > 1 else locals_[0]
+        if agg.reduction_type == "argreduce":
+            offset = jax.lax.axis_index(axis_name).astype(jnp.int32) * shard_len
+            result_local = jnp.where(result_local >= 0, result_local + offset, -1)
+        # owner = the shard that saw this group's elements (precondition:
+        # exactly one, after reshard_for_blockwise)
+        counts_all = jax.lax.all_gather(counts_local, axis_name)  # (ndev, ..., size)
+        res_all = jax.lax.all_gather(result_local, axis_name)  # (ndev, *new, ..., size)
+        owner = jnp.argmax(counts_all > 0, axis=0)  # (..., size)
+        extra = res_all.ndim - 1 - owner.ndim  # new dims (e.g. quantile's q)
+        pick = jnp.broadcast_to(
+            owner.reshape((1,) * extra + owner.shape), res_all.shape[1:]
+        )
+        result = jnp.take_along_axis(res_all, pick[None], axis=0)[0]
+        counts = jax.lax.psum(counts_local, axis_name)
+        return _apply_final_fill(result, counts, agg)
+
+    if method == "map-reduce":
+        return mapreduce_program
+    if method == "cohorts":
+        return cohorts_program
+    if method == "blockwise":
+        return blockwise_program
+    raise ValueError(f"Unknown method {method!r}")
+
+
+def _pscatter(x, axis_name):
+    """psum_scatter over the trailing (group) axis; returns the owned slice."""
+    import jax
+    import jax.numpy as jnp
+
+    moved = jnp.moveaxis(x, -1, 0)
+    out = jax.lax.psum_scatter(moved, axis_name, scatter_dimension=0, tiled=True)
+    return jnp.moveaxis(out, 0, -1)
+
+
+def _unscatter_broadcast(x_own, axis_name):
+    """all_gather an owned slice back to the full (padded) group axis."""
+    import jax
+    import jax.numpy as jnp
+
+    moved = jnp.moveaxis(x_own, -1, 0)
+    full = jax.lax.all_gather(moved, axis_name, tiled=True)
+    return jnp.moveaxis(full, 0, -1)
+
+
+def _crop(x, size):
+    return x[..., :size]

@@ -1,0 +1,25 @@
+"""Mesh construction helpers.
+
+The reference's analogue of "pick a scheduler" (dask cluster / cubed spec) is
+picking a device mesh. One logical axis is enough for groupby map-reduce —
+the reduced axis is sharded over it; ICI carries the combine collectives.
+Multi-host meshes work unchanged: jax.devices() spans hosts under
+jax.distributed, and the same psum rides ICI within a host and DCN across.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = "data"):
+    """A 1-D mesh over the first ``n_devices`` devices (default: all)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(f"Requested {n_devices} devices; only {len(devices)} available.")
+    return Mesh(np.asarray(devices[:n_devices]), (axis_name,))

@@ -1,0 +1,160 @@
+"""Sharded grouped scans: the mesh Blelloch scan (L5).
+
+Parity target: the reference's dask scan pipeline (dask.py:576-663) —
+``cumreduction(method="blelloch")`` with ``chunk_scan`` / ``grouped_reduce``
+/ ``scan_binary_op`` (scan.py:318-352, aggregations.py:792-846).
+
+Mesh realization, one jitted SPMD program:
+
+1. each shard runs the segmented within-shard scan (the same
+   ``associative_scan`` kernel as the eager path);
+2. each shard computes its per-group block summary (sum of the block for
+   cumsum; last valid value for ffill) — the Blelloch "preop";
+3. carries are exchanged with ONE ``all_gather`` (ndev × size values) and
+   each shard folds its exclusive prefix — the cross-shard "binop". For
+   cumsum that fold is a select-then-sum over the gathered (ndev, size)
+   block summaries; for ffill it picks the nearest preceding shard with a
+   valid value;
+4. the carry is gathered back per element through the group codes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import utils
+from ..aggregations import Scan
+from .mesh import make_mesh
+from .mapreduce import _cached_mesh_default, _pad_to
+
+_SCAN_CACHE: dict = {}
+
+
+def sharded_groupby_scan(
+    array,
+    codes,
+    scan: Scan,
+    *,
+    size: int,
+    mesh=None,
+    axis_name: str = "data",
+    dtype=None,
+):
+    """Sharded grouped scan over the trailing axis. Returns same shape as
+    ``array`` (padded positions stripped)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    if mesh is None:
+        mesh = _cached_mesh_default()
+    ndev = mesh.devices.size
+
+    arr = utils.asarray_device(array)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    codes_dev = jnp.asarray(np.asarray(codes), dtype=jnp.int32)
+    n = codes_dev.shape[0]
+    pad = _pad_to(n, ndev)
+    if pad:
+        codes_dev = jnp.concatenate([codes_dev, jnp.full((pad,), -1, dtype=jnp.int32)])
+        widths = [(0, 0)] * (arr.ndim - 1) + [(0, pad)]
+        arr = jnp.pad(arr, widths)
+
+    in_specs = (P(*([None] * (arr.ndim - 1) + [axis_name])), P(axis_name))
+    out_specs = P(*([None] * (arr.ndim - 1) + [axis_name]))
+
+    cache_key = (scan.name, size, axis_name, mesh, arr.ndim, str(arr.dtype))
+    fn = _SCAN_CACHE.get(cache_key)
+    if fn is None:
+        program = _build_scan_program(scan, size=size, axis_name=axis_name)
+        fn = jax.jit(
+            jax.shard_map(program, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        )
+        if len(_SCAN_CACHE) > 256:
+            _SCAN_CACHE.clear()
+        _SCAN_CACHE[cache_key] = fn
+    out = fn(arr, codes_dev)
+    if pad:
+        out = out[..., :n]
+    return out
+
+
+def _build_scan_program(scan: Scan, *, size, axis_name):
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels import generic_kernel
+
+    def program(arr_sh, codes_sh):
+        # 1. within-shard segmented scan
+        local = generic_kernel(scan.scan, codes_sh, arr_sh, size=size)
+
+        if scan.mode == "apply_binary_op":
+            # 2. block summary: per-group sum of this shard
+            block = generic_kernel(
+                scan.reduction, codes_sh, arr_sh, size=size, fill_value=0
+            )
+            block = block.astype(local.dtype)
+            # 3. exclusive prefix across shards: gather (ndev, ..., size) and
+            # fold devices strictly before mine. A select-then-sum, not a
+            # masked multiply: NaN blocks (cumsum propagation) would poison
+            # every carry through NaN * 0.
+            gathered = jax.lax.all_gather(block, axis_name)  # (ndev, ..., size)
+            ndev = gathered.shape[0]
+            me = jax.lax.axis_index(axis_name)
+            mask = (jnp.arange(ndev) < me).reshape((ndev,) + (1,) * (gathered.ndim - 1))
+            carry = jnp.sum(
+                jnp.where(mask, gathered, jnp.zeros((), gathered.dtype)), axis=0
+            )  # (..., size)
+            # 4. add the carry through the codes
+            safe = jnp.where(codes_sh < 0, size, codes_sh)
+            carry_pad = jnp.concatenate(
+                [carry, jnp.zeros(carry.shape[:-1] + (1,), carry.dtype)], axis=-1
+            )
+            per_elem = jnp.take(carry_pad, safe, axis=-1)
+            return local + per_elem
+
+        # ffill/bfill: carry = last (first) valid value per group in shards
+        # strictly before (after) me
+        reverse = scan.name == "bfill"
+        is_float = jnp.issubdtype(arr_sh.dtype, jnp.floating)
+        valid_f = generic_kernel(
+            "nanlen", codes_sh, arr_sh, size=size
+        )  # per-group valid counts this shard
+        last_val = generic_kernel(
+            "nanlast" if not reverse else "nanfirst",
+            codes_sh,
+            arr_sh,
+            size=size,
+            fill_value=jnp.nan if is_float else 0,
+        )
+        g_vals = jax.lax.all_gather(last_val, axis_name)  # (ndev, ..., size)
+        g_valid = jax.lax.all_gather(valid_f > 0, axis_name)
+        ndev = g_vals.shape[0]
+        me = jax.lax.axis_index(axis_name)
+        before = (jnp.arange(ndev) < me) if not reverse else (jnp.arange(ndev) > me)
+        before = before.reshape((ndev,) + (1,) * (g_vals.ndim - 1))
+        eligible = g_valid & before
+        # index of the closest eligible shard (max index for ffill, min for bfill)
+        dev_idx = jnp.arange(ndev).reshape((ndev,) + (1,) * (g_vals.ndim - 1))
+        if not reverse:
+            pick = jnp.max(jnp.where(eligible, dev_idx, -1), axis=0)
+        else:
+            pick = jnp.min(jnp.where(eligible, dev_idx, ndev), axis=0)
+        has_carry = (pick >= 0) & (pick < ndev)
+        pick_c = jnp.clip(pick, 0, ndev - 1)
+        carry = jnp.take_along_axis(g_vals, pick_c[None], axis=0)[0]
+        # apply: positions still missing after the local fill take the carry
+        safe = jnp.where(codes_sh < 0, size, codes_sh)
+
+        def gather_groups(x):
+            pad = jnp.zeros(x.shape[:-1] + (1,), x.dtype)
+            return jnp.take(jnp.concatenate([x, pad], axis=-1), safe, axis=-1)
+
+        carry_e = gather_groups(carry)
+        has_e = gather_groups(has_carry.astype(jnp.int8)) > 0
+        still = jnp.isnan(local) if jnp.issubdtype(local.dtype, jnp.floating) else jnp.zeros(local.shape, bool)
+        return jnp.where(still & has_e & (codes_sh >= 0), carry_e, local)
+
+    return program

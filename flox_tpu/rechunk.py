@@ -1,0 +1,130 @@
+"""Resharding helpers: lay data out so groups are shard-local (L3).
+
+Parity target: /root/reference/flox/rechunk.py — ``rechunk_for_blockwise``
+(rechunk.py:158-223, optimal chunk boundaries for sorted labels) and
+``rechunk_for_cohorts`` (rechunk.py:64-155).
+
+TPU rethink: dask chunks can have arbitrary sizes, so the reference *moves
+chunk boundaries* to group boundaries. Mesh shards are equal-sized, so the
+equivalent transformation is a **permutation + padding**: order elements by
+group, assign whole groups to shards balancing element counts, and pad each
+shard to a common length with missing labels (code -1, which every kernel
+ignores). The result feeds ``method='blockwise'`` — each group's members
+live entirely on one shard, so no collective combine is needed, and order
+statistics (median/quantile/mode) become mesh-executable.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+import numpy as np
+
+from .options import OPTIONS
+
+logger = logging.getLogger("flox_tpu")
+
+__all__ = ["reshard_for_blockwise", "BlockwiseLayout", "rechunk_for_blockwise"]
+
+
+@dataclass(frozen=True)
+class BlockwiseLayout:
+    """A shard-local-groups layout produced by :func:`reshard_for_blockwise`.
+
+    ``permutation``: host int64 array, indices into the original trailing
+    axis for each padded slot (-1 = padding).
+    ``codes``: group codes per padded slot (-1 = padding).
+    ``n_shards`` / ``shard_len``: the padded geometry.
+    """
+
+    permutation: np.ndarray
+    codes: np.ndarray
+    n_shards: int
+    shard_len: int
+
+    def apply(self, array):
+        """Gather ``array`` (..., N) into the padded blockwise layout."""
+        import jax.numpy as jnp
+
+        from . import utils
+
+        arr = utils.asarray_device(array)
+        perm = jnp.asarray(np.where(self.permutation < 0, 0, self.permutation))
+        out = jnp.take(arr, perm, axis=-1)
+        invalid = jnp.asarray(self.permutation < 0)
+        if jnp.issubdtype(out.dtype, jnp.floating):
+            out = jnp.where(invalid, jnp.nan, out)
+        return out
+
+
+def reshard_for_blockwise(codes: np.ndarray, n_shards: int) -> BlockwiseLayout:
+    """Compute a permutation that makes every group shard-local.
+
+    Greedy longest-processing-time assignment of groups to shards (balanced
+    element counts), then per-shard concatenation with padding to the max
+    shard length. The reference's analogue moves dask chunk boundaries to
+    group boundaries (rechunk.py:29-61); equal-size mesh shards need the
+    permutation form instead.
+    """
+    codes = np.asarray(codes).reshape(-1)
+    n = codes.shape[0]
+    valid = codes >= 0
+    uniq, counts = np.unique(codes[valid], return_counts=True)
+
+    # greedy LPT: biggest group to the least-loaded shard
+    order = np.argsort(counts)[::-1]
+    loads = np.zeros(n_shards, dtype=np.int64)
+    assignment = {}
+    for gi in order:
+        s = int(np.argmin(loads))
+        assignment[uniq[gi]] = s
+        loads[s] += counts[gi]
+    shard_len = int(loads.max()) if len(uniq) else 1
+
+    # build per-shard index lists (stable within group: original order kept)
+    perm = np.full((n_shards, shard_len), -1, dtype=np.int64)
+    out_codes = np.full((n_shards, shard_len), -1, dtype=np.int64)
+    cursors = np.zeros(n_shards, dtype=np.int64)
+    # iterate groups in label order for determinism
+    for g in uniq:
+        s = assignment[g]
+        idx = np.flatnonzero(codes == g)
+        c = cursors[s]
+        perm[s, c : c + idx.size] = idx
+        out_codes[s, c : c + idx.size] = g
+        cursors[s] += idx.size
+
+    logger.debug(
+        "reshard_for_blockwise: %d groups over %d shards, shard_len=%d (pad %.1f%%)",
+        len(uniq), n_shards, shard_len,
+        100.0 * (n_shards * shard_len - int(valid.sum())) / max(n_shards * shard_len, 1),
+    )
+    return BlockwiseLayout(
+        permutation=perm.reshape(-1),
+        codes=out_codes.reshape(-1),
+        n_shards=n_shards,
+        shard_len=shard_len,
+    )
+
+
+def rechunk_for_blockwise(array, axis: int, labels, n_shards: int | None = None):
+    """Convenience wrapper mirroring the reference's public name
+    (rechunk.py:158-223): returns ``(resharded_array, resharded_codes)``
+    ready for ``groupby_reduce(..., method='blockwise')``.
+
+    Auto-application thresholds (OPTIONS['rechunk_blockwise_*'], parity:
+    options.py:9-18) are the caller's concern; this always reshards.
+    """
+    import jax
+
+    from . import factorize as fct
+
+    if n_shards is None:
+        n_shards = len(jax.devices())
+    codes, groups = fct.factorize_single(np.asarray(labels), None, sort=True)
+    layout = reshard_for_blockwise(codes, n_shards)
+    import numpy as _np
+
+    arr = _np.moveaxis(_np.asarray(array), axis, -1) if axis not in (-1, np.ndim(array) - 1) else array
+    return layout.apply(arr), layout.codes, groups

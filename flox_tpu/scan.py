@@ -43,6 +43,7 @@ def groupby_scan(
     dtype=None,
     method: str | None = None,
     engine: str | None = None,
+    mesh=None,
 ):
     """Grouped scan along ``axis``; output has the same shape as ``array``.
 
@@ -54,6 +55,8 @@ def groupby_scan(
         raise TypeError("Must pass at least one `by`")
     if np.ndim(axis) != 0:
         raise ValueError("groupby_scan supports a single axis only (like the reference).")
+    if method not in (None, "blelloch", "blockwise"):
+        raise ValueError(f"scan method must be None, 'blelloch' or 'blockwise'; got {method!r}")
     engine = engine or OPTIONS["default_engine"]
     nby = len(by)
 
@@ -102,7 +105,20 @@ def groupby_scan(
     if scan.name in ("cumsum", "nancumsum") and dtype is None:
         if arr_dtype.kind in "iub":
             dtype = np.result_type(arr_dtype, np.int_)
-    out = _apply_scan(scan, arr_flat, codes_flat, engine=engine, dtype=dtype)
+    if method == "blockwise" and mesh is not None:
+        raise NotImplementedError(
+            "method='blockwise' with a mesh is not implemented for scans; "
+            "use method='blelloch' (distributed) or omit method (single device)."
+        )
+    if method == "blelloch":
+        # sharded Blelloch scan over the mesh (parallel/scan.py)
+        from .parallel.scan import sharded_groupby_scan
+
+        out = sharded_groupby_scan(
+            arr_flat, codes_flat, scan, size=size, dtype=dtype, mesh=mesh
+        )
+    else:
+        out = _apply_scan(scan, arr_flat, codes_flat, engine=engine, dtype=dtype)
 
     # missing labels scan to NaN (they belong to no group)
     if (np.asarray(codes_flat) < 0).any():

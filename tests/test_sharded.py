@@ -1,0 +1,203 @@
+"""Distributed correctness: the mesh path must equal the eager path.
+
+The reference tests distributed behavior with the synchronous dask scheduler
+(test_core.py:65); here the analogue is a virtual 8-device CPU mesh — the
+same SPMD program that runs over ICI on real chips executes across host
+devices, collectives included.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from flox_tpu.core import groupby_reduce
+from flox_tpu.scan import groupby_scan
+from flox_tpu.parallel import make_mesh
+
+RNG = np.random.default_rng(99)
+
+MESH_FUNCS = [
+    "sum", "nansum", "prod", "nanprod", "mean", "nanmean", "var", "nanvar",
+    "std", "nanstd", "max", "nanmax", "min", "nanmin", "count", "all", "any",
+    "argmax", "nanargmax", "argmin", "nanargmin",
+    "first", "last", "nanfirst", "nanlast",
+]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _data(shape, add_nan, n):
+    values = np.round(RNG.normal(size=shape), 1)
+    if add_nan:
+        values[..., RNG.random(n) < 0.25] = np.nan
+    return values
+
+
+@pytest.mark.parametrize("method", ["map-reduce", "cohorts"])
+@pytest.mark.parametrize("add_nan", [False, True])
+@pytest.mark.parametrize("func", MESH_FUNCS)
+def test_sharded_matches_eager(mesh, func, add_nan, method):
+    n, size = 111, 5  # deliberately not divisible by 8 (padding path)
+    codes = RNG.integers(0, size, n).astype(np.int64)
+    values = _data((n,), add_nan, n)
+    fkw = {"ddof": 1} if "var" in func or "std" in func else {}
+
+    eager, _ = groupby_reduce(values, codes, func=func, engine="jax", finalize_kwargs=fkw)
+    sharded, _ = groupby_reduce(
+        values, codes, func=func, method=method, mesh=mesh, finalize_kwargs=fkw
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded).astype(np.float64),
+        np.asarray(eager).astype(np.float64),
+        rtol=1e-12,
+        atol=1e-12,
+        equal_nan=True,
+    )
+
+
+@pytest.mark.parametrize("func", ["sum", "nanmean", "var", "max", "first", "nanargmax"])
+def test_sharded_2d(mesh, func):
+    n, size = 64, 4
+    codes = RNG.integers(0, size, n).astype(np.int64)
+    values = _data((3, n), True, n)
+    eager, _ = groupby_reduce(values, codes, func=func, engine="jax")
+    sharded, _ = groupby_reduce(values, codes, func=func, method="map-reduce", mesh=mesh)
+    np.testing.assert_allclose(
+        np.asarray(sharded).astype(np.float64),
+        np.asarray(eager).astype(np.float64),
+        rtol=1e-12, atol=1e-12, equal_nan=True,
+    )
+
+
+def test_sharded_expected_groups(mesh):
+    labels = np.array([1, 1, 3, 3, 5] * 10)
+    vals = np.arange(50.0)
+    sharded, groups = groupby_reduce(
+        vals, labels, func="nanmean", method="map-reduce", mesh=mesh,
+        expected_groups=np.array([1, 2, 3, 4, 5]),
+    )
+    eager, _ = groupby_reduce(
+        vals, labels, func="nanmean", expected_groups=np.array([1, 2, 3, 4, 5])
+    )
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(eager), equal_nan=True)
+
+
+def test_blockwise_shard_local_groups(mesh):
+    # groups aligned with shards: each shard owns whole groups
+    ndev = len(jax.devices())
+    per = 16
+    n = ndev * per
+    codes = np.repeat(np.arange(ndev), per).astype(np.int64)  # group d on shard d
+    values = np.round(RNG.normal(size=n), 1)
+    sharded, _ = groupby_reduce(values, codes, func="sum", method="blockwise", mesh=mesh)
+    eager, _ = groupby_reduce(values, codes, func="sum", engine="jax")
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(eager), rtol=1e-12)
+
+
+def test_blockwise_order_stats(mesh):
+    # median/quantile on the mesh via blockwise (whole groups per shard)
+    ndev = len(jax.devices())
+    per = 16
+    n = ndev * per
+    codes = np.repeat(np.arange(ndev), per).astype(np.int64)
+    values = np.round(RNG.normal(size=n), 1)
+    sharded, _ = groupby_reduce(values, codes, func="nanmedian", method="blockwise", mesh=mesh)
+    eager, _ = groupby_reduce(values, codes, func="nanmedian", engine="jax")
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(eager), rtol=1e-12, atol=1e-12)
+
+
+def test_order_stats_mapreduce_raises(mesh):
+    with pytest.raises(NotImplementedError, match="blockwise"):
+        groupby_reduce(
+            np.arange(8.0), np.array([0, 1] * 4), func="median",
+            method="map-reduce", mesh=mesh,
+        )
+
+
+def test_sharded_min_count(mesh):
+    labels = np.array([0, 0, 1] * 8)
+    vals = np.array([1.0, np.nan, np.nan] * 8)
+    sharded, _ = groupby_reduce(
+        vals, labels, func="nansum", min_count=20, method="map-reduce", mesh=mesh
+    )
+    np.testing.assert_allclose(np.asarray(sharded), [np.nan, np.nan], equal_nan=True)
+
+
+@pytest.mark.parametrize("func", ["cumsum", "nancumsum", "ffill", "bfill"])
+@pytest.mark.parametrize("add_nan", [False, True])
+def test_sharded_scan_matches_eager(mesh, func, add_nan):
+    n = 117  # non-divisible: padding path
+    codes = RNG.integers(0, 5, n).astype(np.int64)
+    values = _data((n,), add_nan, n)
+    eager = np.asarray(groupby_scan(values, codes, func=func, engine="jax"))
+    sharded = np.asarray(groupby_scan(values, codes, func=func, method="blelloch"))
+    np.testing.assert_allclose(sharded, eager, rtol=1e-12, atol=1e-12, equal_nan=True)
+
+
+def test_sharded_scan_2d(mesh):
+    n = 64
+    codes = RNG.integers(0, 4, n).astype(np.int64)
+    values = _data((3, n), True, n)
+    eager = np.asarray(groupby_scan(values, codes, func="nancumsum", engine="jax"))
+    sharded = np.asarray(groupby_scan(values, codes, func="nancumsum", method="blelloch"))
+    np.testing.assert_allclose(sharded, eager, rtol=1e-12, atol=1e-12, equal_nan=True)
+
+
+def test_reshard_for_blockwise_order_stats(mesh):
+    # arbitrary (interleaved) labels -> resharded -> blockwise median works
+    from flox_tpu.rechunk import reshard_for_blockwise
+
+    n = 200
+    codes = RNG.integers(0, 7, n).astype(np.int64)
+    values = np.round(RNG.normal(size=n), 1)
+    layout = reshard_for_blockwise(codes, len(jax.devices()))
+    arr2 = np.asarray(layout.apply(values))
+    sharded, _ = groupby_reduce(
+        arr2, layout.codes, func="nanmedian", method="blockwise", mesh=mesh,
+        expected_groups=np.arange(7),
+    )
+    eager, _ = groupby_reduce(values, codes, func="nanmedian", engine="jax")
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(eager), rtol=1e-12, atol=1e-12)
+
+
+def test_sharded_min_count_with_finalize(mesh):
+    # min_count must not leak the appended count into agg.finalize (mean/var)
+    labels = np.tile(np.array([0, 0, 1]), 8)
+    vals = np.tile(np.array([1.0, 3.0, np.nan]), 8)
+    for func, fkw in [("nanmean", {}), ("nanvar", {"ddof": 1}), ("nanargmax", {})]:
+        sharded, _ = groupby_reduce(
+            vals, labels, func=func, min_count=2, method="map-reduce", mesh=mesh,
+            finalize_kwargs=fkw,
+        )
+        eager, _ = groupby_reduce(
+            vals, labels, func=func, min_count=2, engine="jax", finalize_kwargs=fkw
+        )
+        np.testing.assert_allclose(
+            np.asarray(sharded).astype(float), np.asarray(eager).astype(float),
+            equal_nan=True, err_msg=func,
+        )
+
+
+def test_sharded_datetime_minmax(mesh):
+    # empty-shard fill must not masquerade as NaT (few elements, many shards)
+    dt = np.array(["2020-01-03", "2020-01-01", "NaT", "2020-01-05"], dtype="datetime64[ns]")
+    labels = np.array([0, 0, 1, 1])
+    for func in ["max", "nanmax", "min", "nanmin"]:
+        sharded, _ = groupby_reduce(dt, labels, func=func, method="map-reduce", mesh=mesh)
+        eager, _ = groupby_reduce(dt, labels, func=func, engine="numpy")
+        np.testing.assert_array_equal(np.asarray(sharded), np.asarray(eager), err_msg=func)
+
+
+def test_sharded_program_cache(mesh):
+    from flox_tpu.parallel.mapreduce import _PROGRAM_CACHE
+
+    _PROGRAM_CACHE.clear()
+    labels = np.arange(64) % 4
+    vals = np.arange(64.0)
+    for _ in range(3):
+        groupby_reduce(vals, labels, func="nanmean", method="map-reduce", mesh=mesh)
+    assert len(_PROGRAM_CACHE) == 1
