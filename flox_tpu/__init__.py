@@ -8,6 +8,8 @@ kernels, and shard_map/collective execution strategies over a TPU mesh.
 
 from . import kernels
 from .aggregations import Aggregation, Scan, is_supported_aggregation
+from .rechunk import rechunk_for_blockwise, reshard_for_blockwise
+from .reindex import ReindexArrayType, ReindexStrategy
 from .core import groupby_reduce
 from .scan import groupby_scan
 from .dtypes import INF, NA, NINF
@@ -28,6 +30,10 @@ __all__ = [
     "groupby_scan",
     "is_supported_aggregation",
     "kernels",
+    "rechunk_for_blockwise",
+    "reshard_for_blockwise",
+    "ReindexArrayType",
+    "ReindexStrategy",
     "set_options",
 ]
 

@@ -1,0 +1,220 @@
+"""Cohort detection: the method-selection brain (L3).
+
+Parity target: /root/reference/flox/cohorts.py:109-301 —
+``find_group_cohorts`` builds a sparse boolean bitmask ``S[chunk, label]``
+(cohorts.py:34-105), walks a decision ladder (single chunk → blockwise;
+every label in one chunk → blockwise; single cohort → map-reduce; …), and
+otherwise measures *containment* ``C = S.T @ S / chunks_per_label``
+(cohorts.py:241-244) and greedily merges labels whose chunk-sets overlap
+≥ 75 % into cohorts (cohorts.py:256-290).
+
+TPU reading of the same quantities: a "chunk" is a shard of the reduced
+axis (equal slices of length ``N / n_shards``). The ladder's outcomes map to
+the three mesh programs (parallel/mapreduce.py):
+
+* ``blockwise`` — every group is shard-local already; skip the combine.
+* ``cohorts``  — labels cluster into shard-subsets; psum_scatter ownership
+  pays off because each device finalizes only its cohort's groups.
+* ``map-reduce`` — labels are spread over most shards; dense psum combine.
+
+Everything here is host-side numpy/scipy, exactly as the reference keeps its
+detection in scipy-sparse land; the result only parameterizes which SPMD
+program gets traced.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Sequence
+
+import numpy as np
+
+
+logger = logging.getLogger("flox_tpu")
+
+__all__ = ["find_group_cohorts", "chunks_from_shards"]
+
+
+def chunks_from_shards(n: int, n_shards: int) -> tuple[int, ...]:
+    """Equal-slice chunk lengths for a sharded axis (last shard may be short)."""
+    per = math.ceil(n / n_shards)
+    chunks = []
+    left = n
+    while left > 0:
+        take = min(per, left)
+        chunks.append(take)
+        left -= take
+    return tuple(chunks)
+
+
+def _label_chunk_bitmask(labels: np.ndarray, chunks: Sequence[int], nlabels: int):
+    """Sparse boolean S[chunk, label]: does chunk i contain label j?
+
+    Parity: _compute_label_chunk_bitmask (cohorts.py:34-105). The reference's
+    write-True-uniques trick becomes a per-chunk ``np.unique`` here — the
+    chunk count is small (shards), so this stays cheap.
+    """
+    import scipy.sparse
+
+    labels = np.asarray(labels).reshape(-1)
+    rows: list[np.ndarray] = []
+    cols: list[np.ndarray] = []
+    start = 0
+    for i, c in enumerate(chunks):
+        seg = labels[start : start + c]
+        start += c
+        uniq = np.unique(seg[seg >= 0])
+        rows.append(np.full(uniq.shape, i, dtype=np.int64))
+        cols.append(uniq)
+    rows_a = np.concatenate(rows) if rows else np.empty(0, dtype=np.int64)
+    cols_a = np.concatenate(cols) if cols else np.empty(0, dtype=np.int64)
+    data = np.ones(rows_a.shape, dtype=np.int64)
+    return scipy.sparse.csc_array(
+        (data, (rows_a, cols_a)), shape=(len(chunks), nlabels), dtype=np.int64
+    )
+
+
+_COHORTS_CACHE: dict = {}
+
+
+def find_group_cohorts(
+    labels,
+    chunks: Sequence[int],
+    expected_groups=None,
+    merge: bool = True,
+) -> tuple[str, dict[tuple[int, ...], list[int]]]:
+    """Detect cohorts and recommend an execution method.
+
+    Returns ``(method, chunks_cohorts)`` where ``method`` is one of
+    "blockwise" | "cohorts" | "map-reduce" and ``chunks_cohorts`` maps a
+    tuple of chunk indices to the list of labels they own (empty for
+    map-reduce, as in the reference). ``merge=False`` skips the containment
+    merge and returns one cohort per label (parity: cohorts.py merge flag).
+
+    Results are memoized on a label fingerprint — repeated reductions over
+    the same layout (e.g. one climatology per training step) skip the
+    O(nlabels²) containment analysis (parity: the reference memoizes its
+    chunk analyses through cachey, cache.py:7-9).
+
+    Decision ladder parity: cohorts.py:109-301.
+    """
+    import hashlib
+
+    labels = np.asarray(labels).reshape(-1)
+    key = (
+        hashlib.sha1(np.ascontiguousarray(labels)).hexdigest(),
+        tuple(chunks),
+        None if expected_groups is None else len(expected_groups),
+        merge,
+    )
+    hit = _COHORTS_CACHE.get(key)
+    if hit is not None:
+        return hit
+    out = _find_group_cohorts(labels, chunks, expected_groups, merge)
+    if len(_COHORTS_CACHE) > 128:
+        _COHORTS_CACHE.clear()
+    _COHORTS_CACHE[key] = out
+    return out
+
+
+def _find_group_cohorts(
+    labels: np.ndarray,
+    chunks: Sequence[int],
+    expected_groups,
+    merge: bool,
+) -> tuple[str, dict[tuple[int, ...], list[int]]]:
+    if expected_groups is None:
+        nlabels = int(labels.max()) + 1 if labels.size and labels.max() >= 0 else 0
+    else:
+        nlabels = len(expected_groups)
+    nchunks = len(chunks)
+
+    if nlabels == 0:
+        return "map-reduce", {}
+
+    # single chunk: everything is local (cohorts.py:151-152)
+    if nchunks == 1:
+        logger.debug("find_group_cohorts: single chunk -> blockwise")
+        return "blockwise", {(0,): list(range(nlabels))}
+
+    bitmask = _label_chunk_bitmask(labels, chunks, nlabels)
+    chunks_per_label = np.asarray(bitmask.sum(axis=0)).reshape(-1)
+    present = chunks_per_label > 0
+
+    # every label lives in exactly one chunk -> blockwise (cohorts.py:182-184)
+    if (chunks_per_label[present] == 1).all():
+        coo = bitmask.tocoo()
+        mapping: dict[tuple[int, ...], list[int]] = {}
+        for chunk, label in zip(coo.coords[0], coo.coords[1]):
+            mapping.setdefault((int(chunk),), []).append(int(label))
+        logger.debug("find_group_cohorts: one chunk per label -> blockwise")
+        return "blockwise", mapping
+
+    # single cohort: every label occupies every chunk (cohorts.py:187-189)
+    if (chunks_per_label[present] == nchunks).all():
+        logger.debug("find_group_cohorts: all labels in all chunks -> map-reduce")
+        return "map-reduce", {}
+
+    if not merge:
+        coo = bitmask.tocoo()
+        per_label: dict[int, set[int]] = {}
+        for chunk, label in zip(coo.coords[0], coo.coords[1]):
+            per_label.setdefault(int(label), set()).add(int(chunk))
+        raw: dict[tuple[int, ...], list[int]] = {}
+        for lab, cset in sorted(per_label.items()):
+            raw.setdefault(tuple(sorted(cset)), []).append(lab)
+        return "cohorts", raw
+
+    # containment matrix C[i, j] = |chunks(i) ∩ chunks(j)| / |chunks(i)|
+    # (cohorts.py:241-244)
+    S = bitmask.T  # (nlabels, nchunks)
+    overlap = (S @ S.T).astype(np.float64)  # (nlabels, nlabels)
+    denom = np.where(chunks_per_label > 0, chunks_per_label, 1).astype(np.float64)
+    containment = overlap.multiply(1.0 / denom[:, None]).tocsr()
+
+    # sparsity guard: highly-overlapping labels -> map-reduce (cohorts.py:220-237)
+    sparsity = containment.nnz / max(nlabels * nlabels, 1)
+    MAX_SPARSITY = 0.4
+    if sparsity > MAX_SPARSITY:
+        logger.debug(
+            "find_group_cohorts: containment sparsity %.2f > %.2f -> map-reduce",
+            sparsity, MAX_SPARSITY,
+        )
+        return "map-reduce", {}
+
+    # greedy merge of labels with containment >= 0.75 (cohorts.py:256-290)
+    THRESHOLD = 0.75
+    bcoo = bitmask.tocoo()
+    label_chunks: dict[int, set[int]] = {}
+    for chunk, label in zip(bcoo.coords[0], bcoo.coords[1]):
+        label_chunks.setdefault(int(label), set()).add(int(chunk))
+    indptr, indices, data = containment.indptr, containment.indices, containment.data
+    merged: dict[tuple[int, ...], list[int]] = {}
+    assigned = np.full(nlabels, False)
+    order = np.argsort(-chunks_per_label)  # widest labels first
+    for lab in order:
+        lab = int(lab)
+        if not present[lab] or assigned[lab]:
+            continue
+        row_cols = indices[indptr[lab] : indptr[lab + 1]]
+        row_vals = data[indptr[lab] : indptr[lab + 1]]
+        members = [
+            int(j)
+            for j, v in zip(row_cols, row_vals)
+            if v >= THRESHOLD and not assigned[j] and present[j]
+        ]
+        if lab not in members:
+            members.append(lab)
+        chunk_set: set[int] = set()
+        for m in members:
+            assigned[m] = True
+            chunk_set.update(label_chunks[m])
+        merged.setdefault(tuple(sorted(chunk_set)), []).extend(sorted(members))
+
+    ncohorts = len(merged)
+    if ncohorts == 1:
+        logger.debug("find_group_cohorts: merged into one cohort -> map-reduce")
+        return "map-reduce", {}
+    logger.debug("find_group_cohorts: %d cohorts -> cohorts", ncohorts)
+    return "cohorts", merged

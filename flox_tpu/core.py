@@ -229,6 +229,22 @@ def _hashable_fill(fv):
 # ---------------------------------------------------------------------------
 
 
+def _choose_engine(engine, array, array_is_jax: bool) -> str:
+    """Default engine choice (parity: _choose_engine, core.py:712-736).
+
+    The jit path wins for device arrays and anything sizeable; tiny host
+    arrays skip jit dispatch overhead via the numpy engine — but only when
+    both engines produce the same result dtype (x64 on), so the choice is
+    invisible to the caller.
+    """
+    if engine is not None:
+        return engine
+    if not array_is_jax and utils.x64_enabled() and np.asarray(array).size < 2048:
+        logger.debug("engine heuristic: small host array -> numpy")
+        return "numpy"
+    return OPTIONS["default_engine"]
+
+
 def groupby_reduce(
     array,
     *by,
@@ -264,13 +280,13 @@ def groupby_reduce(
         raise ValueError(
             f"method must be one of None, 'map-reduce', 'blockwise', 'cohorts'; got {method!r}"
         )
-    engine = engine or OPTIONS["default_engine"]
     nby = len(by)
 
     # -- host-side label normalization ------------------------------------
     bys = [utils.asarray_host(b) for b in by]
     bys = list(np.broadcast_arrays(*bys)) if nby > 1 else bys
     array_is_jax = utils.is_jax_array(array)
+    engine = _choose_engine(engine, array, array_is_jax)
     arr = array if array_is_jax else np.asarray(array)
     _assert_by_is_aligned(arr.shape, bys)
 
@@ -319,6 +335,20 @@ def groupby_reduce(
     )
     if ngroups == 0 or size == 0:
         raise ValueError("No groups to reduce over (empty expected_groups?)")
+
+    # -- method/engine heuristics (parity: core.py:685-736) ----------------
+    if method is None and mesh is not None:
+        # user opted into the mesh without picking a method: let cohort
+        # detection recommend one (the reference's _choose_method defers to
+        # find_group_cohorts the same way)
+        from .cohorts import chunks_from_shards, find_group_cohorts
+
+        flat = np.asarray(codes).reshape(-1)
+        method, _ = find_group_cohorts(
+            flat, chunks_from_shards(flat.shape[0], mesh.devices.size),
+            expected_groups=range(size),
+        )
+        logger.debug("groupby_reduce: auto-selected method=%s", method)
 
     # -- dtype round-trips -------------------------------------------------
     func_name = func if isinstance(func, str) else func.name

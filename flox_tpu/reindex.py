@@ -1,0 +1,98 @@
+"""Reindexing: align a per-group axis to a target index (L3).
+
+Parity target: /root/reference/flox/reindex.py — ``reindex_``
+(reindex.py:160-216), ``ReindexStrategy``/``ReindexArrayType``
+(reindex.py:23-89).
+
+On the device paths this framework *always* reduces into a dense axis over
+``expected_groups`` (static shapes are load-bearing for XLA and
+collectives), so device results never need reindexing. This host-side
+``reindex_`` serves the remaining cases: aligning host results of a
+discovery-mode reduction (expected_groups=None) to a user index, and the
+xarray adapter's coordinate alignment.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum, auto
+from typing import Any
+
+import numpy as np
+import pandas as pd
+
+from . import dtypes
+
+__all__ = ["reindex_", "ReindexStrategy", "ReindexArrayType"]
+
+
+class ReindexArrayType(Enum):
+    """Which array type holds the reindexed result (reindex.py:23-50).
+
+    The reference offers sparse.COO for enormous group spaces; that backend
+    is unavailable here, so AUTO always resolves to NUMPY (device results
+    are dense by construction).
+    """
+
+    AUTO = auto()
+    NUMPY = auto()
+    SPARSE_COO = auto()
+
+
+@dataclass(frozen=True)
+class ReindexStrategy:
+    """Whether to reindex blockwise (per shard) and into what array type
+    (reindex.py:53-89). On the mesh runtime ``blockwise=True`` is implicit:
+    each shard's intermediates are dense over expected_groups."""
+
+    blockwise: bool | None = None
+    array_type: ReindexArrayType = ReindexArrayType.AUTO
+
+    def __post_init__(self):
+        if self.array_type == ReindexArrayType.SPARSE_COO:
+            raise NotImplementedError(
+                "sparse.COO reindexing requires the 'sparse' package, which is "
+                "not available in this build."
+            )
+
+
+def reindex_(
+    array,
+    from_: pd.Index,
+    to: pd.Index,
+    *,
+    fill_value: Any = None,
+    axis: int = -1,
+    promote: bool = False,
+) -> np.ndarray:
+    """Gather ``array``'s group axis from ``from_`` order into ``to`` order.
+
+    Missing target groups are filled with ``fill_value`` (sentinels resolved
+    against the array dtype). Parity: reindex_numpy (reindex.py:92-103).
+    """
+    if not isinstance(from_, pd.Index):
+        from_ = pd.Index(from_)
+    if not isinstance(to, pd.Index):
+        to = pd.Index(to)
+    array = np.asarray(array)
+
+    idx = from_.get_indexer(to)
+    missing = idx < 0
+
+    if fill_value in (dtypes.INF, dtypes.NINF):
+        # representable without promotion (iinfo extremes for ints)
+        fill_value = dtypes.get_fill_value(array.dtype, fill_value)
+    elif fill_value is dtypes.NA or fill_value is None:
+        if missing.any() or promote:
+            promoted, na = dtypes.maybe_promote(array.dtype)
+            array = array.astype(promoted, copy=False)
+            fill_value = dtypes.get_fill_value(promoted, dtypes.NA)
+        else:
+            fill_value = 0  # unused
+    out = np.take(array, np.where(missing, 0, idx), axis=axis)
+    if missing.any():
+        shape = [1] * out.ndim
+        shape[axis] = len(to)
+        mask = np.broadcast_to(missing.reshape(shape), out.shape)
+        out = np.where(mask, fill_value, out)
+    return out

@@ -1,0 +1,258 @@
+"""xarray adapter: ``xarray_reduce`` (L6).
+
+Parity target: /root/reference/flox/xarray.py:73-516 — named/DataArray
+groupers, dim=... semantics, skipna -> nan-func rewriting (xarray.py:369-371),
+``xr.apply_ufunc`` dispatch (416-446), coordinate/attr restoration (448-516).
+
+xarray is an optional dependency (as in the reference); every entry point
+raises a clear ImportError without it. The helpers that do not need xarray
+objects (func rewriting, dim resolution) are plain functions so they stay
+unit-testable without the package.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Hashable, Sequence
+
+import numpy as np
+import pandas as pd
+
+from .aggregations import AGGREGATIONS
+from .utils import HAS_XARRAY
+
+__all__ = ["xarray_reduce", "rechunk_for_blockwise"]
+
+
+def _require_xarray():
+    if not HAS_XARRAY:
+        raise ImportError(
+            "xarray is required for flox_tpu.xarray.xarray_reduce; install xarray "
+            "or use flox_tpu.groupby_reduce on raw arrays."
+        )
+    import xarray as xr
+
+    return xr
+
+
+def _rewrite_func_for_skipna(func: str, skipna: bool | None) -> str:
+    """skipna=True -> nan-variant; skipna=False -> plain variant
+    (parity: xarray.py:369-386)."""
+    if not isinstance(func, str) or skipna is None:
+        return func
+    has_nan_variant = f"nan{func}" in AGGREGATIONS
+    if skipna and not func.startswith("nan") and has_nan_variant:
+        return f"nan{func}"
+    if skipna is False and func.startswith("nan"):
+        return func.removeprefix("nan")
+    return func
+
+
+def _resolve_dim(dim, by_dims: tuple[Hashable, ...], obj_dims: tuple[Hashable, ...]):
+    """dim=None -> reduce over all grouper dims; dim=... -> all object dims
+    (parity: xarray.py:271-282)."""
+    if dim is None:
+        return tuple(by_dims)
+    if dim is Ellipsis:
+        return tuple(obj_dims)
+    if isinstance(dim, str):
+        return (dim,)
+    return tuple(dim)
+
+
+def xarray_reduce(
+    obj,
+    *by,
+    func: str,
+    expected_groups=None,
+    isbin: bool | Sequence[bool] = False,
+    sort: bool = True,
+    dim=None,
+    fill_value=None,
+    dtype=None,
+    method: str | None = None,
+    engine: str | None = None,
+    keep_attrs: bool = True,
+    skipna: bool | None = None,
+    min_count: int | None = None,
+    mesh=None,
+    **finalize_kwargs: Any,
+):
+    """GroupBy reduction on an xarray Dataset/DataArray.
+
+    ``by`` entries may be variable/coordinate names or DataArrays. Returns
+    an object of the same type with the reduced dims replaced by one dim per
+    grouper (named after the grouper, with the discovered/expected groups as
+    its coordinate).
+    """
+    xr = _require_xarray()
+    from .core import groupby_reduce
+
+    if not by:
+        raise TypeError("Must pass at least one `by`")
+
+    func = _rewrite_func_for_skipna(func, skipna)
+
+    if isinstance(obj, xr.Dataset):
+        # apply per-variable: variables missing the reduced dims pass through
+        # unchanged (parity: the reference's handling of mixed-dim Datasets,
+        # xarray.py:303-322)
+        by_named = [obj[b] if isinstance(b, str) else b for b in by]
+        probe_dims = tuple(dict.fromkeys(d for b in by_named for d in b.dims))
+        target_dims = _resolve_dim(dim, probe_dims, tuple(obj.dims))
+        reduced_vars = {}
+        passthrough = {}
+        for name, var in obj.data_vars.items():
+            if all(d in var.dims for d in target_dims):
+                reduced_vars[name] = xarray_reduce(
+                    var, *by_named, func=func, expected_groups=expected_groups,
+                    isbin=isbin, sort=sort, dim=dim, fill_value=fill_value,
+                    dtype=dtype, method=method, engine=engine,
+                    keep_attrs=keep_attrs, skipna=None, min_count=min_count,
+                    mesh=mesh, **finalize_kwargs,
+                )
+            else:
+                passthrough[name] = var
+        out = xr.Dataset(reduced_vars, attrs=obj.attrs if keep_attrs else None)
+        for name, var in passthrough.items():
+            out[name] = var
+        return out
+
+    # resolve groupers to DataArrays (parity: xarray.py:243-269)
+    by_das: list = []
+    for b in by:
+        if isinstance(b, str):
+            if isinstance(obj, xr.Dataset) and b in obj:
+                by_das.append(obj[b])
+            elif b in obj.coords:
+                by_das.append(obj[b])
+            else:
+                raise ValueError(f"Grouper {b!r} not found in object")
+        else:
+            by_das.append(b)
+    by_names = [getattr(b, "name", None) or f"group_{i}" for i, b in enumerate(by_das)]
+
+    grouper_dims = tuple(dict.fromkeys(d for b in by_das for d in b.dims))
+    dims = _resolve_dim(dim, grouper_dims, tuple(obj.dims))
+    bad = [d for d in dims if d not in obj.dims]
+    if bad:
+        raise ValueError(f"Cannot reduce over missing dims {bad}")
+
+    # broadcast groupers against each other (parity: xarray.py:284-301);
+    # reduced dims the labels don't span are broadcast by expand_dims
+    by_b = list(xr.broadcast(*by_das))
+    by_dims = tuple(dict.fromkeys(d for b in by_b for d in b.dims))
+    missing_dims = tuple(d for d in dims if d not in by_dims)
+    if missing_dims:
+        sizes = obj.sizes
+        by_b = [
+            b.expand_dims({d: sizes[d] for d in missing_dims if d not in b.dims})
+            for b in by_b
+        ]
+        by_b = list(xr.broadcast(*by_b))
+        by_dims = tuple(dict.fromkeys(d for b in by_b for d in b.dims))
+
+    # normalize expected groups per grouper
+    nby = len(by_b)
+    if expected_groups is None:
+        expected_t: tuple = (None,) * nby
+    elif nby == 1 and not isinstance(expected_groups, tuple):
+        expected_t = (expected_groups,)
+    else:
+        expected_t = tuple(expected_groups)
+    isbin_t = (isbin,) * nby if isinstance(isbin, bool) else tuple(isbin)
+
+    reduce_dims = tuple(d for d in by_dims if d in dims)
+    # groupby_reduce requires by to span the trailing reduced dims of the
+    # array: core dims are (kept by-dims..., reduced dims...), and every
+    # grouper is transposed to that same order
+    input_core = list(
+        dict.fromkeys(tuple(d for d in by_dims if d not in reduce_dims) + reduce_dims)
+    )
+    by_b = [b.transpose(*input_core) for b in by_b]
+
+    new_dim_names = [f"{name}_bins" if bin_ else name for name, bin_ in zip(by_names, isbin_t)]
+    keep_by_dims = [d for d in input_core if d not in reduce_dims]
+    q = finalize_kwargs.get("q") if finalize_kwargs else None
+    has_q_dim = func in ("quantile", "nanquantile") and q is not None and np.ndim(q) > 0
+    output_core = keep_by_dims + new_dim_names + (["quantile"] if has_q_dim else [])
+
+    groups_out: list = []
+
+    n_reduce = len(reduce_dims)
+
+    def wrapper(arr, *by_arrays):
+        result, *groups = groupby_reduce(
+            arr,
+            *by_arrays,
+            func=func,
+            axis=tuple(range(-n_reduce, 0)),
+            expected_groups=expected_t if any(e is not None for e in expected_t) else None,
+            isbin=isbin_t,
+            sort=sort,
+            fill_value=fill_value,
+            dtype=dtype,
+            min_count=min_count,
+            method=method,
+            engine=engine,
+            mesh=mesh,
+            finalize_kwargs=finalize_kwargs or None,
+        )
+        groups_out.clear()
+        groups_out.extend(groups)
+        result = np.asarray(result)
+        if has_q_dim:
+            # groupby_reduce puts the q dim first; apply_ufunc wants core
+            # dims last, so quantile becomes the trailing output dim
+            result = np.moveaxis(result, 0, -1)
+        return result
+
+    actual = xr.apply_ufunc(
+        wrapper,
+        obj,
+        *by_b,
+        input_core_dims=[input_core] + [input_core] * len(by_b),
+        output_core_dims=[output_core],
+        dask="forbidden",
+        keep_attrs=keep_attrs,
+        vectorize=False,
+        join="exact",
+        dataset_fill_value=np.nan,
+    )
+
+    # attach group coordinates (parity: xarray.py:448-516)
+    for name, groups in zip(new_dim_names, groups_out):
+        if isinstance(groups, pd.IntervalIndex):
+            actual = actual.assign_coords({name: groups})
+        else:
+            actual = actual.assign_coords({name: np.asarray(groups)})
+    if has_q_dim:
+        actual = actual.assign_coords({"quantile": np.asarray(q, dtype=float)})
+    return actual
+
+
+def rechunk_for_blockwise(obj, dim: str, labels, n_shards: int | None = None):
+    """xarray-level wrapper over rechunk.reshard_for_blockwise
+    (parity: xarray.py:567-612).
+
+    Returns ``(resharded DataArray, codes, groups)`` with ``dim`` replaced by
+    the padded shard-local layout (length ``n_shards * shard_len``); feed the
+    pair to ``groupby_reduce(..., method='blockwise')``.
+    """
+    xr = _require_xarray()
+    from . import rechunk as _rechunk
+
+    if isinstance(obj, xr.Dataset):
+        raise NotImplementedError(
+            "rechunk_for_blockwise takes a DataArray; reshard each variable "
+            "or use flox_tpu.rechunk.reshard_for_blockwise directly."
+        )
+    axis = obj.dims.index(dim)
+    arr, codes, groups = _rechunk.rechunk_for_blockwise(
+        obj.data, axis, np.asarray(labels), n_shards
+    )
+    new_dims = tuple(d for d in obj.dims if d != dim) + (dim,)
+    out = xr.DataArray(
+        np.asarray(arr), dims=new_dims, attrs=obj.attrs,
+        coords={d: obj.coords[d] for d in obj.coords if d != dim and d in new_dims},
+    )
+    return out, codes, groups

@@ -1,0 +1,116 @@
+"""Auxiliary subsystems: reindex, rechunk layouts, options, cache, visualize
+gating, xarray helper functions."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import flox_tpu
+from flox_tpu import dtypes
+from flox_tpu.rechunk import reshard_for_blockwise
+from flox_tpu.reindex import ReindexArrayType, ReindexStrategy, reindex_
+
+
+def test_reindex_basic():
+    arr = np.array([1.0, 2.0, 3.0])
+    out = reindex_(arr, pd.Index([10, 20, 30]), pd.Index([20, 30, 40]))
+    np.testing.assert_allclose(out, [2.0, 3.0, np.nan], equal_nan=True)
+
+
+def test_reindex_int_promotes():
+    arr = np.array([1, 2], dtype=np.int32)
+    out = reindex_(arr, pd.Index([0, 1]), pd.Index([0, 1, 2]))
+    assert out.dtype.kind == "f"
+    np.testing.assert_allclose(out, [1, 2, np.nan], equal_nan=True)
+
+
+def test_reindex_axis():
+    arr = np.arange(6.0).reshape(2, 3)
+    out = reindex_(arr, pd.Index([0, 1, 2]), pd.Index([2, 0]), axis=-1)
+    np.testing.assert_allclose(out, [[2, 0], [5, 3]])
+
+
+def test_reindex_sentinel_fill():
+    arr = np.array([5, 7], dtype=np.int64)
+    out = reindex_(arr, pd.Index([0, 1]), pd.Index([0, 1, 9]), fill_value=dtypes.NINF)
+    assert out[2] == np.iinfo(np.int64).min or np.isneginf(out[2])
+
+
+def test_reindex_strategy_sparse_unavailable():
+    with pytest.raises(NotImplementedError):
+        ReindexStrategy(blockwise=True, array_type=ReindexArrayType.SPARSE_COO)
+
+
+def test_reshard_layout_roundtrip():
+    codes = np.array([2, 0, 1, 0, 2, 1, 0, 2])
+    layout = reshard_for_blockwise(codes, 2)
+    # every group's slots live within one shard
+    for g in np.unique(codes):
+        slots = np.flatnonzero(layout.codes == g)
+        shards = slots // layout.shard_len
+        assert len(np.unique(shards)) == 1
+    # permutation covers every original element exactly once
+    used = layout.permutation[layout.permutation >= 0]
+    assert sorted(used) == list(range(len(codes)))
+
+
+def test_set_options_roundtrip():
+    from flox_tpu.options import OPTIONS
+
+    before = OPTIONS["default_engine"]
+    with flox_tpu.set_options(default_engine="numpy"):
+        assert OPTIONS["default_engine"] == "numpy"
+    assert OPTIONS["default_engine"] == before
+    with pytest.raises(ValueError):
+        flox_tpu.set_options(default_engine="bogus")
+    with pytest.raises(ValueError):
+        flox_tpu.set_options(not_an_option=1)
+
+
+def test_is_supported_aggregation():
+    assert flox_tpu.is_supported_aggregation("nanmean")
+    assert not flox_tpu.is_supported_aggregation("bogus")
+
+
+def test_xarray_helpers_no_xarray():
+    from flox_tpu.xarray import _resolve_dim, _rewrite_func_for_skipna
+
+    assert _rewrite_func_for_skipna("mean", True) == "nanmean"
+    assert _rewrite_func_for_skipna("nanmean", False) == "mean"
+    assert _rewrite_func_for_skipna("mean", None) == "mean"
+    assert _rewrite_func_for_skipna("count", True) == "count"
+    assert _resolve_dim(None, ("time",), ("x", "time")) == ("time",)
+    assert _resolve_dim(Ellipsis, ("time",), ("x", "time")) == ("x", "time")
+    assert _resolve_dim("time", ("time",), ("x", "time")) == ("time",)
+
+
+def test_xarray_reduce_gated():
+    from flox_tpu import utils
+
+    if utils.HAS_XARRAY:
+        pytest.skip("xarray installed; gating not applicable")
+    from flox_tpu.xarray import xarray_reduce
+
+    with pytest.raises(ImportError, match="xarray"):
+        xarray_reduce(object(), "time", func="mean")
+
+
+def test_visualize_gated():
+    from flox_tpu import utils
+    from flox_tpu.visualize import visualize_groups_1d
+
+    if utils.HAS_MATPLOTLIB:
+        ax = visualize_groups_1d(np.array([0, 0, 1, 1]), chunks=(2, 2))
+        assert ax is not None
+    else:
+        with pytest.raises(ImportError):
+            visualize_groups_1d(np.array([0, 1]))
+
+
+def test_reindex_inf_fill_no_promotion():
+    # INF/NINF fills are representable in int64; dtype must not change
+    big = np.array([2**62, 2**62 + 1], dtype=np.int64)
+    out = reindex_(big, pd.Index([0, 1]), pd.Index([0, 1, 2]), fill_value=dtypes.NINF)
+    assert out.dtype == np.int64
+    assert out[0] == 2**62 and out[1] == 2**62 + 1
+    assert out[2] == np.iinfo(np.int64).min

@@ -1,0 +1,107 @@
+"""Cohort detection scenarios (reference: tests/test_cohorts.py:10-29 and
+the snapshot suite — here expressed as explicit expectations on realistic
+chunking patterns)."""
+
+import numpy as np
+import pytest
+
+from flox_tpu.cohorts import chunks_from_shards, find_group_cohorts
+
+
+def test_single_chunk_is_blockwise():
+    labels = np.array([0, 0, 1, 1, 2])
+    method, mapping = find_group_cohorts(labels, (5,))
+    assert method == "blockwise"
+    assert mapping == {(0,): [0, 1, 2]}
+
+
+def test_one_chunk_per_label_is_blockwise():
+    # sorted labels, chunk boundaries on group boundaries
+    labels = np.repeat([0, 1, 2, 3], 4)
+    method, mapping = find_group_cohorts(labels, (4, 4, 4, 4))
+    assert method == "blockwise"
+    assert mapping == {(0,): [0], (1,): [1], (2,): [2], (3,): [3]}
+
+
+def test_all_labels_everywhere_is_mapreduce():
+    # every chunk contains every label (random big array case)
+    labels = np.tile([0, 1, 2, 3], 8)
+    method, mapping = find_group_cohorts(labels, (8, 8, 8, 8))
+    assert method == "map-reduce"
+    assert mapping == {}
+
+
+def test_periodic_labels_form_cohorts():
+    # day-of-year-like pattern: each chunk sees a distinct label subset,
+    # repeating across "years" -> cohorts
+    nyears, nlabels, chunksize = 4, 12, 3
+    labels = np.tile(np.arange(nlabels), nyears)  # 4 years of 12 months
+    chunks = chunks_from_shards(len(labels), len(labels) // chunksize)
+    method, mapping = find_group_cohorts(labels, chunks)
+    assert method == "cohorts"
+    # every label appears in exactly one cohort
+    all_labels = sorted(lab for labs in mapping.values() for lab in labs)
+    assert all_labels == list(range(nlabels))
+    # months 0-2 always land in the same chunks -> same cohort
+    for labs in mapping.values():
+        assert labs in ([0, 1, 2], [3, 4, 5], [6, 7, 8], [9, 10, 11])
+
+
+def test_era5_dayofyear_like():
+    # hourly data, chunks of 48h: each chunk covers 2 days; day-of-year
+    # groups recur yearly -> cohorts
+    nhours = 24 * 365 * 2
+    day = (np.arange(nhours) // 24) % 365
+    chunks = chunks_from_shards(nhours, nhours // 48)
+    method, mapping = find_group_cohorts(day, chunks, expected_groups=range(365))
+    assert method == "cohorts"
+    # each day of year recurs in a small chunk subset; cohorts stay granular
+    ncohorts = len(mapping)
+    assert 100 < ncohorts <= 365
+    # every label assigned exactly once
+    all_labels = sorted(lab for labs in mapping.values() for lab in labs)
+    assert all_labels == list(range(365))
+
+
+def test_chunks_from_shards():
+    assert chunks_from_shards(10, 4) == (3, 3, 3, 1)
+    assert chunks_from_shards(8, 4) == (2, 2, 2, 2)
+    assert sum(chunks_from_shards(111, 8)) == 111
+
+
+def test_auto_method_selection_on_mesh():
+    # core wires find_group_cohorts when mesh given without method
+    import jax
+
+    from flox_tpu import groupby_reduce
+    from flox_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    labels = np.tile([0, 1, 2], 80)
+    vals = np.arange(240.0)
+    out, _ = groupby_reduce(vals, labels, func="nanmean", mesh=mesh)
+    expected = [np.mean(vals[labels == g]) for g in range(3)]
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_merge_false_returns_per_label_cohorts():
+    # chunks of 3 over a 12-cycle: labels {0,1,2} share chunks, so merge=True
+    # fuses them while merge=False keeps raw per-chunk-set cohorts
+    labels = np.tile(np.arange(12), 24)
+    chunks = chunks_from_shards(len(labels), len(labels) // 3)
+    method, merged = find_group_cohorts(labels, chunks, merge=True)
+    method2, raw = find_group_cohorts(labels, chunks, merge=False)
+    assert method == method2 == "cohorts"
+    assert sum(len(v) for v in raw.values()) == 12
+    assert len(raw) >= len(merged)
+
+
+def test_cohorts_memoized():
+    from flox_tpu.cohorts import _COHORTS_CACHE
+
+    _COHORTS_CACHE.clear()
+    labels = np.tile(np.arange(12), 100)
+    chunks = chunks_from_shards(len(labels), 8)
+    r1 = find_group_cohorts(labels, chunks)
+    r2 = find_group_cohorts(labels, chunks)
+    assert r1 is r2  # cache hit returns the same object

@@ -1,0 +1,105 @@
+"""Property-based invariants (reference: tests/test_properties.py:99-332).
+
+Invariants:
+* single-group groupby == the plain numpy reduction (reference :99-178)
+* jax engine == numpy engine on identical data (the reference's
+  chunked==eager analogue, :187-219)
+* first/last on reversed data == last/first (reference :295-332)
+* ffill/bfill reversal symmetry (reference :269-287)
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+from hypothesis.extra.numpy import arrays
+
+from flox_tpu.core import groupby_reduce
+from flox_tpu.scan import groupby_scan
+
+SIMPLE_FUNCS = ["sum", "nansum", "mean", "nanmean", "max", "nanmax", "min", "nanmin",
+                "var", "nanvar", "count", "first", "last", "nanfirst", "nanlast"]
+
+# bounded floats so sums cannot overflow (reference's not_overflowing_array,
+# test_properties.py:67-90)
+ELEMENTS = st.floats(
+    min_value=-1e6, max_value=1e6, allow_nan=False, allow_infinity=False, width=64
+)
+ELEMENTS_NAN = st.one_of(ELEMENTS, st.just(np.nan))
+
+
+@st.composite
+def array_and_labels(draw, with_nan=False):
+    n = draw(st.integers(min_value=1, max_value=40))
+    vals = draw(arrays(np.float64, (n,), elements=ELEMENTS_NAN if with_nan else ELEMENTS))
+    nlabels = draw(st.integers(min_value=1, max_value=5))
+    labels = draw(arrays(np.int64, (n,), elements=st.integers(0, nlabels - 1)))
+    return vals, labels
+
+
+@settings(max_examples=50, deadline=None)
+@given(data=array_and_labels(), func=st.sampled_from(SIMPLE_FUNCS))
+def test_single_group_equals_numpy(data, func):
+    vals, _ = data
+    labels = np.zeros(len(vals), dtype=np.int64)
+    result, _ = groupby_reduce(vals, labels, func=func, engine="numpy")
+    oracle = {
+        "sum": np.sum, "nansum": np.nansum, "mean": np.mean, "nanmean": np.nanmean,
+        "max": np.max, "nanmax": np.nanmax, "min": np.min, "nanmin": np.nanmin,
+        "var": np.var, "nanvar": np.nanvar,
+        "count": lambda x: np.sum(~np.isnan(x)),
+        "first": lambda x: x[0], "last": lambda x: x[-1],
+        "nanfirst": lambda x: x[0], "nanlast": lambda x: x[-1],
+    }[func]
+    with np.errstate(invalid="ignore"), np.testing.suppress_warnings() as sup:
+        sup.filter(RuntimeWarning)
+        expected = oracle(vals)
+    # atol covers shifted-two-pass rounding residue for var of near-constant
+    # data (|x|<=1e6 -> dev^2 residue <= ~1e-8); not a correctness deviation
+    np.testing.assert_allclose(
+        np.asarray(result).astype(float)[0], float(expected),
+        rtol=1e-9, atol=1e-7, equal_nan=True,
+    )
+
+
+@settings(max_examples=50, deadline=None)
+@given(data=array_and_labels(with_nan=True), func=st.sampled_from(SIMPLE_FUNCS))
+def test_engines_agree(data, func):
+    vals, labels = data
+    a, _ = groupby_reduce(vals, labels, func=func, engine="jax")
+    b, _ = groupby_reduce(vals, labels, func=func, engine="numpy")
+    np.testing.assert_allclose(
+        np.asarray(a).astype(float), np.asarray(b).astype(float),
+        rtol=1e-10, atol=1e-10, equal_nan=True,
+    )
+
+
+@settings(max_examples=40, deadline=None)
+@given(data=array_and_labels(with_nan=True))
+def test_first_last_reversal_duality(data):
+    vals, labels = data
+    f, gf = groupby_reduce(vals, labels, func="nanfirst", engine="numpy")
+    l, gl = groupby_reduce(vals[::-1], labels[::-1], func="nanlast", engine="numpy")
+    np.testing.assert_array_equal(gf, gl)
+    np.testing.assert_allclose(np.asarray(f), np.asarray(l), equal_nan=True)
+
+
+@settings(max_examples=40, deadline=None)
+@given(data=array_and_labels(with_nan=True))
+def test_ffill_bfill_reversal(data):
+    vals, labels = data
+    b = np.asarray(groupby_scan(vals, labels, func="bfill", engine="numpy"))
+    f_rev = np.asarray(
+        groupby_scan(vals[::-1], labels[::-1], func="ffill", engine="numpy")
+    )[::-1]
+    np.testing.assert_allclose(b, f_rev, equal_nan=True)
+
+
+@settings(max_examples=30, deadline=None)
+@given(data=array_and_labels())
+def test_cumsum_last_equals_sum(data):
+    vals, labels = data
+    scanned = np.asarray(groupby_scan(vals, labels, func="cumsum", engine="numpy"))
+    total, groups = groupby_reduce(vals, labels, func="sum", engine="numpy")
+    for i, g in enumerate(groups):
+        sel = np.flatnonzero(labels == g)
+        np.testing.assert_allclose(scanned[sel[-1]], np.asarray(total)[i], rtol=1e-12)
