@@ -1,0 +1,177 @@
+"""Benchmark suite: the reference's asv workloads on this framework.
+
+Parity target: BASELINE.md / the reference's asv_bench —
+``time_reduce`` (reduce.py:12-117), ``time_reduce_bare`` (reduce.py:88-104),
+``time_quantile`` (reduce.py:146-161), cohort-detection timing and
+graph-size-style metrics (cohorts.py:40-81), and the synthetic workloads
+(ERA5 day-of-year, PerfectMonthly, OISST, NWM county zonal stats,
+RandomBigArray).
+
+Run: ``python benchmarks.py [--scale small|full] [--engine jax|numpy]``.
+Prints one JSON line per benchmark:
+``{"bench": ..., "value": ..., "unit": ...}``.
+``bench.py`` remains the single-line headline benchmark for the driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _timeit(fn, reps=3):
+    fn()  # warm (compile)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def _block(x):
+    if hasattr(x, "block_until_ready"):
+        x.block_until_ready()
+    return x
+
+
+def bench_reduce(engine: str):
+    """time_reduce parity: N=3000, 1-D and 2-D, core func sweep."""
+    from flox_tpu import groupby_reduce
+
+    n = 3000
+    rng = np.random.default_rng(0)
+    labels = np.repeat(np.arange(5), n // 5)
+    out = []
+    for shape_name, vals in [("1d", rng.normal(size=n)), ("2d", rng.normal(size=(5, n)))]:
+        for func in ["sum", "nansum", "mean", "nanmean", "max", "nanmax", "count"]:
+            t = _timeit(lambda: _block(groupby_reduce(vals, labels, func=func, engine=engine)[0]))
+            out.append({"bench": f"time_reduce[{shape_name}-{func}-{engine}]", "value": round(t * 1e3, 3), "unit": "ms"})
+    return out
+
+
+def bench_reduce_bare(engine: str):
+    """time_reduce_bare parity: the engine kernel alone."""
+    from flox_tpu.aggregations import generic_aggregate
+
+    n = 3000
+    rng = np.random.default_rng(0)
+    labels = np.repeat(np.arange(5), n // 5)
+    vals = rng.normal(size=n)
+    out = []
+    for func in ["nansum", "nanmean", "nanmax", "nanlen"]:
+        t = _timeit(
+            lambda: _block(
+                generic_aggregate(labels, vals, engine=engine, func=func, size=5, fill_value=0)
+            )
+        )
+        out.append({"bench": f"time_reduce_bare[{func}-{engine}]", "value": round(t * 1e3, 3), "unit": "ms"})
+    return out
+
+
+def bench_quantile(engine: str, scale: str):
+    """time_quantile parity: q=0.9 yearly resample of a (T, 25, 25) array."""
+    from flox_tpu import groupby_reduce
+
+    nt = 31411 if scale == "full" else 4000
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(25, 25, nt))
+    years = (np.arange(nt) // 365).astype(np.int64)
+    t = _timeit(
+        lambda: _block(
+            groupby_reduce(vals, years, func="quantile", engine=engine, finalize_kwargs={"q": 0.9})[0]
+        )
+    )
+    return [{"bench": f"time_quantile[{engine}]", "value": round(t * 1e3, 2), "unit": "ms"}]
+
+
+def _era5_labels(scale: str):
+    nt = 26304 if scale == "full" else 8760
+    day = ((np.arange(nt) // 24) % 365).astype(np.int64)
+    return nt, day
+
+
+def bench_era5_dayofyear(engine: str, scale: str):
+    """ERA5 day-of-year climatology (scaled spatial grid)."""
+    from flox_tpu import groupby_reduce
+
+    nt, day = _era5_labels(scale)
+    nspace = 72 * 144 if scale == "full" else 24 * 48
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(nspace, nt)).astype(np.float32)
+    t = _timeit(lambda: _block(groupby_reduce(vals, day, func="nanmean", engine=engine)[0]))
+    gbps = vals.nbytes / t / 1e9
+    return [{"bench": f"era5_dayofyear[{engine}]", "value": round(gbps, 2), "unit": "GB/s"}]
+
+
+def bench_nwm_zonal(engine: str, scale: str):
+    """NWM county zonal stats: 2-D labels, ~900 groups (cohorts.py:84-97)."""
+    from flox_tpu import groupby_reduce
+
+    side = 1500 if scale == "full" else 400
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 900, size=(side, side))
+    vals = rng.normal(size=(side, side)).astype(np.float32)
+    t = _timeit(lambda: _block(groupby_reduce(vals, labels, func="nanmean", engine=engine)[0]))
+    return [{"bench": f"nwm_zonal_stats[{engine}]", "value": round(t * 1e3, 2), "unit": "ms"}]
+
+
+def bench_random_big(engine: str, scale: str):
+    """RandomBigArray map-reduce stress (scaled; cohorts.py:242-248)."""
+    from flox_tpu import groupby_reduce
+
+    nt = 100_000 if scale == "full" else 20_000
+    nspace = 2000 if scale == "full" else 200
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 5000, size=nt)
+    vals = rng.normal(size=(nspace, nt)).astype(np.float32)
+    t = _timeit(lambda: _block(groupby_reduce(vals, labels, func="nansum", engine=engine)[0]))
+    gbps = vals.nbytes / t / 1e9
+    return [{"bench": f"random_big_array[{engine}]", "value": round(gbps, 2), "unit": "GB/s"}]
+
+
+def bench_cohort_detection(scale: str):
+    """time_find_group_cohorts + track_num_cohorts parity."""
+    from flox_tpu.cohorts import _COHORTS_CACHE, chunks_from_shards, find_group_cohorts
+
+    nt, day = _era5_labels(scale)
+    chunks = chunks_from_shards(nt, nt // 48)
+
+    def run():
+        _COHORTS_CACHE.clear()
+        return find_group_cohorts(day, chunks, expected_groups=range(365))
+
+    t = _timeit(run)
+    method, mapping = run()
+    return [
+        {"bench": "time_find_group_cohorts[era5]", "value": round(t * 1e3, 2), "unit": "ms"},
+        {"bench": "track_num_cohorts[era5]", "value": len(mapping), "unit": "cohorts"},
+        {"bench": "track_method[era5]", "value": method, "unit": "method"},
+    ]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", choices=["small", "full"], default="small")
+    ap.add_argument("--engine", choices=["jax", "numpy", "both"], default="jax")
+    args = ap.parse_args()
+
+    engines = ["jax", "numpy"] if args.engine == "both" else [args.engine]
+    results = []
+    for engine in engines:
+        results += bench_reduce(engine)
+        results += bench_reduce_bare(engine)
+        results += bench_quantile(engine, args.scale)
+        results += bench_era5_dayofyear(engine, args.scale)
+        results += bench_nwm_zonal(engine, args.scale)
+        results += bench_random_big(engine, args.scale)
+    results += bench_cohort_detection(args.scale)
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -112,7 +112,7 @@ def _norm_chunk_entry(entry) -> tuple[str | Callable, dict]:
 
 
 @functools.lru_cache(maxsize=512)
-def _jitted_bundle(funcs_key, size: int, engine: str):
+def _jitted_bundle(funcs_key, size: int, engine: str, opts_key: tuple = ()):
     """Build & cache one jitted program running all kernels of a reduction.
 
     ``funcs_key`` is a hashable encoding of (func, fill_value, dtype-str,
@@ -192,7 +192,9 @@ def chunk_reduce(
             (f, _hashable_fill(fv), None if dt is None else np.dtype(dt).str, tuple(sorted(kw.items())))
             for f, fv, dt, kw in plan
         )
-        bundle = _jitted_bundle(funcs_key, size, engine)
+        from .options import trace_fingerprint
+
+        bundle = _jitted_bundle(funcs_key, size, engine, trace_fingerprint())
         results = bundle(utils.asarray_device(codes), utils.asarray_device(array))
     else:
         results = [

@@ -69,12 +69,140 @@ def _safe_codes(group_idx, size: int):
     return jnp.where(codes < 0, size, codes)
 
 
-def _seg(op: str, data, codes, size: int):
+def _use_matmul_path(op: str, data, size: int) -> bool:
+    """Additive segment reductions over few groups run as a one-hot matmul.
+
+    ``out[g, k] = Σ_n onehot[n, g] · data[n, k]`` is a plain GEMM: on TPU it
+    rides the MXU at full HBM streaming bandwidth, where XLA's scatter-add
+    serializes on the VPU. The one-hot is (N, size) — negligible traffic
+    while ``size`` is small, which is the common climatology case (12
+    months, 366 days). Float-only (integer sums must stay exact beyond the
+    f32 mantissa); policy "auto" engages it on TPU backends only — on CPU
+    XLA's scatter beats the un-tiled one-hot GEMM.
+    """
+    from .options import OPTIONS
+
+    policy = OPTIONS["segment_sum_impl"]
+    if policy != "matmul" or op != "sum":
+        return False
+    if not (size <= OPTIONS["matmul_num_groups_max"] and jnp.issubdtype(data.dtype, jnp.floating)):
+        return False
+    # footprint guard: the one-hot is (N, size); its traffic relative to the
+    # data is size/K. Keep it bounded and never let the materialized one-hot
+    # exceed a hard cap — a long 1-D array with many groups must stay on the
+    # scatter path.
+    n = data.shape[0]
+    k = int(np.prod(data.shape[1:])) if data.ndim > 1 else 1
+    itemsize = np.dtype(str(data.dtype)).itemsize
+    if size > 4 * k:
+        return False
+    if n * size * itemsize > 2**31:
+        return False
+    return True
+
+
+def _seg_matmul_sum(data, codes, size: int):
+    """(N, ...) × one-hot(N, size) -> (size, ...) on the MXU.
+
+    codes may contain the missing sentinel (== size); the one-hot row is all
+    zeros there, so missing labels drop out for free.
+
+    Non-finite values cannot ride the GEMM directly — ``0 × inf`` and
+    ``0 × NaN`` against other groups' zero one-hot entries would poison
+    their sums — so the data is zero-filled and per-column marker blocks
+    (NaN / +inf / -inf indicators) are appended to the K axis; a single GEMM
+    produces sums and markers, and IEEE propagation rules are re-applied.
+    The extra traffic is why ``_use_matmul_path`` requires a wide kept axis;
+    the endgame for narrow shapes is the Pallas segment-sum kernel.
+
+    precision=HIGHEST keeps f32 operands f32 on the MXU (the default would
+    demote them to bf16, losing accuracy vs the scatter path this replaces).
+    """
+    n = data.shape[0]
+    onehot = (codes[:, None] == jnp.arange(size, dtype=codes.dtype)[None, :]).astype(
+        data.dtype
+    )  # (N, size)
+    flat = data.reshape(n, -1)  # (N, K)
+    k = flat.shape[1]
+    isnan = jnp.isnan(flat)
+    ispos = jnp.isposinf(flat)
+    isneg = jnp.isneginf(flat)
+    nonfinite = isnan | ispos | isneg
+    zeroed = jnp.where(nonfinite, jnp.zeros((), flat.dtype), flat)
+    stacked = jnp.concatenate(
+        [zeroed, isnan.astype(flat.dtype), ispos.astype(flat.dtype), isneg.astype(flat.dtype)],
+        axis=1,
+    )  # (N, 4K)
+    out = jax.lax.dot_general(
+        onehot,
+        stacked,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=flat.dtype,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # (size, 4K)
+    sums = out[:, :k]
+    nan_c = out[:, k : 2 * k]
+    pos_c = out[:, 2 * k : 3 * k]
+    neg_c = out[:, 3 * k :]
+    poison = (nan_c > 0) | ((pos_c > 0) & (neg_c > 0))
+    out_v = jnp.where(
+        poison,
+        jnp.asarray(jnp.nan, sums.dtype),
+        jnp.where(
+            pos_c > 0,
+            jnp.asarray(jnp.inf, sums.dtype),
+            jnp.where(neg_c > 0, jnp.asarray(-jnp.inf, sums.dtype), sums),
+        ),
+    )
+    return out_v.reshape((size,) + data.shape[1:])
+
+
+def _segment_sum_impl(data, size: int) -> str:
+    """Pick the segment-sum implementation per the policy + constraints."""
+    from .options import OPTIONS
+
+    policy = OPTIONS["segment_sum_impl"]
+    floating = jnp.issubdtype(data.dtype, jnp.floating)
+    if policy == "scatter" or not floating:
+        return "scatter"
+    if policy == "matmul":
+        return "matmul" if _use_matmul_path("sum", data, size) else "scatter"
+    pallas_ok = (
+        str(data.dtype) in ("float32", "bfloat16")
+        and size <= 512
+        and data.shape[0] >= 8
+    )
+    if policy == "pallas":
+        return "pallas" if pallas_ok else "scatter"
+    # auto: pallas on TPU backends, scatter elsewhere
+    if jax.default_backend() in ("tpu", "axon") and pallas_ok:
+        return "pallas"
+    return "scatter"
+
+
+def _seg(op: str, data, codes, size: int, nan_safe: bool = False):
     """Segment-reduce ``data`` (N, ...) by ``codes`` (N,) into (size, ...).
 
     Allocates one extra segment for missing labels and slices it off, so the
-    output shape depends only on the static ``size``.
+    output shape depends only on the static ``size``. Additive reductions
+    over few groups take the MXU one-hot-matmul path instead of scatter;
+    ``nan_safe=True`` asserts the caller already masked NaNs out (skipna
+    paths), otherwise the matmul zero-fills and re-injects NaN per group —
+    a ``0 × NaN`` in the GEMM would poison every group's sum.
     """
+    if op == "sum":
+        impl = _segment_sum_impl(data, size)
+        if impl == "pallas":
+            from .pallas_kernels import segment_sum_pallas
+
+            # interpret mode keeps the kernel testable off-TPU
+            return segment_sum_pallas(
+                data, codes, size, interpret=jax.default_backend() not in ("tpu", "axon")
+            )
+        if impl == "matmul":
+            # non-finite handling is built into the GEMM (marker columns), so
+            # skipna-masked and raw data take the same path
+            return _seg_matmul_sum(data, codes, size)
     fn = {
         "sum": jax.ops.segment_sum,
         "prod": jax.ops.segment_prod,
@@ -91,7 +219,7 @@ def _counts(codes, size: int, mask=None, dtype=jnp.int32):
         ones = jnp.ones(codes.shape, dtype=dtype)
     else:
         ones = mask.astype(dtype)
-    return _seg("sum", ones, codes, size)
+    return _seg("sum", ones, codes, size, nan_safe=True)
 
 
 def _fill_empty(out, present, fill_value):
@@ -140,7 +268,7 @@ def _make_addlike(op: str, identity, skipna: bool):
         if mask is not None:
             data = jnp.where(mask, data, jnp.asarray(identity, dtype=data.dtype))
         data = _maybe_cast(data, dtype)
-        out = _seg(op, data, codes, size)
+        out = _seg(op, data, codes, size, nan_safe=mask is not None)
         if fill_value is not None and fill_value != identity:
             # numpy semantics: nansum of an all-NaN group is the identity (0),
             # so "empty" means zero *total* elements, not zero non-NaN ones.
@@ -244,7 +372,7 @@ def _mean_impl(group_idx, array, *, size, fill_value, dtype, skipna):
         dtype = jnp.result_type(data.dtype, jnp.float32)
     sdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
     sdata = _maybe_cast(sdata, dtype)
-    total = _seg("sum", sdata, codes, size)
+    total = _seg("sum", sdata, codes, size, nan_safe=mask is not None)
     cnt = _counts(codes, size, mask=mask, dtype=sdata.dtype)
     cnt = _bcast_present(cnt, total)
     out = total / cnt
@@ -286,7 +414,7 @@ def _var_impl(group_idx, array, *, size, fill_value, dtype, ddof, skipna, std):
     zdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
     zdata = _maybe_cast(zdata, dtype)
     cnt = _counts(codes, size, mask=mask, dtype=zdata.dtype)
-    total = _seg("sum", zdata, codes, size)
+    total = _seg("sum", zdata, codes, size, nan_safe=mask is not None)
     cnt_b = _bcast_present(cnt, total)
     mean_g = total / jnp.where(cnt_b > 0, cnt_b, 1)
     # gather each element's group mean and accumulate squared deviations
@@ -294,7 +422,7 @@ def _var_impl(group_idx, array, *, size, fill_value, dtype, ddof, skipna, std):
     dev = zdata - gathered
     if mask is not None:
         dev = jnp.where(mask, dev, jnp.zeros((), dev.dtype))
-    m2 = _seg("sum", dev * dev, codes, size)
+    m2 = _seg("sum", dev * dev, codes, size, nan_safe=mask is not None)
     denom = cnt_b - ddof
     out = m2 / jnp.where(denom > 0, denom, 1)
     out = jnp.where(denom > 0, out, jnp.asarray(jnp.nan, out.dtype))
@@ -337,7 +465,7 @@ def var_chunk(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, s
     zdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
     zdata = _maybe_cast(zdata, dtype)
     cnt = _counts(codes, size, mask=mask, dtype=zdata.dtype)
-    total = _seg("sum", zdata, codes, size)
+    total = _seg("sum", zdata, codes, size, nan_safe=mask is not None)
     cnt_b = _bcast_present(cnt, total)
     mean_g = total / jnp.where(cnt_b > 0, cnt_b, 1)
     gathered = jnp.take(
@@ -346,7 +474,7 @@ def var_chunk(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, s
     dev = zdata - gathered
     if mask is not None:
         dev = jnp.where(mask, dev, jnp.zeros((), dev.dtype))
-    m2 = _seg("sum", dev * dev, codes, size)
+    m2 = _seg("sum", dev * dev, codes, size, nan_safe=mask is not None)
     if cnt_b.shape != total.shape:
         cnt_b = jnp.broadcast_to(cnt_b, total.shape)
     return MultiArray(

@@ -19,6 +19,12 @@ OPTIONS: dict[str, Any] = {
     "accumulate_f64": True,
     # default engine for device arrays
     "default_engine": "jax",
+    # additive segment reductions with at most this many groups may use the
+    # one-hot matmul (MXU) or Pallas path instead of scatter-add
+    "matmul_num_groups_max": 384,
+    # segment-sum implementation: "auto" picks pallas on TPU backends and
+    # scatter elsewhere; explicit "scatter" | "matmul" | "pallas" override
+    "segment_sum_impl": "auto",
 }
 
 _VALIDATORS = {
@@ -26,7 +32,18 @@ _VALIDATORS = {
     "rechunk_blockwise_chunk_size_threshold": lambda x: x >= 1,
     "accumulate_f64": lambda x: isinstance(x, bool),
     "default_engine": lambda x: x in ("jax", "numpy"),
+    "matmul_num_groups_max": lambda x: isinstance(x, int) and x >= 0,
+    "segment_sum_impl": lambda x: x in ("auto", "scatter", "matmul", "pallas"),
 }
+
+
+def trace_fingerprint() -> tuple:
+    """Options that are read at TRACE time inside jitted programs.
+
+    Any cache of compiled programs must include this in its key, or a
+    set_options() change would silently keep serving stale kernels.
+    """
+    return (OPTIONS["segment_sum_impl"], OPTIONS["matmul_num_groups_max"])
 
 
 class set_options:

@@ -1,0 +1,143 @@
+"""Pallas TPU kernels for the hot segment reductions (L1, below kernels.py).
+
+Why a custom kernel: XLA lowers ``segment_sum`` to scatter-add, which
+serializes on the VPU; the one-hot GEMM path (kernels._seg_matmul_sum) rides
+the MXU but pays 4× HBM traffic for its exactness marker columns. This
+kernel gets both: the data streams HBM→VMEM exactly once, and each tile's
+contribution is an **in-VMEM** one-hot matmul on the MXU — the one-hot and
+the marker masks never touch HBM.
+
+Layout: ``data`` (N, K) reduced over N into (size, K); grid = (k_tiles,
+n_tiles) with the output block revisited across the n axis (sequential TPU
+grid → accumulate with an init at n==0, the standard reduction pattern).
+Non-finite values are zero-filled in VMEM and NaN/±inf markers accumulate in
+three extra outputs so IEEE propagation is re-applied exactly.
+
+Reference analogue: the numpy_groupies bincount kernels this replaces
+(aggregate_npg.py:7-126) — but tiled for the memory hierarchy the guide
+describes (pallas_guide.md: HBM→VMEM→MXU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["segment_sum_pallas", "pallas_available"]
+
+
+def pallas_available() -> bool:
+    try:
+        import jax.experimental.pallas  # noqa: F401
+
+        return True
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def _kernel(codes_ref, data_ref, out_ref, nan_ref, pos_ref, neg_ref, *, size_p, n_tile):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)  # position along the reduced (N) axis
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+        nan_ref[:] = jnp.zeros_like(nan_ref)
+        pos_ref[:] = jnp.zeros_like(pos_ref)
+        neg_ref[:] = jnp.zeros_like(neg_ref)
+
+    codes = codes_ref[0, :]  # (n_tile,)
+    data = data_ref[:]  # (n_tile, k_tile)
+    onehot = (
+        codes[:, None] == jax.lax.broadcasted_iota(jnp.int32, (n_tile, size_p), 1)
+    ).astype(data.dtype)  # (n_tile, size_p) — lives only in VMEM
+
+    isnan = jnp.isnan(data)
+    ispos = jnp.isposinf(data)
+    isneg = jnp.isneginf(data)
+    zeroed = jnp.where(isnan | ispos | isneg, jnp.zeros((), data.dtype), data)
+
+    def acc(ref, tile):
+        ref[:] += jax.lax.dot_general(
+            onehot,
+            tile,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=ref.dtype,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    acc(out_ref, zeroed)
+    acc(nan_ref, isnan.astype(data.dtype))
+    acc(pos_ref, ispos.astype(data.dtype))
+    acc(neg_ref, isneg.astype(data.dtype))
+
+
+@functools.lru_cache(maxsize=128)
+def _build(n_pad: int, k_pad: int, size_p: int, dtype_str: str, n_tile: int, k_tile: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    kern = functools.partial(_kernel, size_p=size_p, n_tile=n_tile)
+    grid = (k_pad // k_tile, n_pad // n_tile)
+    dtype = jnp.dtype(dtype_str)
+    out_shape = [jax.ShapeDtypeStruct((size_p, k_pad), dtype)] * 4
+
+    fn = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, n_tile), lambda i, j: (0, j)),  # codes
+            pl.BlockSpec((n_tile, k_tile), lambda i, j: (j, i)),  # data
+        ],
+        out_specs=[pl.BlockSpec((size_p, k_tile), lambda i, j: (0, i))] * 4,
+        out_shape=out_shape,
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def segment_sum_pallas(data, codes, size: int, *, interpret: bool = False):
+    """Segment-sum ``data`` (N, K...) by ``codes`` (N,) -> (size, K...).
+
+    Exact IEEE semantics (NaN/±inf propagate per group+column); missing
+    labels (code outside [0, size)) drop out. f32/bf16 only.
+    """
+    import jax.numpy as jnp
+
+    data = jnp.asarray(data)
+    orig_shape = data.shape
+    n = data.shape[0]
+    flat = data.reshape(n, -1)
+    k = flat.shape[1]
+
+    n_tile = 512 if n >= 512 else max(8, ((n + 7) // 8) * 8)
+    k_tile = 512 if k >= 512 else max(128, ((k + 127) // 128) * 128)
+    n_pad = -(-n // n_tile) * n_tile
+    k_pad = -(-k // k_tile) * k_tile
+    size_p = max(8, ((size + 7) // 8) * 8)
+
+    codes = jnp.asarray(codes).astype(jnp.int32).reshape(-1)
+    # out-of-range codes (missing labels, padding) match no one-hot column
+    codes = jnp.where((codes < 0) | (codes >= size), size_p, codes)
+    codes_p = jnp.pad(codes, (0, n_pad - n), constant_values=size_p).reshape(1, n_pad)
+    flat_p = jnp.pad(flat, ((0, n_pad - n), (0, k_pad - k)))
+
+    fn = _build(n_pad, k_pad, size_p, str(flat.dtype), n_tile, k_tile, interpret)
+    sums, nan_c, pos_c, neg_c = fn(codes_p, flat_p)
+
+    poison = (nan_c > 0) | ((pos_c > 0) & (neg_c > 0))
+    out = jnp.where(
+        poison,
+        jnp.asarray(jnp.nan, sums.dtype),
+        jnp.where(
+            pos_c > 0,
+            jnp.asarray(jnp.inf, sums.dtype),
+            jnp.where(neg_c > 0, jnp.asarray(-jnp.inf, sums.dtype), sums),
+        ),
+    )
+    return out[:size, :k].reshape((size,) + orig_shape[1:])

@@ -257,9 +257,11 @@ def sharded_groupby_reduce(
     )
     out_specs = P()  # replicated
 
+    from ..options import trace_fingerprint
+
     cache_key = (
         _agg_cache_key(agg), size, size_pad, method, axis_name, shard_len, nat,
-        mesh, arr.ndim,
+        mesh, arr.ndim, trace_fingerprint(),
     )
     fn = _PROGRAM_CACHE.get(cache_key)
     if fn is None:

@@ -64,7 +64,9 @@ def sharded_groupby_scan(
     in_specs = (P(*([None] * (arr.ndim - 1) + [axis_name])), P(axis_name))
     out_specs = P(*([None] * (arr.ndim - 1) + [axis_name]))
 
-    cache_key = (scan.name, size, axis_name, mesh, arr.ndim, str(arr.dtype))
+    from ..options import trace_fingerprint
+
+    cache_key = (scan.name, size, axis_name, mesh, arr.ndim, str(arr.dtype), trace_fingerprint())
     fn = _SCAN_CACHE.get(cache_key)
     if fn is None:
         program = _build_scan_program(scan, size=size, axis_name=axis_name)

@@ -251,3 +251,103 @@ def test_nan_labels_excluded():
     values = np.array([1.0, 100.0, 2.0, 3.0])
     got = np.asarray(kernels.generic_kernel("sum", codes, values, size=2))
     np.testing.assert_allclose(got, [3.0, 3.0])
+
+
+class TestMatmulPath:
+    """The one-hot-GEMM segment-sum path must agree with scatter exactly
+    in semantics (incl. NaN propagation and missing labels)."""
+
+    def _both(self, func, codes, values, size, **kw):
+        import flox_tpu
+
+        with flox_tpu.set_options(segment_sum_impl="matmul"):
+            a = np.asarray(kernels.generic_kernel(func, codes, values, size=size, **kw))
+        with flox_tpu.set_options(segment_sum_impl="scatter"):
+            b = np.asarray(kernels.generic_kernel(func, codes, values, size=size, **kw))
+        return a, b
+
+    @pytest.mark.parametrize("func", ["sum", "nansum", "mean", "nanmean", "var", "nanvar"])
+    def test_agrees_with_scatter(self, func):
+        rng = np.random.default_rng(3)
+        codes = rng.integers(0, 5, 200)
+        values = rng.normal(size=(3, 200))
+        values[..., rng.random(200) < 0.2] = np.nan
+        codes[rng.random(200) < 0.1] = -1
+        a, b = self._both(func, codes, values, 5)
+        np.testing.assert_allclose(a, b, rtol=1e-10, atol=1e-12, equal_nan=True)
+
+    def test_nan_does_not_poison_other_groups(self):
+        # non-skipna sum: NaN must hit only its own group (0*NaN hazard)
+        codes = np.array([0, 0, 1, 1])
+        values = np.array([1.0, np.nan, 2.0, 3.0])
+        a, b = self._both("sum", codes, values, 2)
+        np.testing.assert_allclose(a, [np.nan, 5.0], equal_nan=True)
+        np.testing.assert_allclose(b, [np.nan, 5.0], equal_nan=True)
+
+    def test_missing_labels_drop(self):
+        codes = np.array([0, -1, 0, 1])
+        values = np.array([1.0, 100.0, 2.0, 3.0])
+        a, _ = self._both("sum", codes, values, 2)
+        np.testing.assert_allclose(a, [3.0, 3.0])
+
+
+def test_matmul_path_inf_exact():
+    # inf must stay local to its group and column (0*inf hazard in the GEMM)
+    import flox_tpu
+
+    codes = np.array([0, 1, 0, 1])
+    values = np.array([[np.inf, 1.0, 2.0, 3.0],
+                       [1.0, -np.inf, np.inf, 4.0],
+                       [1.0, 2.0, 3.0, 4.0]])
+    with flox_tpu.set_options(segment_sum_impl="matmul"):
+        a = np.asarray(kernels.generic_kernel("sum", codes, values, size=2))
+    with flox_tpu.set_options(segment_sum_impl="scatter"):
+        b = np.asarray(kernels.generic_kernel("sum", codes, values, size=2))
+    expected = np.array([[np.inf, 4.0], [np.inf, -np.inf + 4.0], [4.0, 6.0]])
+    np.testing.assert_array_equal(a, expected)
+    np.testing.assert_array_equal(b, expected)
+
+
+def test_options_invalidate_jit_cache():
+    # toggling matmul_path must not serve a stale compiled bundle
+    import flox_tpu
+    from flox_tpu.core import groupby_reduce
+
+    codes = np.array([0, 1] * 50)
+    vals = np.arange(100.0).reshape(2, 50).repeat(2, axis=1)[:, :100].reshape(2, 100)
+    with flox_tpu.set_options(segment_sum_impl="matmul"):
+        a, _ = groupby_reduce(vals, codes, func="sum", engine="jax")
+    with flox_tpu.set_options(segment_sum_impl="scatter"):
+        b, _ = groupby_reduce(vals, codes, func="sum", engine="jax")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-12)
+
+
+class TestPallasPath:
+    """Pallas segment-sum (interpret mode off-TPU) vs scatter."""
+
+    def _both(self, func, codes, values, size, **kw):
+        import flox_tpu
+
+        with flox_tpu.set_options(segment_sum_impl="pallas"):
+            a = np.asarray(kernels.generic_kernel(func, codes, values, size=size, **kw))
+        with flox_tpu.set_options(segment_sum_impl="scatter"):
+            b = np.asarray(kernels.generic_kernel(func, codes, values, size=size, **kw))
+        return a, b
+
+    @pytest.mark.parametrize("func", ["sum", "nansum", "nanmean"])
+    def test_agrees_with_scatter(self, func):
+        rng = np.random.default_rng(4)
+        codes = rng.integers(0, 5, 64)
+        values = rng.normal(size=(2, 64)).astype(np.float32)
+        values[..., rng.random(64) < 0.2] = np.nan
+        codes[rng.random(64) < 0.1] = -1
+        a, b = self._both(func, codes, values, 5)
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6, equal_nan=True)
+
+    def test_inf_exact(self):
+        codes = np.array([0, 1, 0, 1] * 4)
+        values = np.zeros((2, 16), dtype=np.float32)
+        values[0, 0] = np.inf
+        values[1, 1] = -np.inf
+        a, b = self._both("sum", codes, values, 2)
+        np.testing.assert_array_equal(a, b)
