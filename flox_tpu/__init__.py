@@ -6,11 +6,12 @@ built on JAX/XLA: device-resident group codes, jit-compiled segment-reduce
 kernels, and shard_map/collective execution strategies over a TPU mesh.
 """
 
-from . import kernels
+from . import kernels, profiling
 from .aggregations import Aggregation, Scan, is_supported_aggregation
-from .rechunk import rechunk_for_blockwise, reshard_for_blockwise
+from .rechunk import rechunk_for_blockwise, rechunk_for_cohorts, reshard_for_blockwise
 from .reindex import ReindexArrayType, ReindexStrategy
 from .core import groupby_reduce
+from .device import codes_device, groupby_reduce_device
 from .scan import groupby_scan
 from .dtypes import INF, NA, NINF
 from .factorize import factorize_, factorize_single
@@ -26,11 +27,15 @@ __all__ = [
     "Scan",
     "factorize_",
     "factorize_single",
+    "codes_device",
     "groupby_reduce",
+    "groupby_reduce_device",
     "groupby_scan",
     "is_supported_aggregation",
     "kernels",
+    "profiling",
     "rechunk_for_blockwise",
+    "rechunk_for_cohorts",
     "reshard_for_blockwise",
     "ReindexArrayType",
     "ReindexStrategy",

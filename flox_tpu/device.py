@@ -1,0 +1,145 @@
+"""Fully-traceable device-resident groupby (L4, jit-composable surface).
+
+The main ``groupby_reduce`` keeps unknown-label discovery host-side, like
+the reference. When ``expected_groups`` is known, NOTHING needs the host:
+factorization is a ``searchsorted`` (factorize.factorize_device), the
+reduction is the kernel bundle, and the whole pipeline is one traceable
+function users can place inside their own ``jax.jit`` / ``shard_map`` /
+training step — the capability the reference cannot offer (its engines are
+host numpy).
+
+This realizes the "device-resident integer group codes" design point of the
+build plan (SURVEY.md §7 step 2; reference counterpart factorize.py:42-99
+is host-only).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from . import factorize as fct
+from .aggregations import _initialize_aggregation
+
+__all__ = ["groupby_reduce_device", "codes_device"]
+
+
+def codes_device(by, expected_values=None, *, bins=None, closed: str = "right"):
+    """Traceable label -> dense code computation on device.
+
+    Exactly one of ``expected_values`` (sorted unique labels) or ``bins``
+    (interval edges) must be given. Returns int32 codes with -1 = missing.
+    """
+    if (expected_values is None) == (bins is None):
+        raise ValueError("Pass exactly one of expected_values or bins")
+    if bins is not None:
+        return fct.bin_device(by, bins, closed=closed)
+    return fct.factorize_device(by, expected_values)
+
+
+def groupby_reduce_device(
+    array,
+    *by,
+    func: str,
+    expected_values: Sequence | None = None,
+    bins: Sequence | None = None,
+    fill_value=None,
+    dtype=None,
+    finalize_kwargs: dict | None = None,
+):
+    """Grouped reduction with every step on device — safe inside ``jax.jit``.
+
+    ``by`` entries are device arrays whose *flattened* elements align with
+    the trailing dims of ``array``; ``expected_values`` / ``bins`` give the
+    static group space (one entry per ``by``; a bare array is accepted for
+    one grouper). Reduces over all ``by`` dims. Returns the dense result
+    (..., *group_sizes) — no groups tuple (they are exactly the expected
+    values, which the caller already has).
+
+    Limitations vs the host orchestrator: no unknown-label discovery, no
+    partial-axis reduction, no datetime round-trips — those need the host.
+    """
+    import jax.numpy as jnp
+
+    from .kernels import generic_kernel
+
+    nby = len(by)
+    if nby == 0:
+        raise TypeError("Must pass at least one `by`")
+
+    def _norm(spec):
+        if spec is None:
+            return (None,) * nby
+        if nby == 1:
+            # a bare array OR a plain list of group values is one spec;
+            # only a 1-tuple is the explicit per-grouper form
+            if isinstance(spec, tuple) and len(spec) == 1:
+                return spec
+            return (spec,)
+        if not isinstance(spec, (tuple, list)) or len(spec) != nby:
+            raise ValueError(
+                f"With {nby} groupers, pass a tuple of {nby} expected_values/bins entries"
+            )
+        return tuple(spec)
+
+    expected_t = _norm(expected_values)
+    bins_t = _norm(bins)
+
+    codes_list = []
+    sizes = []
+    for b, exp, edges in zip(by, expected_t, bins_t):
+        flat = jnp.asarray(b).reshape(-1)
+        if edges is not None:
+            codes_list.append(fct.bin_device(flat, edges))
+            sizes.append(len(edges) - 1)
+        elif exp is not None:
+            codes_list.append(fct.factorize_device(flat, exp))
+            sizes.append(len(exp))
+        else:
+            raise ValueError("groupby_reduce_device needs expected_values or bins per `by`")
+
+    # ravel multi-by codes on device; any -1 component -> -1
+    codes = codes_list[0]
+    size = sizes[0]
+    for c, s in zip(codes_list[1:], sizes[1:]):
+        missing = (codes < 0) | (c < 0)
+        codes = jnp.where(missing, -1, codes * s + c)
+        size *= s
+
+    arr = jnp.asarray(array)
+    n = codes.shape[0]
+    lead = arr.shape[: arr.ndim - _span_ndim(arr.shape, n)]
+    arr_flat = arr.reshape(lead + (n,))
+
+    agg = _initialize_aggregation(
+        func, dtype, np.dtype(str(arr.dtype)), fill_value, 0, finalize_kwargs
+    )
+    kw = dict(agg.finalize_kwargs)
+    result = generic_kernel(
+        agg.numpy[0] if isinstance(agg.numpy[0], str) else func,
+        codes,
+        arr_flat,
+        size=size,
+        fill_value=agg.final_fill_value if not _is_sentinel(agg.final_fill_value) else None,
+        **kw,
+    )
+    new_dims = agg.new_dims()
+    out_shape = new_dims + lead + tuple(sizes)
+    return result.reshape(out_shape)
+
+
+def _span_ndim(shape: tuple[int, ...], n: int) -> int:
+    """How many trailing dims of ``shape`` flatten to ``n`` elements."""
+    prod = 1
+    for i, s in enumerate(reversed(shape), start=1):
+        prod *= s
+        if prod == n:
+            return i
+    raise ValueError(f"`by` length {n} does not match trailing dims of array shape {shape}")
+
+
+def _is_sentinel(v) -> bool:
+    from . import dtypes
+
+    return v in (dtypes.NA, dtypes.INF, dtypes.NINF)

@@ -144,16 +144,9 @@ def _seg_matmul_sum(data, codes, size: int):
     nan_c = out[:, k : 2 * k]
     pos_c = out[:, 2 * k : 3 * k]
     neg_c = out[:, 3 * k :]
-    poison = (nan_c > 0) | ((pos_c > 0) & (neg_c > 0))
-    out_v = jnp.where(
-        poison,
-        jnp.asarray(jnp.nan, sums.dtype),
-        jnp.where(
-            pos_c > 0,
-            jnp.asarray(jnp.inf, sums.dtype),
-            jnp.where(neg_c > 0, jnp.asarray(-jnp.inf, sums.dtype), sums),
-        ),
-    )
+    from .utils import reapply_nonfinite
+
+    out_v = reapply_nonfinite(sums, nan_c, pos_c, neg_c)
     return out_v.reshape((size,) + data.shape[1:])
 
 
@@ -167,9 +160,11 @@ def _segment_sum_impl(data, size: int) -> str:
         return "scatter"
     if policy == "matmul":
         return "matmul" if _use_matmul_path("sum", data, size) else "scatter"
+    from .options import OPTIONS as _opts
+
     pallas_ok = (
         str(data.dtype) in ("float32", "bfloat16")
-        and size <= 512
+        and size <= min(512, _opts["matmul_num_groups_max"])
         and data.shape[0] >= 8
     )
     if policy == "pallas":
@@ -180,15 +175,14 @@ def _segment_sum_impl(data, size: int) -> str:
     return "scatter"
 
 
-def _seg(op: str, data, codes, size: int, nan_safe: bool = False):
+def _seg(op: str, data, codes, size: int):
     """Segment-reduce ``data`` (N, ...) by ``codes`` (N,) into (size, ...).
 
     Allocates one extra segment for missing labels and slices it off, so the
-    output shape depends only on the static ``size``. Additive reductions
-    over few groups take the MXU one-hot-matmul path instead of scatter;
-    ``nan_safe=True`` asserts the caller already masked NaNs out (skipna
-    paths), otherwise the matmul zero-fills and re-injects NaN per group —
-    a ``0 × NaN`` in the GEMM would poison every group's sum.
+    output shape depends only on the static ``size``. Additive float
+    reductions may take the MXU one-hot-matmul or Pallas path per the
+    ``segment_sum_impl`` policy; both carry non-finite marker columns, since
+    even skipna-masked data may contain legitimate ±inf values.
     """
     if op == "sum":
         impl = _segment_sum_impl(data, size)
@@ -219,7 +213,7 @@ def _counts(codes, size: int, mask=None, dtype=jnp.int32):
         ones = jnp.ones(codes.shape, dtype=dtype)
     else:
         ones = mask.astype(dtype)
-    return _seg("sum", ones, codes, size, nan_safe=True)
+    return _seg("sum", ones, codes, size)
 
 
 def _fill_empty(out, present, fill_value):
@@ -268,7 +262,7 @@ def _make_addlike(op: str, identity, skipna: bool):
         if mask is not None:
             data = jnp.where(mask, data, jnp.asarray(identity, dtype=data.dtype))
         data = _maybe_cast(data, dtype)
-        out = _seg(op, data, codes, size, nan_safe=mask is not None)
+        out = _seg(op, data, codes, size)
         if fill_value is not None and fill_value != identity:
             # numpy semantics: nansum of an all-NaN group is the identity (0),
             # so "empty" means zero *total* elements, not zero non-NaN ones.
@@ -372,7 +366,7 @@ def _mean_impl(group_idx, array, *, size, fill_value, dtype, skipna):
         dtype = jnp.result_type(data.dtype, jnp.float32)
     sdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
     sdata = _maybe_cast(sdata, dtype)
-    total = _seg("sum", sdata, codes, size, nan_safe=mask is not None)
+    total = _seg("sum", sdata, codes, size)
     cnt = _counts(codes, size, mask=mask, dtype=sdata.dtype)
     cnt = _bcast_present(cnt, total)
     out = total / cnt
@@ -414,7 +408,7 @@ def _var_impl(group_idx, array, *, size, fill_value, dtype, ddof, skipna, std):
     zdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
     zdata = _maybe_cast(zdata, dtype)
     cnt = _counts(codes, size, mask=mask, dtype=zdata.dtype)
-    total = _seg("sum", zdata, codes, size, nan_safe=mask is not None)
+    total = _seg("sum", zdata, codes, size)
     cnt_b = _bcast_present(cnt, total)
     mean_g = total / jnp.where(cnt_b > 0, cnt_b, 1)
     # gather each element's group mean and accumulate squared deviations
@@ -422,7 +416,7 @@ def _var_impl(group_idx, array, *, size, fill_value, dtype, ddof, skipna, std):
     dev = zdata - gathered
     if mask is not None:
         dev = jnp.where(mask, dev, jnp.zeros((), dev.dtype))
-    m2 = _seg("sum", dev * dev, codes, size, nan_safe=mask is not None)
+    m2 = _seg("sum", dev * dev, codes, size)
     denom = cnt_b - ddof
     out = m2 / jnp.where(denom > 0, denom, 1)
     out = jnp.where(denom > 0, out, jnp.asarray(jnp.nan, out.dtype))
@@ -465,7 +459,7 @@ def var_chunk(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, s
     zdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
     zdata = _maybe_cast(zdata, dtype)
     cnt = _counts(codes, size, mask=mask, dtype=zdata.dtype)
-    total = _seg("sum", zdata, codes, size, nan_safe=mask is not None)
+    total = _seg("sum", zdata, codes, size)
     cnt_b = _bcast_present(cnt, total)
     mean_g = total / jnp.where(cnt_b > 0, cnt_b, 1)
     gathered = jnp.take(
@@ -474,7 +468,7 @@ def var_chunk(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, s
     dev = zdata - gathered
     if mask is not None:
         dev = jnp.where(mask, dev, jnp.zeros((), dev.dtype))
-    m2 = _seg("sum", dev * dev, codes, size, nan_safe=mask is not None)
+    m2 = _seg("sum", dev * dev, codes, size)
     if cnt_b.shape != total.shape:
         cnt_b = jnp.broadcast_to(cnt_b, total.shape)
     return MultiArray(

@@ -14,9 +14,6 @@ OPTIONS: dict[str, Any] = {
     "rechunk_blockwise_num_chunks_threshold": 0.25,
     "rechunk_blockwise_chunk_size_threshold": 1.5,
     # TPU policy knobs (no reference analogue):
-    # accumulate float32 inputs in float64 when x64 is enabled, else use
-    # compensated (Kahan) summation inside kernels.
-    "accumulate_f64": True,
     # default engine for device arrays
     "default_engine": "jax",
     # additive segment reductions with at most this many groups may use the
@@ -30,7 +27,6 @@ OPTIONS: dict[str, Any] = {
 _VALIDATORS = {
     "rechunk_blockwise_num_chunks_threshold": lambda x: 0 < x <= 1,
     "rechunk_blockwise_chunk_size_threshold": lambda x: x >= 1,
-    "accumulate_f64": lambda x: isinstance(x, bool),
     "default_engine": lambda x: x in ("jax", "numpy"),
     "matmul_num_groups_max": lambda x: isinstance(x, int) and x >= 0,
     "segment_sum_impl": lambda x: x in ("auto", "scatter", "matmul", "pallas"),
@@ -50,7 +46,7 @@ class set_options:
     """Context manager / global setter for options (options.py:21-65 parity).
 
     >>> import flox_tpu
-    >>> with flox_tpu.set_options(accumulate_f64=False):
+    >>> with flox_tpu.set_options(default_engine="numpy"):
     ...     pass
     """
 

@@ -130,14 +130,7 @@ def segment_sum_pallas(data, codes, size: int, *, interpret: bool = False):
     fn = _build(n_pad, k_pad, size_p, str(flat.dtype), n_tile, k_tile, interpret)
     sums, nan_c, pos_c, neg_c = fn(codes_p, flat_p)
 
-    poison = (nan_c > 0) | ((pos_c > 0) & (neg_c > 0))
-    out = jnp.where(
-        poison,
-        jnp.asarray(jnp.nan, sums.dtype),
-        jnp.where(
-            pos_c > 0,
-            jnp.asarray(jnp.inf, sums.dtype),
-            jnp.where(neg_c > 0, jnp.asarray(-jnp.inf, sums.dtype), sums),
-        ),
-    )
+    from .utils import reapply_nonfinite
+
+    out = reapply_nonfinite(sums, nan_c, pos_c, neg_c)
     return out[:size, :k].reshape((size,) + orig_shape[1:])

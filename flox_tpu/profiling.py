@@ -1,0 +1,51 @@
+"""Profiling hooks (auxiliary subsystem; SURVEY.md §5).
+
+The reference has no built-in profiler beyond debug logging — profiling is
+external (asv, snakeviz). On TPU the native tool is ``jax.profiler``; this
+module provides the thin wrappers so users can capture a trace of a grouped
+reduction without learning the jax API.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+logger = logging.getLogger("flox_tpu")
+
+__all__ = ["trace", "annotate", "timed"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a jax profiler trace (view with TensorBoard / xprof).
+
+    >>> with flox_tpu.profiling.trace("/tmp/flox-trace"):  # doctest: +SKIP
+    ...     groupby_reduce(...)
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("profiler trace written to %s", logdir)
+
+
+def annotate(name: str):
+    """Named region that shows up inside profiler traces."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def timed(label: str):
+    """Wall-clock log line for a block (host-side; includes dispatch)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        logger.info("%s took %.3f ms", label, (time.perf_counter() - t0) * 1e3)

@@ -21,11 +21,9 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from .options import OPTIONS
-
 logger = logging.getLogger("flox_tpu")
 
-__all__ = ["reshard_for_blockwise", "BlockwiseLayout", "rechunk_for_blockwise"]
+__all__ = ["reshard_for_blockwise", "BlockwiseLayout", "rechunk_for_blockwise", "rechunk_for_cohorts"]
 
 
 @dataclass(frozen=True)
@@ -128,3 +126,68 @@ def rechunk_for_blockwise(array, axis: int, labels, n_shards: int | None = None)
 
     arr = _np.moveaxis(_np.asarray(array), axis, -1) if axis not in (-1, np.ndim(array) - 1) else array
     return layout.apply(arr), layout.codes, groups
+
+
+def rechunk_for_cohorts(
+    array,
+    axis: int,
+    labels,
+    force_new_chunk_at,
+    chunksize: int | None = None,
+    debug: bool = False,
+):
+    """Chunk boundaries anchored at label-pattern starts (parity:
+    rechunk.py:64-155).
+
+    For periodic labels (day-of-year, month), placing a boundary wherever a
+    label in ``force_new_chunk_at`` begins makes every chunk hold one period
+    segment, so the same label subset recurs in the same chunk position — the
+    layout that makes cohorts maximally effective. Returns the chunk-length
+    tuple (feed it to cohorts.find_group_cohorts, or use the lengths as
+    shard sizes after reshard_for_blockwise-style padding).
+    """
+    labels = np.asarray(labels).reshape(-1)
+    n = labels.shape[0]
+    if array is not None:
+        ax_len = np.shape(array)[axis]
+        if ax_len != n:
+            raise ValueError(
+                f"labels (length {n}) do not align with array axis {axis} (length {ax_len})"
+            )
+    anchors = np.atleast_1d(np.asarray(force_new_chunk_at))
+    is_anchor = np.isin(labels, anchors)
+    # boundary at the first position of every run of an anchor label
+    starts = np.flatnonzero(is_anchor & np.r_[True, ~is_anchor[:-1]])
+    anchor_bounds = [0]
+    for pos in starts:
+        if pos == 0:
+            continue
+        # hysteresis: keep chunks near the target size (parity: the
+        # reference's chunksize tolerance, rechunk.py:104-139)
+        if chunksize is not None and (pos - anchor_bounds[-1]) < max(1, chunksize // 2):
+            continue
+        anchor_bounds.append(int(pos))
+    anchor_bounds.append(n)
+    # subdivide within periods: chunks at the SAME offset of every period
+    # then hold the same label subset — that repetition is what makes
+    # cohorts effective (one anchor-to-anchor chunk would hold the whole
+    # cycle and degrade to map-reduce). Default: ~4 chunks per period.
+    if chunksize is None and len(anchor_bounds) > 2:
+        min_period = min(b - a for a, b in zip(anchor_bounds[:-1], anchor_bounds[1:]))
+        chunksize = max(1, min_period // 4)
+    boundaries = [0]
+    for a, b in zip(anchor_bounds[:-1], anchor_bounds[1:]):
+        seg = b - a
+        if chunksize is not None and seg > chunksize:
+            nparts = -(-seg // chunksize)
+            for p in range(1, nparts):
+                boundaries.append(a + (seg * p) // nparts)
+        if b != boundaries[-1]:
+            boundaries.append(b)
+    chunks = tuple(b - a for a, b in zip(boundaries[:-1], boundaries[1:]) if b > a)
+    logger.debug(
+        "rechunk_for_cohorts: %d chunks, sizes %s...", len(chunks), chunks[:5]
+    )
+    if debug:
+        return chunks, boundaries
+    return chunks

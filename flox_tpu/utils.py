@@ -123,3 +123,21 @@ def moveaxis_to_end(array, axes: tuple[int, ...]):
     """Move ``axes`` to the trailing positions, preserving their order."""
     keep = [ax for ax in range(array.ndim) if ax not in axes]
     return array.transpose(keep + list(axes)), tuple(keep)
+
+
+def reapply_nonfinite(sums, nan_c, pos_c, neg_c):
+    """Re-apply IEEE non-finite propagation to segment sums computed on
+    zero-filled data with NaN/+inf/-inf marker counts (shared by the MXU
+    GEMM and Pallas segment-sum paths so their semantics cannot drift)."""
+    import jax.numpy as jnp
+
+    poison = (nan_c > 0) | ((pos_c > 0) & (neg_c > 0))
+    return jnp.where(
+        poison,
+        jnp.asarray(jnp.nan, sums.dtype),
+        jnp.where(
+            pos_c > 0,
+            jnp.asarray(jnp.inf, sums.dtype),
+            jnp.where(neg_c > 0, jnp.asarray(-jnp.inf, sums.dtype), sums),
+        ),
+    )

@@ -114,3 +114,105 @@ def test_reindex_inf_fill_no_promotion():
     assert out.dtype == np.int64
     assert out[0] == 2**62 and out[1] == 2**62 + 1
     assert out[2] == np.iinfo(np.int64).min
+
+
+def test_rechunk_for_cohorts_boundaries():
+    from flox_tpu.cohorts import find_group_cohorts
+    from flox_tpu.rechunk import rechunk_for_cohorts
+
+    # 3 "years" of 12 "months": anchors at month 0 + default subdivision
+    # produce repeating-position chunks that form real cohorts
+    labels = np.repeat(np.tile(np.arange(12), 3), 5)
+    chunks = rechunk_for_cohorts(None, -1, labels, force_new_chunk_at=0)
+    assert sum(chunks) == 180
+    method, mapping = find_group_cohorts(labels, chunks)
+    assert method == "cohorts" and len(mapping) > 1
+    # explicit chunksize: boundaries at period starts + ~chunksize splits
+    chunks2 = rechunk_for_cohorts(None, -1, labels, force_new_chunk_at=0, chunksize=30)
+    assert sum(chunks2) == 180 and all(c <= 30 for c in chunks2)
+    # alignment validation when an array is supplied
+    with pytest.raises(ValueError, match="align"):
+        rechunk_for_cohorts(np.zeros(10), -1, labels, force_new_chunk_at=0)
+
+
+def test_profiling_timed(caplog):
+    import logging
+
+    from flox_tpu import profiling
+
+    with caplog.at_level(logging.INFO, logger="flox_tpu"):
+        with profiling.timed("unit-test block"):
+            pass
+    assert any("unit-test block" in r.message for r in caplog.records)
+
+
+class TestDeviceGroupby:
+    """groupby_reduce_device is fully traceable (usable inside user jit)."""
+
+    def test_inside_jit(self):
+        import jax
+        import jax.numpy as jnp
+
+        from flox_tpu.device import groupby_reduce_device
+
+        vals = np.arange(24.0).reshape(2, 12)
+        months = np.arange(12) % 3
+
+        @jax.jit
+        def step(v, m):
+            return groupby_reduce_device(
+                v, m, func="nanmean", expected_values=jnp.arange(3)
+            )
+
+        out = np.asarray(step(jnp.asarray(vals), jnp.asarray(months)))
+        expected, _ = __import__("flox_tpu").groupby_reduce(
+            vals, months, func="nanmean", expected_groups=np.arange(3)
+        )
+        np.testing.assert_allclose(out, np.asarray(expected))
+
+    def test_bins_inside_jit(self):
+        import jax
+        import jax.numpy as jnp
+
+        from flox_tpu.device import groupby_reduce_device
+
+        vals = np.array([0.5, 1.5, 2.5, 3.5])
+
+        @jax.jit
+        def step(v):
+            return groupby_reduce_device(v, v, func="count", bins=jnp.array([0.0, 2.0, 4.0]))
+
+        out = np.asarray(step(jnp.asarray(vals)))
+        np.testing.assert_array_equal(out, [2, 2])
+
+    def test_multi_by(self):
+        import jax.numpy as jnp
+
+        from flox_tpu.device import groupby_reduce_device
+
+        b1 = np.array([0, 0, 1, 1])
+        b2 = np.array([0, 1, 0, 1])
+        vals = np.array([1.0, 2.0, 3.0, 4.0])
+        out = np.asarray(
+            groupby_reduce_device(
+                vals, b1, b2, func="sum",
+                expected_values=(jnp.arange(2), jnp.arange(2)),
+            )
+        )
+        np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+
+    def test_grad_through_groupby(self):
+        # differentiable: the whole pipeline is traceable
+        import jax
+        import jax.numpy as jnp
+
+        from flox_tpu.device import groupby_reduce_device
+
+        months = jnp.asarray(np.arange(6) % 2)
+
+        def loss(v):
+            means = groupby_reduce_device(v, months, func="mean", expected_values=jnp.arange(2))
+            return jnp.sum(means**2)
+
+        g = jax.grad(loss)(jnp.arange(6.0))
+        assert np.isfinite(np.asarray(g)).all()
