@@ -284,6 +284,26 @@ def groupby_reduce(
         )
     nby = len(by)
 
+    from .sparse import is_sparse_array
+
+    if is_sparse_array(array):
+        # sparse inputs reduce without densifying (parity: aggregate_sparse);
+        # options the sparse reducer cannot honor are rejected, not dropped
+        unsupported = {
+            "min_count": min_count, "axis": axis, "method": method,
+            "finalize_kwargs": finalize_kwargs, "mesh": mesh,
+        }
+        bad = [k for k, v in unsupported.items() if v is not None]
+        if bad:
+            raise NotImplementedError(
+                f"sparse inputs do not support {bad} (grouping is over the last "
+                "axis, eagerly, with the reference's aggregate_sparse func subset)"
+            )
+        return _sparse_path(
+            array, by, func=func, expected_groups=expected_groups, isbin=isbin,
+            sort=sort, fill_value=fill_value, dtype=dtype, engine=engine,
+        )
+
     # -- host-side label normalization ------------------------------------
     bys = [utils.asarray_host(b) for b in by]
     bys = list(np.broadcast_arrays(*bys)) if nby > 1 else bys
@@ -535,3 +555,28 @@ def _astype_final(result, agg: Aggregation, datetime_dtype=None):
             return res  # promoted to hold missing values
         res = res.astype(final)
     return res
+
+
+def _sparse_path(array, by, *, func, expected_groups, isbin, sort, fill_value, dtype, engine):
+    """Route BCOO inputs to the sparse reducer (grouping over the last axis,
+    1-D labels — the reference's aggregate_sparse scope)."""
+    from .sparse import sparse_groupby_reduce
+
+    if len(by) != 1:
+        raise NotImplementedError("sparse inputs support a single 1-D `by`")
+    if not isinstance(func, str):
+        raise NotImplementedError("sparse inputs support named funcs only")
+    bys = [utils.asarray_host(by[0])]
+    if bys[0].ndim != 1 or bys[0].shape[0] != array.shape[-1]:
+        raise ValueError("sparse inputs need a 1-D `by` matching the last axis")
+    expected = _normalize_expected(expected_groups, 1)
+    isbin_t = _normalize_isbin(isbin, 1)
+    expected_idx = _convert_expected_groups_to_index(expected, isbin_t, sort)
+    codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
+        bys, axes=(0,), expected_groups=expected_idx, sort=sort
+    )
+    result = sparse_groupby_reduce(
+        array, np.asarray(codes).reshape(-1), func=func, size=size,
+        fill_value=fill_value, dtype=dtype,
+    )
+    return (result,) + tuple(_index_values(g) for g in found_groups)

@@ -116,14 +116,25 @@ def groupby_reduce_device(
         func, dtype, np.dtype(str(arr.dtype)), fill_value, 0, finalize_kwargs
     )
     kw = dict(agg.finalize_kwargs)
+    kernel_dtype = None
+    if agg.name in ("sum", "nansum", "prod", "nanprod", "mean", "nanmean",
+                    "var", "nanvar", "std", "nanstd") or dtype is not None:
+        kernel_dtype = np.dtype(agg.final_dtype)
+        if not _x64():
+            # don't request 64-bit accumulation the backend cannot represent
+            if kernel_dtype.itemsize == 8 and kernel_dtype.kind in "fiu":
+                kernel_dtype = np.dtype(kernel_dtype.kind + "4")
     result = generic_kernel(
         agg.numpy[0] if isinstance(agg.numpy[0], str) else func,
         codes,
         arr_flat,
         size=size,
         fill_value=agg.final_fill_value if not _is_sentinel(agg.final_fill_value) else None,
+        dtype=kernel_dtype,
         **kw,
     )
+    if kernel_dtype is not None and result.dtype != kernel_dtype:
+        result = result.astype(kernel_dtype)
     new_dims = agg.new_dims()
     out_shape = new_dims + lead + tuple(sizes)
     return result.reshape(out_shape)
@@ -137,6 +148,12 @@ def _span_ndim(shape: tuple[int, ...], n: int) -> int:
         if prod == n:
             return i
     raise ValueError(f"`by` length {n} does not match trailing dims of array shape {shape}")
+
+
+def _x64() -> bool:
+    from . import utils
+
+    return utils.x64_enabled()
 
 
 def _is_sentinel(v) -> bool:

@@ -160,11 +160,9 @@ def _segment_sum_impl(data, size: int) -> str:
         return "scatter"
     if policy == "matmul":
         return "matmul" if _use_matmul_path("sum", data, size) else "scatter"
-    from .options import OPTIONS as _opts
-
     pallas_ok = (
         str(data.dtype) in ("float32", "bfloat16")
-        and size <= min(512, _opts["matmul_num_groups_max"])
+        and size <= OPTIONS["pallas_num_groups_max"]
         and data.shape[0] >= 8
     )
     if policy == "pallas":

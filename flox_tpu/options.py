@@ -22,6 +22,9 @@ OPTIONS: dict[str, Any] = {
     # segment-sum implementation: "auto" picks pallas on TPU backends and
     # scatter elsewhere; explicit "scatter" | "matmul" | "pallas" override
     "segment_sum_impl": "auto",
+    # group-count ceiling for the Pallas path (VMEM-bounded; independent of
+    # the matmul knob so disabling one path does not disable the other)
+    "pallas_num_groups_max": 512,
 }
 
 _VALIDATORS = {
@@ -30,6 +33,7 @@ _VALIDATORS = {
     "default_engine": lambda x: x in ("jax", "numpy"),
     "matmul_num_groups_max": lambda x: isinstance(x, int) and x >= 0,
     "segment_sum_impl": lambda x: x in ("auto", "scatter", "matmul", "pallas"),
+    "pallas_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
 }
 
 
@@ -39,7 +43,11 @@ def trace_fingerprint() -> tuple:
     Any cache of compiled programs must include this in its key, or a
     set_options() change would silently keep serving stale kernels.
     """
-    return (OPTIONS["segment_sum_impl"], OPTIONS["matmul_num_groups_max"])
+    return (
+        OPTIONS["segment_sum_impl"],
+        OPTIONS["matmul_num_groups_max"],
+        OPTIONS["pallas_num_groups_max"],
+    )
 
 
 class set_options:

@@ -1,0 +1,161 @@
+"""Grouped reductions on sparse arrays without densifying (L1).
+
+Parity target: /root/reference/flox/aggregate_sparse.py — group the *stored*
+values by (leading-position ⊗ group-of-last-axis) via a composite segment id
+(aggregate_sparse.py:71-80), reduce them densely, then fold the implicit
+fill-value contribution in algebraically using counts
+(aggregate_sparse.py:106-132). Supported funcs mirror the reference:
+``sum, nansum, min, max, nanmin, nanmax, mean, nanmean, count``
+(aggregate_sparse.py:201-206).
+
+TPU realization: the sparse container is ``jax.experimental.sparse.BCOO``
+(implicit fill value 0), the stored-value reduction is the same XLA segment
+primitive the dense engine uses, and everything stays traceable — a BCOO
+input to ``groupby_reduce`` routes here automatically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sparse_groupby_reduce", "SPARSE_FUNCS", "is_sparse_array"]
+
+SPARSE_FUNCS = frozenset(
+    {"sum", "nansum", "min", "max", "nanmin", "nanmax", "mean", "nanmean", "count"}
+)
+
+
+def is_sparse_array(x) -> bool:
+    try:
+        from jax.experimental.sparse import BCOO, BCSR
+
+        return isinstance(x, (BCOO, BCSR))
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def sparse_groupby_reduce(
+    mat,
+    codes,
+    *,
+    func: str,
+    size: int,
+    fill_value=None,
+    dtype=None,
+):
+    """Grouped reduction over the last axis of a BCOO matrix.
+
+    ``codes``: (ncols,) int with -1 = missing. Returns a DENSE
+    (..., size) result — the group axis is small by construction.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.sparse import BCSR, BCOO
+
+    if func not in SPARSE_FUNCS:
+        raise NotImplementedError(
+            f"sparse grouped {func!r} is not supported (the reference supports the "
+            f"same subset, aggregate_sparse.py:201-206): {sorted(SPARSE_FUNCS)}"
+        )
+    if isinstance(mat, BCSR):
+        mat = mat.to_bcoo()
+    if mat.n_batch or mat.n_dense:
+        raise NotImplementedError("batched/dense-suffix BCOO layouts are not supported")
+
+    codes = jnp.asarray(np.asarray(codes)).astype(jnp.int32).reshape(-1)
+    if dtype is not None:
+        mat = BCOO((mat.data.astype(dtype), mat.indices), shape=mat.shape)
+    lead_shape = mat.shape[:-1]
+    ncols = mat.shape[-1]
+    nlead = int(np.prod(lead_shape)) if lead_shape else 1
+
+    data = mat.data
+    idx = mat.indices  # (nse, ndim)
+    if lead_shape:
+        strides = np.concatenate([np.cumprod(lead_shape[::-1])[-2::-1], [1]]).astype(np.int64)
+        lead_idx = (idx[:, :-1] * jnp.asarray(strides)).sum(axis=1).astype(jnp.int32)
+    else:
+        lead_idx = jnp.zeros(idx.shape[0], dtype=jnp.int32)
+    col = idx[:, -1]
+    gcode = jnp.take(codes, col)  # (nse,)
+
+    # composite segment id over (lead, group); missing labels -> overflow slot
+    nseg = nlead * size
+    seg = jnp.where(gcode >= 0, lead_idx * size + gcode, nseg)
+
+    def _seg(op, vals):
+        fn = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min, "max": jax.ops.segment_max}[op]
+        return fn(vals, seg, num_segments=nseg + 1)[:nseg].reshape(lead_shape + (size,))
+
+    skipna = func.startswith("nan") or func == "count"
+    isnan = jnp.isnan(data) if jnp.issubdtype(data.dtype, jnp.floating) else jnp.zeros(data.shape, bool)
+
+    # per-(lead, group) stored counts; per-group total column counts
+    stored = _seg("sum", jnp.ones_like(data, dtype=jnp.int32).astype(jnp.int32))
+    stored_nan = _seg("sum", isnan.astype(jnp.int32))
+    col_counts = jax.ops.segment_sum(
+        jnp.ones_like(codes), jnp.where(codes >= 0, codes, size), num_segments=size + 1
+    )[:size]  # (size,): columns per group
+    total = jnp.broadcast_to(col_counts, lead_shape + (size,))
+    implicit = total - stored  # implicit zeros per (lead, group)
+
+    fv = jnp.nan if fill_value is None else fill_value
+
+    def _promote_for_fill(out):
+        """NaN fills force float output, as the dense path promotes."""
+        import math
+
+        fv_is_nan = isinstance(fv, float) and math.isnan(fv)
+        if fv_is_nan and not jnp.issubdtype(out.dtype, jnp.floating):
+            from . import utils as _u
+
+            return out.astype(jnp.float64 if _u.x64_enabled() else jnp.float32)
+        return out
+
+    if func in ("sum", "nansum"):
+        vals = jnp.where(isnan, 0, data) if func == "nansum" else data
+        out = _seg("sum", vals)
+        if func == "sum" and jnp.issubdtype(out.dtype, jnp.floating):
+            has_nan = stored_nan > 0
+            out = jnp.where(has_nan, jnp.asarray(jnp.nan, out.dtype), out)
+        # implicit zeros contribute 0; a user fill replaces truly empty groups
+        empty = total == 0
+        sum_fill = 0 if fill_value is None else fill_value
+        return jnp.where(empty, jnp.asarray(sum_fill).astype(out.dtype), out)
+
+    if func == "count":
+        return total - stored_nan
+
+    if func in ("mean", "nanmean"):
+        vals = jnp.where(isnan, 0, data) if func == "nanmean" else data
+        s = _seg("sum", vals)
+        denom = (total - stored_nan) if func == "nanmean" else total
+        out = s / jnp.where(denom > 0, denom, 1).astype(s.dtype)
+        out = _promote_for_fill(out)
+        if func == "mean":
+            out = jnp.where(stored_nan > 0, jnp.asarray(jnp.nan, out.dtype), out)
+        return jnp.where(denom > 0, out, jnp.asarray(fv).astype(out.dtype))
+
+    # min/max family: compare the stored extreme against the implicit zero
+    is_max = "max" in func
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        ident = -jnp.inf if is_max else jnp.inf
+    else:
+        info = np.iinfo(np.dtype(str(data.dtype)))
+        ident = info.min if is_max else info.max
+    vals = jnp.where(isnan, jnp.asarray(ident, data.dtype), data) if skipna else data
+    ext = _seg("max" if is_max else "min", vals)
+    # NaN propagation for the non-skipna variants (float data only — integer
+    # data cannot hold NaN, and asarray(nan, int) would raise)
+    if not skipna and jnp.issubdtype(ext.dtype, jnp.floating):
+        ext = jnp.where(stored_nan > 0, jnp.asarray(jnp.nan, ext.dtype), ext)
+    zero = jnp.asarray(0, ext.dtype)
+    with_fill = jnp.where(
+        implicit > 0, jnp.maximum(ext, zero) if is_max else jnp.minimum(ext, zero), ext
+    )
+    # all-stored-NaN groups with no implicit zeros -> fill
+    with_fill = _promote_for_fill(with_fill)
+    if skipna:
+        all_nan_stored = (stored_nan == stored) & (implicit == 0) & (total > 0)
+        with_fill = jnp.where(all_nan_stored, jnp.asarray(fv).astype(with_fill.dtype), with_fill)
+    return jnp.where(total > 0, with_fill, jnp.asarray(fv).astype(with_fill.dtype))

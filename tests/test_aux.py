@@ -216,3 +216,27 @@ class TestDeviceGroupby:
 
         g = jax.grad(loss)(jnp.arange(6.0))
         assert np.isfinite(np.asarray(g)).all()
+
+
+def test_device_dtype_applied():
+    from flox_tpu.device import groupby_reduce_device
+
+    out = groupby_reduce_device(
+        np.array([1, 2, 3, 4], dtype=np.int32), np.array([0, 0, 1, 1]),
+        func="sum", expected_values=np.arange(2), dtype=np.float64,
+    )
+    assert np.asarray(out).dtype.kind == "f"
+    np.testing.assert_allclose(np.asarray(out), [3.0, 7.0])
+
+
+def test_pallas_knob_independent_of_matmul_knob():
+    import jax.numpy as jnp
+
+    import flox_tpu
+    from flox_tpu.kernels import _segment_sum_impl
+
+    data = jnp.zeros((64, 4), jnp.float32)
+    with flox_tpu.set_options(segment_sum_impl="pallas", matmul_num_groups_max=0):
+        assert _segment_sum_impl(data, 12) == "pallas"
+    with flox_tpu.set_options(segment_sum_impl="pallas", pallas_num_groups_max=0):
+        assert _segment_sum_impl(data, 12) == "scatter"

@@ -1,0 +1,90 @@
+"""Sparse (BCOO) grouped reductions vs the dense path (reference:
+aggregate_sparse semantics, tests via dense equivalence)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from flox_tpu import groupby_reduce
+
+RNG = np.random.default_rng(21)
+
+FUNCS = ["sum", "nansum", "min", "max", "nanmin", "nanmax", "mean", "nanmean", "count"]
+
+
+@pytest.fixture(params=["1d", "2d", "with-nan", "nan-labels"])
+def case(request):
+    n, size = 60, 4
+    codes = RNG.integers(0, size, n).astype(np.int64)
+    dense = np.round(RNG.normal(size=(3, n)), 1)
+    dense[RNG.random((3, n)) < 0.6] = 0.0  # sparsity
+    if request.param == "1d":
+        dense = dense[0]
+    elif request.param == "with-nan":
+        dense[..., RNG.random(n) < 0.1] = np.nan
+    elif request.param == "nan-labels":
+        codes[RNG.random(n) < 0.2] = -1
+    return dense, codes, size
+
+
+@pytest.mark.parametrize("func", FUNCS)
+def test_sparse_matches_dense(case, func):
+    dense, codes, size = case
+    mat = jsparse.BCOO.fromdense(jnp.asarray(dense))
+    got, groups = groupby_reduce(mat, codes, func=func)
+    expected, groups2 = groupby_reduce(dense, codes, func=func, engine="jax")
+    np.testing.assert_array_equal(np.asarray(groups), np.asarray(groups2))
+    np.testing.assert_allclose(
+        np.asarray(got).astype(float), np.asarray(expected).astype(float),
+        rtol=1e-10, atol=1e-12, equal_nan=True,
+    )
+
+
+def test_sparse_expected_groups():
+    dense = np.array([1.0, 0.0, 2.0, 0.0])
+    codes = np.array([0, 0, 2, 2])
+    mat = jsparse.BCOO.fromdense(jnp.asarray(dense))
+    got, groups = groupby_reduce(mat, codes, func="sum", expected_groups=np.array([0, 1, 2]))
+    np.testing.assert_allclose(np.asarray(got), [1.0, 0.0, 2.0])
+    np.testing.assert_array_equal(groups, [0, 1, 2])
+
+
+def test_sparse_unsupported_func():
+    mat = jsparse.BCOO.fromdense(jnp.ones((4,)))
+    with pytest.raises(NotImplementedError, match="sparse grouped"):
+        groupby_reduce(mat, np.array([0, 0, 1, 1]), func="var")
+
+
+def test_sparse_int_minmax_empty_group_promotes():
+    # empty group with default NaN fill must promote, not write garbage ints
+    dense = np.array([3, 0, 5, 0], dtype=np.int32)
+    mat = jsparse.BCOO.fromdense(jnp.asarray(dense))
+    got, _ = groupby_reduce(mat, np.array([0, 0, 2, 2]), func="min",
+                            expected_groups=np.array([0, 1, 2]))
+    got = np.asarray(got)
+    assert got.dtype.kind == "f" and np.isnan(got[1])
+    np.testing.assert_allclose(got[[0, 2]], [0.0, 0.0])  # implicit zeros win the min
+
+
+def test_sparse_sum_fill_value():
+    dense = np.array([1.0, 0.0, 2.0, 0.0])
+    mat = jsparse.BCOO.fromdense(jnp.asarray(dense))
+    got, _ = groupby_reduce(mat, np.array([0, 0, 2, 2]), func="sum",
+                            expected_groups=np.array([0, 1, 2]), fill_value=-999.0)
+    np.testing.assert_allclose(np.asarray(got), [1.0, -999.0, 2.0])
+
+
+def test_sparse_rejects_unsupported_kwargs():
+    mat = jsparse.BCOO.fromdense(jnp.ones((4,)))
+    with pytest.raises(NotImplementedError, match="min_count"):
+        groupby_reduce(mat, np.array([0, 0, 1, 1]), func="nansum", min_count=2)
+
+
+def test_sparse_int_sum_fill():
+    # integer data: NaN-injection must not be constructed for int dtypes
+    mat = jsparse.BCOO.fromdense(jnp.asarray(np.array([3, 0, 5, 0], dtype=np.int32)))
+    got, _ = groupby_reduce(mat, np.array([0, 0, 2, 2]), func="sum",
+                            expected_groups=np.arange(3), fill_value=-999)
+    np.testing.assert_array_equal(np.asarray(got), [3, -999, 5])
