@@ -344,8 +344,8 @@ def groupby_reduce(
     keep_by_shape = tuple(bys[0].shape[: len(by_keep)])
 
     # -- factorize (host) --------------------------------------------------
-    codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
-        bys, axes=tuple(range(len(by_keep), bndim)), expected_groups=expected_idx, sort=sort
+    codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_cached(
+        tuple(bys), axes=tuple(range(len(by_keep), bndim)), expected_groups=expected_idx, sort=sort
     )
     logger.debug(
         "groupby_reduce: func=%s ngroups=%d size=%d offset=%s engine=%s",

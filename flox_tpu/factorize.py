@@ -29,7 +29,7 @@ import pandas as pd
 from . import utils
 from .types import FactorProps
 
-__all__ = ["factorize_", "factorize_single", "factorize_device", "bin_device"]
+__all__ = ["factorize_", "factorize_cached", "factorize_single", "factorize_device", "bin_device"]
 
 
 def _view_if_datetime(values: np.ndarray) -> np.ndarray:
@@ -218,3 +218,69 @@ def bin_device(by, edges, closed: str = "right"):
         codes = jnp.searchsorted(edges, by, side="right") - 1
         valid = (by >= edges[0]) & (by < edges[-1])
     return jnp.where(valid, codes, -1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# memoized factorization: repeated reductions over the same labels (e.g. a
+# per-step climatology) skip the pandas factorize entirely (the reference
+# gets the same effect from dask token-level caching of the graph)
+# ---------------------------------------------------------------------------
+
+_FACTORIZE_CACHE: "dict" = {}  # insertion-ordered: oldest first
+_FACTORIZE_CACHE_BYTES = [0]
+_FACTORIZE_MAX_INPUT_BYTES = 1 << 26  # don't fingerprint labels over 64 MB
+_FACTORIZE_BUDGET_BYTES = 1 << 28  # cached codes arrays: 256 MB total
+
+
+def _fingerprint_array(a: np.ndarray) -> tuple:
+    import hashlib
+
+    if not a.flags["C_CONTIGUOUS"] and a.nbytes > (1 << 24):
+        # hashing would first materialize a large copy; not worth it
+        raise TypeError("skip cache: large non-contiguous labels")
+    return (a.shape, a.dtype.str, hashlib.sha1(np.ascontiguousarray(a)).hexdigest())
+
+
+def _fingerprint_index(idx) -> tuple | None:
+    if idx is None:
+        return None
+    if isinstance(idx, pd.IntervalIndex):
+        return ("interval", idx.closed, _fingerprint_array(np.asarray(idx.left)),
+                _fingerprint_array(np.asarray(idx.right)))
+    return ("index", _fingerprint_array(np.asarray(idx.values)))
+
+
+def factorize_cached(by, axes, expected_groups=None, *, sort: bool = True):
+    """Memoizing wrapper over :func:`factorize_` (same signature/returns).
+
+    Byte-budgeted LRU: entries are evicted oldest-first once the cached
+    codes arrays exceed the budget, so a cycling workload cannot pin
+    unbounded memory and hot entries survive eviction of cold ones.
+    """
+    total = sum(np.asarray(b).nbytes for b in by)
+    if total > _FACTORIZE_MAX_INPUT_BYTES:
+        return factorize_(by, axes, expected_groups, sort=sort)
+    try:
+        key = (
+            tuple(_fingerprint_array(np.asarray(b)) for b in by),
+            tuple(axes),
+            None if expected_groups is None else tuple(_fingerprint_index(e) for e in expected_groups),
+            sort,
+        )
+    except TypeError:  # exotic/large-noncontiguous labels: just compute
+        return factorize_(by, axes, expected_groups, sort=sort)
+    hit = _FACTORIZE_CACHE.get(key)
+    if hit is not None:
+        # refresh LRU position
+        _FACTORIZE_CACHE[key] = _FACTORIZE_CACHE.pop(key)
+        return hit
+    out = factorize_(by, axes, expected_groups, sort=sort)
+    _FACTORIZE_CACHE[key] = out
+    _FACTORIZE_CACHE_BYTES[0] += int(np.asarray(out[0]).nbytes)
+    # evict oldest-first until the cached codes fit the byte budget (dicts
+    # preserve insertion order; hits re-insert, so hot entries survive)
+    while _FACTORIZE_CACHE_BYTES[0] > _FACTORIZE_BUDGET_BYTES and len(_FACTORIZE_CACHE) > 1:
+        oldest = next(iter(_FACTORIZE_CACHE))
+        evicted = _FACTORIZE_CACHE.pop(oldest)
+        _FACTORIZE_CACHE_BYTES[0] -= int(np.asarray(evicted[0]).nbytes)
+    return out

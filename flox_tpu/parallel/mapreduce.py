@@ -180,6 +180,28 @@ def _pad_to(n: int, multiple: int) -> int:
     return (-n) % multiple
 
 
+def _norm_axes(axis_name, mesh=None) -> tuple[str, ...]:
+    """Accept a single mesh axis name or a tuple (e.g. ("dcn", "ici"));
+    validates against the mesh when given."""
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    if mesh is not None:
+        missing = [a for a in axes if a not in mesh.shape]
+        if missing:
+            raise ValueError(f"mesh has no axes {missing}; mesh axes: {tuple(mesh.shape)}")
+    return axes
+
+
+def _flat_axis_index(axes: tuple[str, ...]):
+    """Flattened device index across mesh axes, major-to-minor — matches the
+    order PartitionSpec((a0, a1)) shards the data axis."""
+    import jax
+
+    idx = jax.lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+    return idx
+
+
 @functools.lru_cache(maxsize=256)
 def _cached_mesh_default():
     return make_mesh()
@@ -208,7 +230,8 @@ def sharded_groupby_reduce(
 
     if mesh is None:
         mesh = _cached_mesh_default()
-    ndev = mesh.devices.size
+    axes = _norm_axes(axis_name, mesh)
+    ndev = int(np.prod([mesh.shape[a] for a in axes]))
 
     if agg.blockwise_only and method != "blockwise":
         raise NotImplementedError(
@@ -251,22 +274,23 @@ def sharded_groupby_reduce(
     # pad the group axis for psum_scatter ownership slicing
     size_pad = size + _pad_to(n=size, multiple=ndev) if method == "cohorts" else size
 
+    spec_entry = axes if len(axes) > 1 else axes[0]
     in_specs = (
-        P(*([None] * (arr.ndim - 1) + [axis_name])),
-        P(axis_name),
+        P(*([None] * (arr.ndim - 1) + [spec_entry])),
+        P(spec_entry),
     )
     out_specs = P()  # replicated
 
     from ..options import trace_fingerprint
 
     cache_key = (
-        _agg_cache_key(agg), size, size_pad, method, axis_name, shard_len, nat,
+        _agg_cache_key(agg), size, size_pad, method, axes, shard_len, nat,
         mesh, arr.ndim, trace_fingerprint(),
     )
     fn = _PROGRAM_CACHE.get(cache_key)
     if fn is None:
         program = _build_program(
-            agg, size=size, size_pad=size_pad, method=method, axis_name=axis_name,
+            agg, size=size, size_pad=size_pad, method=method, axis_name=axes,
             shard_len=shard_len, nat=nat,
         )
         # check_vma=False: outputs are replicated by construction (psum /
@@ -382,7 +406,7 @@ def _build_program(agg, *, size, size_pad, method, axis_name, shard_len, nat):
                 fill_value=agg.fill_value["intermediate"][0], **kw,
             )
             local_arg = generic_kernel(arg_f, codes_sh, arr_sh, size=size, fill_value=-1, **kw)
-            offset = jax.lax.axis_index(axis_name).astype(jnp.int64 if utils.x64_enabled() else jnp.int32) * shard_len
+            offset = _flat_axis_index(axis_name).astype(jnp.int64 if utils.x64_enabled() else jnp.int32) * shard_len
             gidx = jnp.where(local_arg >= 0, local_arg + offset, -1)
             gv, garg = _combine_arg(
                 val, gidx, axis_name, arg_of_max="max" in agg.chunk[1],
@@ -392,7 +416,7 @@ def _build_program(agg, *, size, size_pad, method, axis_name, shard_len, nat):
 
         if agg.combine == ("first",) or agg.combine == ("last",):
             last = agg.combine == ("last",)
-            offset = jax.lax.axis_index(axis_name).astype(jnp.int32) * shard_len
+            offset = _flat_axis_index(axis_name).astype(jnp.int32) * shard_len
             val, pos = _local_firstlast(
                 codes_sh, arr_sh, size, skipna=skipna, last=last, nat=nat, offset=offset
             )
@@ -475,7 +499,7 @@ def _build_program(agg, *, size, size_pad, method, axis_name, shard_len, nat):
         ]
         result_local = locals_[1] if agg.reduction_type == "argreduce" and len(locals_) > 1 else locals_[0]
         if agg.reduction_type == "argreduce":
-            offset = jax.lax.axis_index(axis_name).astype(jnp.int32) * shard_len
+            offset = _flat_axis_index(axis_name).astype(jnp.int32) * shard_len
             result_local = jnp.where(result_local >= 0, result_local + offset, -1)
         # owner = the shard that saw this group's elements (precondition:
         # exactly one, after reshard_for_blockwise)

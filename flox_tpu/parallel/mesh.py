@@ -12,12 +12,25 @@ from __future__ import annotations
 import numpy as np
 
 
-def make_mesh(n_devices: int | None = None, axis_name: str = "data"):
-    """A 1-D mesh over the first ``n_devices`` devices (default: all)."""
+def make_mesh(n_devices: int | None = None, axis_name: str = "data", *, shape=None, axis_names=None):
+    """A 1-D mesh over the first ``n_devices`` devices (default: all), or a
+    multi-axis mesh via ``shape``/``axis_names`` — e.g.
+    ``make_mesh(shape=(n_hosts, 8), axis_names=("dcn", "ici"))`` for
+    multi-host: the reduction axis is then sharded over BOTH axes
+    (pass ``axis_name=("dcn", "ici")`` to groupby_reduce) and psum rides ICI
+    within a host and DCN across.
+    """
     import jax
     from jax.sharding import Mesh
 
     devices = jax.devices()
+    if shape is not None:
+        if axis_names is None or len(axis_names) != len(shape):
+            raise ValueError("axis_names must match shape")
+        need = int(np.prod(shape))
+        if need > len(devices):
+            raise ValueError(f"Requested {need} devices; only {len(devices)} available.")
+        return Mesh(np.asarray(devices[:need]).reshape(shape), tuple(axis_names))
     if n_devices is None:
         n_devices = len(devices)
     if n_devices > len(devices):

@@ -25,7 +25,7 @@ import numpy as np
 from .. import utils
 from ..aggregations import Scan
 from .mesh import make_mesh
-from .mapreduce import _cached_mesh_default, _pad_to
+from .mapreduce import _cached_mesh_default, _flat_axis_index, _norm_axes, _pad_to
 
 _SCAN_CACHE: dict = {}
 
@@ -48,7 +48,8 @@ def sharded_groupby_scan(
 
     if mesh is None:
         mesh = _cached_mesh_default()
-    ndev = mesh.devices.size
+    axes = _norm_axes(axis_name, mesh)
+    ndev = int(np.prod([mesh.shape[a] for a in axes]))
 
     arr = utils.asarray_device(array)
     if dtype is not None:
@@ -61,15 +62,16 @@ def sharded_groupby_scan(
         widths = [(0, 0)] * (arr.ndim - 1) + [(0, pad)]
         arr = jnp.pad(arr, widths)
 
-    in_specs = (P(*([None] * (arr.ndim - 1) + [axis_name])), P(axis_name))
-    out_specs = P(*([None] * (arr.ndim - 1) + [axis_name]))
+    spec_entry = axes if len(axes) > 1 else axes[0]
+    in_specs = (P(*([None] * (arr.ndim - 1) + [spec_entry])), P(spec_entry))
+    out_specs = P(*([None] * (arr.ndim - 1) + [spec_entry]))
 
     from ..options import trace_fingerprint
 
-    cache_key = (scan.name, size, axis_name, mesh, arr.ndim, str(arr.dtype), trace_fingerprint())
+    cache_key = (scan.name, size, axes, mesh, arr.ndim, str(arr.dtype), trace_fingerprint())
     fn = _SCAN_CACHE.get(cache_key)
     if fn is None:
-        program = _build_scan_program(scan, size=size, axis_name=axis_name)
+        program = _build_scan_program(scan, size=size, axis_name=axes)
         fn = jax.jit(
             jax.shard_map(program, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
         )
@@ -104,7 +106,7 @@ def _build_scan_program(scan: Scan, *, size, axis_name):
             # every carry through NaN * 0.
             gathered = jax.lax.all_gather(block, axis_name)  # (ndev, ..., size)
             ndev = gathered.shape[0]
-            me = jax.lax.axis_index(axis_name)
+            me = _flat_axis_index(axis_name)
             mask = (jnp.arange(ndev) < me).reshape((ndev,) + (1,) * (gathered.ndim - 1))
             carry = jnp.sum(
                 jnp.where(mask, gathered, jnp.zeros((), gathered.dtype)), axis=0
@@ -134,7 +136,7 @@ def _build_scan_program(scan: Scan, *, size, axis_name):
         g_vals = jax.lax.all_gather(last_val, axis_name)  # (ndev, ..., size)
         g_valid = jax.lax.all_gather(valid_f > 0, axis_name)
         ndev = g_vals.shape[0]
-        me = jax.lax.axis_index(axis_name)
+        me = _flat_axis_index(axis_name)
         before = (jnp.arange(ndev) < me) if not reverse else (jnp.arange(ndev) > me)
         before = before.reshape((ndev,) + (1,) * (g_vals.ndim - 1))
         eligible = g_valid & before

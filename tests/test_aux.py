@@ -240,3 +240,42 @@ def test_pallas_knob_independent_of_matmul_knob():
         assert _segment_sum_impl(data, 12) == "pallas"
     with flox_tpu.set_options(segment_sum_impl="pallas", pallas_num_groups_max=0):
         assert _segment_sum_impl(data, 12) == "scatter"
+
+
+def test_factorize_cache_byte_budget():
+    from flox_tpu import factorize as fct
+
+    fct._FACTORIZE_CACHE.clear()
+    fct._FACTORIZE_CACHE_BYTES[0] = 0
+    old_budget = fct._FACTORIZE_BUDGET_BYTES
+    try:
+        fct._FACTORIZE_BUDGET_BYTES = 3000  # tiny budget
+        for i in range(10):
+            labels = (np.arange(200) % (i + 2)).astype(np.int64)  # 1600B codes each
+            fct.factorize_cached((labels,), axes=(0,))
+        assert fct._FACTORIZE_CACHE_BYTES[0] <= 3200  # at most budget + one entry
+        assert len(fct._FACTORIZE_CACHE) <= 2
+        # hot entry survives: re-use the last labels, then add another
+        labels = (np.arange(200) % 11).astype(np.int64)
+        r1 = fct.factorize_cached((labels,), axes=(0,))
+        r2 = fct.factorize_cached((labels,), axes=(0,))
+        assert r1 is r2
+    finally:
+        fct._FACTORIZE_BUDGET_BYTES = old_budget
+        fct._FACTORIZE_CACHE.clear()
+        fct._FACTORIZE_CACHE_BYTES[0] = 0
+
+
+def test_scan_bad_axis_errors():
+    import jax
+
+    from flox_tpu.aggregations import SCANS
+    from flox_tpu.parallel import make_mesh
+    from flox_tpu.parallel.scan import sharded_groupby_scan
+
+    mesh2 = make_mesh(shape=(2, 4), axis_names=("dcn", "ici"))
+    with pytest.raises(ValueError, match="no axes"):
+        sharded_groupby_scan(
+            np.arange(16.0), np.arange(16) % 2, SCANS["cumsum"], size=2,
+            mesh=mesh2, axis_name="bogus",
+        )

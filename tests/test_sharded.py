@@ -201,3 +201,54 @@ def test_sharded_program_cache(mesh):
     for _ in range(3):
         groupby_reduce(vals, labels, func="nanmean", method="map-reduce", mesh=mesh)
     assert len(_PROGRAM_CACHE) == 1
+
+
+@pytest.mark.parametrize("func", ["nanmean", "nanvar", "max", "nanargmax", "first"])
+def test_two_axis_mesh(func):
+    # 2-D (dcn, ici)-style mesh: the reduced axis shards over both axes
+    mesh2 = make_mesh(shape=(2, 4), axis_names=("dcn", "ici"))
+    n = 103
+    codes = RNG.integers(0, 5, n).astype(np.int64)
+    values = _data((n,), True, n)
+    eager, _ = groupby_reduce(values, codes, func=func, engine="jax")
+    sharded, _ = groupby_reduce(
+        values, codes, func=func, method="map-reduce", mesh=mesh2,
+        axis_name=("dcn", "ici"),
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded).astype(np.float64),
+        np.asarray(eager).astype(np.float64),
+        rtol=1e-12, atol=1e-12, equal_nan=True,
+    )
+
+
+def test_two_axis_mesh_cohorts_and_scan():
+    mesh2 = make_mesh(shape=(2, 4), axis_names=("dcn", "ici"))
+    n = 96
+    codes = RNG.integers(0, 6, n).astype(np.int64)
+    values = _data((n,), False, n)
+    eager, _ = groupby_reduce(values, codes, func="nansum", engine="jax")
+    sharded, _ = groupby_reduce(
+        values, codes, func="nansum", method="cohorts", mesh=mesh2,
+        axis_name=("dcn", "ici"),
+    )
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(eager), rtol=1e-12, atol=1e-14)
+    # distributed scan over the 2-D mesh
+    from flox_tpu.parallel.scan import sharded_groupby_scan
+    from flox_tpu.aggregations import SCANS
+
+    out = np.asarray(
+        sharded_groupby_scan(values, codes, SCANS["cumsum"], size=6, mesh=mesh2,
+                             axis_name=("dcn", "ici"))
+    )
+    eager_s = np.asarray(groupby_scan(values, codes, func="cumsum", engine="jax"))
+    np.testing.assert_allclose(out, eager_s, rtol=1e-12, atol=1e-14)
+
+
+def test_mesh_missing_axis_errors():
+    mesh2 = make_mesh(shape=(2, 4), axis_names=("dcn", "ici"))
+    with pytest.raises(ValueError, match="no axes"):
+        groupby_reduce(
+            np.arange(16.0), np.arange(16) % 2, func="sum",
+            method="map-reduce", mesh=mesh2, axis_name="bogus",
+        )
