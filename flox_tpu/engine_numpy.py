@@ -32,6 +32,21 @@ def _scatter(ufunc, codes, data, valid, size, init, dtype=None):
     return out
 
 
+def _apply_fill(out, codes, valid, size, fill_value, identity=None):
+    """Replace groups with no labelled elements by ``fill_value`` (shared by
+    the add-like, count, and bool kernels so promotion rules stay aligned).
+    ``out`` is (size, ...); returns possibly-promoted array."""
+    if fill_value is None or (identity is not None and fill_value == identity):
+        return out
+    present = np.bincount(codes[valid], minlength=size) > 0
+    present = np.broadcast_to(
+        present.reshape((size,) + (1,) * (out.ndim - 1)), out.shape
+    )
+    if _nanlike(fill_value) and not np.issubdtype(out.dtype, np.floating):
+        out = out.astype(np.float64)
+    return np.where(present, out, fill_value)
+
+
 def _nanlike(v) -> bool:
     try:
         return bool(np.isnan(v))
@@ -59,15 +74,7 @@ def _make_addlike(ufunc, identity, skipna):
         if dtype is not None:
             data = data.astype(dtype, copy=False)
         out = _scatter(ufunc, codes, data, valid, size, identity, dtype)
-        if fill_value is not None and fill_value != identity:
-            present = np.bincount(codes[valid], minlength=size) > 0
-            if _nanlike(fill_value) and not np.issubdtype(out.dtype, np.floating):
-                out = out.astype(np.float64)
-            out = np.where(
-                np.broadcast_to(present.reshape((size,) + (1,) * (out.ndim - 1)), out.shape),
-                out,
-                fill_value,
-            )
+        out = _apply_fill(out, codes, valid, size, fill_value, identity)
         return np.moveaxis(out, 0, -1)
 
     return kernel
@@ -143,6 +150,7 @@ def nanlen(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw
     else:
         out = np.zeros((size,) + data.shape[1:], dtype=dtype or np.intp)
         np.add.at(out, codes[valid], mask[valid].astype(out.dtype))
+    out = _apply_fill(out, codes, valid, size, fill_value, identity=0)
     return np.moveaxis(out, 0, -1)
 
 
@@ -264,13 +272,7 @@ def all_(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
     codes, data, valid = _prep(group_idx, array)
     out = np.ones((size,) + data.shape[1:], dtype=bool)
     np.logical_and.at(out, codes[valid], data[valid].astype(bool))
-    present = np.bincount(codes[valid], minlength=size) > 0
-    if fill_value is not None:
-        out = np.where(
-            np.broadcast_to(present.reshape((size,) + (1,) * (out.ndim - 1)), out.shape),
-            out,
-            fill_value,
-        )
+    out = _apply_fill(out, codes, valid, size, fill_value)
     return np.moveaxis(out, 0, -1)
 
 
@@ -278,13 +280,7 @@ def any_(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
     codes, data, valid = _prep(group_idx, array)
     out = np.zeros((size,) + data.shape[1:], dtype=bool)
     np.logical_or.at(out, codes[valid], data[valid].astype(bool))
-    present = np.bincount(codes[valid], minlength=size) > 0
-    if fill_value is not None:
-        out = np.where(
-            np.broadcast_to(present.reshape((size,) + (1,) * (out.ndim - 1)), out.shape),
-            out,
-            fill_value,
-        )
+    out = _apply_fill(out, codes, valid, size, fill_value)
     return np.moveaxis(out, 0, -1)
 
 
@@ -391,6 +387,8 @@ def _orderstat_loop(group_idx, array, *, size, fill_value, func):
         res = func(grp)
         if out is None:
             out = np.full((size,) + np.shape(res), fill_value if fill_value is not None else np.nan, dtype=np.result_type(np.float64, data.dtype))
+        if grp.shape[0] == 0:
+            continue  # leave the fill for empty groups
         out[g] = res
     if out is None:
         out = np.full((size,) + first_shape, fill_value if fill_value is not None else np.nan)

@@ -25,6 +25,9 @@ OPTIONS: dict[str, Any] = {
     # group-count ceiling for the Pallas path (VMEM-bounded; independent of
     # the matmul knob so disabling one path does not disable the other)
     "pallas_num_groups_max": 512,
+    # Kahan-compensated accumulation across Pallas tiles (f32 accuracy on
+    # hardware without float64)
+    "pallas_compensated": True,
 }
 
 _VALIDATORS = {
@@ -34,6 +37,7 @@ _VALIDATORS = {
     "matmul_num_groups_max": lambda x: isinstance(x, int) and x >= 0,
     "segment_sum_impl": lambda x: x in ("auto", "scatter", "matmul", "pallas"),
     "pallas_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
+    "pallas_compensated": lambda x: isinstance(x, bool),
 }
 
 
@@ -47,6 +51,7 @@ def trace_fingerprint() -> tuple:
         OPTIONS["segment_sum_impl"],
         OPTIONS["matmul_num_groups_max"],
         OPTIONS["pallas_num_groups_max"],
+        OPTIONS["pallas_compensated"],
     )
 
 

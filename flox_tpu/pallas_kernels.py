@@ -36,7 +36,10 @@ def pallas_available() -> bool:
         return False
 
 
-def _kernel(codes_ref, data_ref, out_ref, nan_ref, pos_ref, neg_ref, *, size_p, n_tile):
+def _kernel(
+    codes_ref, data_ref, out_ref, nan_ref, pos_ref, neg_ref, comp_ref=None,
+    *, size_p, n_tile, compensated,
+):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -49,6 +52,8 @@ def _kernel(codes_ref, data_ref, out_ref, nan_ref, pos_ref, neg_ref, *, size_p, 
         nan_ref[:] = jnp.zeros_like(nan_ref)
         pos_ref[:] = jnp.zeros_like(pos_ref)
         neg_ref[:] = jnp.zeros_like(neg_ref)
+        if compensated:
+            comp_ref[:] = jnp.zeros_like(comp_ref)
 
     codes = codes_ref[0, :]  # (n_tile,)
     data = data_ref[:]  # (n_tile, k_tile)
@@ -61,31 +66,48 @@ def _kernel(codes_ref, data_ref, out_ref, nan_ref, pos_ref, neg_ref, *, size_p, 
     isneg = jnp.isneginf(data)
     zeroed = jnp.where(isnan | ispos | isneg, jnp.zeros((), data.dtype), data)
 
-    def acc(ref, tile):
-        ref[:] += jax.lax.dot_general(
+    def contract(tile):
+        return jax.lax.dot_general(
             onehot,
             tile,
             dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=ref.dtype,
+            preferred_element_type=out_ref.dtype,
             precision=jax.lax.Precision.HIGHEST,
         )
 
-    acc(out_ref, zeroed)
-    acc(nan_ref, isnan.astype(data.dtype))
-    acc(pos_ref, ispos.astype(data.dtype))
-    acc(neg_ref, isneg.astype(data.dtype))
+    if compensated:
+        # Kahan summation across the sequential n-grid: recovers most of the
+        # bits a plain f32 running sum loses over many tiles — the accuracy
+        # story on TPUs, where float64 hardware does not exist (the eager
+        # CPU path gets true f64 via jax_enable_x64 instead).
+        y = contract(zeroed) - comp_ref[:]
+        t = out_ref[:] + y
+        comp_ref[:] = (t - out_ref[:]) - y
+        out_ref[:] = t
+    else:
+        out_ref[:] += contract(zeroed)
+    nan_ref[:] += contract(isnan.astype(data.dtype))
+    pos_ref[:] += contract(ispos.astype(data.dtype))
+    neg_ref[:] += contract(isneg.astype(data.dtype))
 
 
 @functools.lru_cache(maxsize=128)
-def _build(n_pad: int, k_pad: int, size_p: int, dtype_str: str, n_tile: int, k_tile: int, interpret: bool):
+def _build(
+    n_pad: int, k_pad: int, size_p: int, dtype_str: str, n_tile: int, k_tile: int,
+    interpret: bool, compensated: bool,
+):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    kern = functools.partial(_kernel, size_p=size_p, n_tile=n_tile)
+    kern = functools.partial(_kernel, size_p=size_p, n_tile=n_tile, compensated=compensated)
     grid = (k_pad // k_tile, n_pad // n_tile)
     dtype = jnp.dtype(dtype_str)
-    out_shape = [jax.ShapeDtypeStruct((size_p, k_pad), dtype)] * 4
+    # the Kahan compensation term rides as a 5th output block (revisited per
+    # k-tile like the sums); pallas scratch does not persist across the k
+    # grid axis, an output block does. Uncompensated builds skip it entirely.
+    n_out = 5 if compensated else 4
+    out_shape = [jax.ShapeDtypeStruct((size_p, k_pad), dtype)] * n_out
 
     fn = pl.pallas_call(
         kern,
@@ -94,20 +116,27 @@ def _build(n_pad: int, k_pad: int, size_p: int, dtype_str: str, n_tile: int, k_t
             pl.BlockSpec((1, n_tile), lambda i, j: (0, j)),  # codes
             pl.BlockSpec((n_tile, k_tile), lambda i, j: (j, i)),  # data
         ],
-        out_specs=[pl.BlockSpec((size_p, k_tile), lambda i, j: (0, i))] * 4,
+        out_specs=[pl.BlockSpec((size_p, k_tile), lambda i, j: (0, i))] * n_out,
         out_shape=out_shape,
         interpret=interpret,
     )
     return jax.jit(fn)
 
 
-def segment_sum_pallas(data, codes, size: int, *, interpret: bool = False):
+def segment_sum_pallas(data, codes, size: int, *, interpret: bool = False, compensated: bool | None = None):
     """Segment-sum ``data`` (N, K...) by ``codes`` (N,) -> (size, K...).
 
     Exact IEEE semantics (NaN/±inf propagate per group+column); missing
     labels (code outside [0, size)) drop out. f32/bf16 only.
+    ``compensated`` (default: the ``pallas_compensated`` option) applies
+    Kahan summation across tiles.
     """
     import jax.numpy as jnp
+
+    if compensated is None:
+        from .options import OPTIONS
+
+        compensated = OPTIONS["pallas_compensated"]
 
     data = jnp.asarray(data)
     orig_shape = data.shape
@@ -127,8 +156,10 @@ def segment_sum_pallas(data, codes, size: int, *, interpret: bool = False):
     codes_p = jnp.pad(codes, (0, n_pad - n), constant_values=size_p).reshape(1, n_pad)
     flat_p = jnp.pad(flat, ((0, n_pad - n), (0, k_pad - k)))
 
-    fn = _build(n_pad, k_pad, size_p, str(flat.dtype), n_tile, k_tile, interpret)
-    sums, nan_c, pos_c, neg_c = fn(codes_p, flat_p)
+    fn = _build(
+        n_pad, k_pad, size_p, str(flat.dtype), n_tile, k_tile, interpret, bool(compensated)
+    )
+    sums, nan_c, pos_c, neg_c, *_comp = fn(codes_p, flat_p)
 
     from .utils import reapply_nonfinite
 

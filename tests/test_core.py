@@ -352,3 +352,66 @@ def test_jit_bundle_cache_stable():
 def test_invalid_method():
     with pytest.raises(ValueError, match="method"):
         groupby_reduce(np.arange(4.0), np.array([0, 1, 0, 1]), func="sum", method="bogus")
+
+
+# --- dtype preservation matrix (reference test_core.py:1135-1176) -----------
+
+
+DTYPE_FUNCS_PRESERVING = ["max", "nanmax", "min", "nanmin", "first", "last", "nanfirst", "nanlast"]
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.float32, np.float64])
+@pytest.mark.parametrize("func", DTYPE_FUNCS_PRESERVING)
+def test_dtype_preserved(engine, func, dtype):
+    labels = np.array([0, 1, 0, 1])
+    vals = np.array([4, 1, 3, 2], dtype=dtype)
+    result, _ = groupby_reduce(vals, labels, func=func, engine=engine)
+    assert np.asarray(result).dtype == np.dtype(dtype), (func, dtype)
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.float32, np.float64])
+def test_dtype_sum_promotes_ints(engine, dtype):
+    labels = np.array([0, 1, 0, 1])
+    vals = np.array([4, 1, 3, 2], dtype=dtype)
+    result, _ = groupby_reduce(vals, labels, func="sum", engine=engine)
+    got = np.asarray(result).dtype
+    if np.dtype(dtype).kind == "i":
+        assert got.kind == "i" and got.itemsize >= 4
+    else:
+        assert got == np.dtype(dtype)
+
+
+@pytest.mark.parametrize("func", ["mean", "nanmean", "var", "nanvar"])
+def test_dtype_mean_of_ints_is_float(engine, func):
+    labels = np.array([0, 1, 0, 1])
+    vals = np.array([4, 1, 3, 2], dtype=np.int64)
+    result, _ = groupby_reduce(vals, labels, func=func, engine=engine)
+    assert np.asarray(result).dtype.kind == "f"
+
+
+def test_dtype_count_is_int(engine):
+    result, _ = groupby_reduce(
+        np.array([1.0, 2.0]), np.array([0, 1]), func="count", engine=engine
+    )
+    assert np.asarray(result).dtype.kind == "i"
+
+
+# --- fill_value behaviour across funcs (reference test_core.py:1109-1133) ---
+
+
+FILL_FUNCS = ["sum", "nansum", "prod", "mean", "nanmean", "max", "nanmin", "var",
+              "std", "count", "first", "nanlast", "median", "nanquantile"]
+
+
+@pytest.mark.parametrize("func", FILL_FUNCS)
+def test_fill_value_applied_to_absent_groups(engine, func):
+    labels = np.array([0, 0, 2, 2])
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    fkw = {"q": 0.5} if "quantile" in func else {}
+    result, _ = groupby_reduce(
+        vals, labels, func=func, engine=engine,
+        expected_groups=np.array([0, 1, 2]), fill_value=-123.0, finalize_kwargs=fkw,
+    )
+    res = np.asarray(result).astype(float)
+    assert res[1] == -123.0, (func, res)
+    assert res[0] != -123.0 and res[2] != -123.0
