@@ -57,6 +57,16 @@ def groupby_scan(
         raise ValueError("groupby_scan supports a single axis only (like the reference).")
     if method not in (None, "blelloch", "blockwise"):
         raise ValueError(f"scan method must be None, 'blelloch' or 'blockwise'; got {method!r}")
+    if method is None and mesh is not None:
+        if engine is not None:
+            raise ValueError(
+                "engine= selects a single-device kernel but mesh= requests "
+                "distributed execution; pass method='blelloch' (engine is "
+                "ignored on the mesh) or drop one of the two."
+            )
+        # a mesh without a method means distributed: Blelloch is the general
+        # scan (parity: _choose_scan_method, reference scan.py:48-78)
+        method = "blelloch"
     engine = engine or OPTIONS["default_engine"]
     nby = len(by)
 
