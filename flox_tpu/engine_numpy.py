@@ -42,7 +42,10 @@ def _apply_fill(out, codes, valid, size, fill_value, identity=None):
     present = np.broadcast_to(
         present.reshape((size,) + (1,) * (out.ndim - 1)), out.shape
     )
-    if _nanlike(fill_value) and not np.issubdtype(out.dtype, np.floating):
+    inexact = np.issubdtype(out.dtype, np.floating) or np.issubdtype(
+        out.dtype, np.complexfloating
+    )
+    if _nanlike(fill_value) and not inexact:
         out = out.astype(np.float64)
     return np.where(present, out, fill_value)
 
@@ -119,7 +122,10 @@ def _make_minmax(ufunc, is_max, skipna):
         fv = fill_value
         if fv is None:
             fv = np.nan if isfloat else init
-        if _nanlike(fv) and not np.issubdtype(out.dtype, np.floating):
+        inexact = np.issubdtype(out.dtype, np.floating) or np.issubdtype(
+            out.dtype, np.complexfloating
+        )
+        if _nanlike(fv) and not inexact:
             out = out.astype(np.float64)
         out = np.where(
             np.broadcast_to(
@@ -350,10 +356,13 @@ def _firstlast_impl(group_idx, array, *, size, fill_value, skipna, last, nat=Fal
     pos = _scatter(np.maximum if last else np.minimum, codes, iota, valid, size, -1 if last else n)
     ok = (pos >= 0) & (pos < n)
     gathered = np.take_along_axis(data, pos.clip(0, n - 1), axis=0)
+    is_inexact = np.issubdtype(data.dtype, np.floating) or np.issubdtype(
+        data.dtype, np.complexfloating
+    )
     fv = fill_value
     if fv is None:
-        fv = np.nan if np.issubdtype(data.dtype, np.floating) else 0
-    if _nanlike(fv) and not np.issubdtype(gathered.dtype, np.floating):
+        fv = np.nan if is_inexact else 0
+    if _nanlike(fv) and not is_inexact:
         gathered = gathered.astype(np.float64)
     out = np.where(ok, gathered, fv)
     return np.moveaxis(out, 0, -1)

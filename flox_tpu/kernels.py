@@ -214,10 +214,30 @@ def _counts(codes, size: int, mask=None, dtype=jnp.int32):
     return _seg("sum", ones, codes, size)
 
 
+def _is_nan_fill(fv) -> bool:
+    try:
+        return bool(np.isnan(fv))
+    except (TypeError, ValueError):
+        return False
+
+
+def _promote_for_nan_fill(out, fv):
+    """A NaN fill on integer output must promote, not truncate to garbage."""
+    inexact = jnp.issubdtype(out.dtype, jnp.floating) or jnp.issubdtype(
+        out.dtype, jnp.complexfloating
+    )
+    if _is_nan_fill(fv) and not inexact:
+        from . import utils as _u
+
+        return out.astype(jnp.float64 if _u.x64_enabled() else jnp.float32)
+    return out
+
+
 def _fill_empty(out, present, fill_value):
     """Replace groups with no contributing elements by ``fill_value``."""
     if fill_value is None:
         return out
+    out = _promote_for_nan_fill(out, fill_value)
     present = _bcast_present(jnp.asarray(present), out)
     return jnp.where(present, out, jnp.asarray(fill_value).astype(out.dtype))
 
@@ -570,7 +590,11 @@ def _firstlast_impl(group_idx, array, *, size, fill_value, skipna, last, nat=Fal
     valid = (pos >= 0) & (pos < _BIG)
     gather_at = jnp.clip(pos, 0, data.shape[0] - 1)
     out = jnp.take_along_axis(data, gather_at, axis=0)
-    fv = fill_value if fill_value is not None else (jnp.nan if jnp.issubdtype(data.dtype, jnp.floating) else 0)
+    is_inexact = jnp.issubdtype(data.dtype, jnp.floating) or jnp.issubdtype(
+        data.dtype, jnp.complexfloating
+    )
+    fv = fill_value if fill_value is not None else (jnp.nan if is_inexact else 0)
+    out = _promote_for_nan_fill(out, fv)
     out = jnp.where(valid, out, jnp.asarray(fv).astype(out.dtype))
     return _from_leading(out)
 
@@ -640,10 +664,35 @@ def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna, meth
         _bcast_present(nn, sorted_data[:1]), (size,) + sorted_data.shape[1:]
     )
 
+    # Continuous interpolation families share numpy's (alpha, beta)
+    # plotting-position parametrization: h = q*(n + 1 - a - b) + a - 1,
+    # clipped to [0, n-1], linearly interpolated. The discrete variants
+    # (lower/higher/nearest/midpoint) derive from the linear h.
+    _ALPHA_BETA = {
+        "linear": (1.0, 1.0),
+        "hazen": (0.5, 0.5),
+        "weibull": (0.0, 0.0),
+        "interpolated_inverted_cdf": (0.0, 1.0),
+        "median_unbiased": (1 / 3, 1 / 3),
+        "normal_unbiased": (3 / 8, 3 / 8),
+    }
+    if method in _ALPHA_BETA:
+        alpha, beta = _ALPHA_BETA[method]
+    elif method in ("lower", "higher", "nearest", "midpoint"):
+        alpha, beta = 1.0, 1.0
+    else:
+        raise ValueError(
+            f"Unsupported quantile method {method!r}; supported: "
+            f"{sorted(_ALPHA_BETA) + ['lower', 'higher', 'nearest', 'midpoint']} "
+            "(the numpy engine additionally supports every np.quantile method)."
+        )
+
     outs = []
     nmax = sorted_data.shape[0]
     for qi in qs:
-        pos = qi * (nn_full - 1).astype(sorted_data.dtype)  # within-group, float
+        nnf = nn_full.astype(sorted_data.dtype)
+        pos = qi * (nnf + 1 - alpha - beta) + (alpha - 1)  # within-group, float
+        pos = jnp.clip(pos, 0, jnp.maximum(nnf - 1, 0))
         lo_in = jnp.floor(pos).astype(jnp.int32)
         hi_in = jnp.ceil(pos).astype(jnp.int32)
         lo = off_b + lo_in
@@ -653,18 +702,18 @@ def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna, meth
         v_lo = jnp.take_along_axis(sorted_data, lo_c, axis=0)
         v_hi = jnp.take_along_axis(sorted_data, hi_c, axis=0)
         frac = pos - lo_in
-        if method == "linear":
-            val = v_lo + frac * (v_hi - v_lo)
-        elif method == "lower":
+        if method == "lower":
             val = v_lo
         elif method == "higher":
             val = v_hi
         elif method == "nearest":
-            val = jnp.where(frac <= 0.5, v_lo, v_hi)
+            # np.quantile rounds the virtual index half-to-even
+            nr = jnp.clip(off_b + jnp.round(pos).astype(jnp.int32), 0, nmax - 1)
+            val = jnp.take_along_axis(sorted_data, nr, axis=0)
         elif method == "midpoint":
             val = (v_lo + v_hi) / 2
-        else:
-            raise ValueError(f"Unsupported quantile method: {method!r}")
+        else:  # all continuous families: linear interpolation at h
+            val = v_lo + frac * (v_hi - v_lo)
         empty = nn_full <= 0
         fv = fill_value if fill_value is not None else jnp.nan
         val = jnp.where(empty, jnp.asarray(fv).astype(val.dtype), val)
@@ -740,6 +789,7 @@ def _mode_impl(group_idx, array, *, size, fill_value, skipna):
         has_nan = _seg("max", (~smask).astype(jnp.int8), codes1d, size) > 0
         out = jnp.where(_bcast_present(has_nan, out), jnp.asarray(jnp.nan, out.dtype), out)
     fv = fill_value if fill_value is not None else (jnp.nan if jnp.issubdtype(out.dtype, jnp.floating) else 0)
+    out = _promote_for_nan_fill(out, fv)
     out = jnp.where(valid, out, jnp.asarray(fv).astype(out.dtype))
     return _from_leading(out)
 

@@ -67,3 +67,14 @@ def test_engine_parity_var_chunk(case):
     b = engine_numpy.generic_kernel("var_chunk", codes, values, size=size)
     for x, y in zip(a, b):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-10, atol=1e-10)
+
+
+def test_complex_dtype_parity():
+    # reference property tests cover complex inputs (strategies.py:52-190)
+    vals = np.array([1 + 2j, 3 - 1j, np.nan + 0j, 2 + 2j])
+    codes = np.array([0, 0, 1, 1])
+    for func in ["sum", "nansum", "mean", "nanmean", "count", "first", "last",
+                 "nanfirst", "nanlast"]:
+        a = np.asarray(kernels.generic_kernel(func, codes, vals, size=2))
+        b = np.asarray(engine_numpy.generic_kernel(func, codes, vals, size=2))
+        np.testing.assert_allclose(a, b, equal_nan=True, err_msg=func)

@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 import scipy.stats
 
+from flox_tpu import engine_numpy as engine_numpy_mod
 from flox_tpu import kernels
 
 
@@ -368,3 +369,49 @@ def test_pallas_kahan_accuracy():
     ulp = np.spacing(np.float32(oracle)).astype(np.float64)
     assert abs(kahan - oracle) <= ulp
     assert abs(kahan - oracle) <= abs(plain - oracle)
+
+
+@pytest.mark.parametrize(
+    "method",
+    ["linear", "hazen", "weibull", "interpolated_inverted_cdf",
+     "median_unbiased", "normal_unbiased", "lower", "higher", "midpoint"],
+)
+def test_quantile_methods_match_numpy(method):
+    # the jax engine's (alpha, beta) families must match np.quantile exactly
+    rng = np.random.default_rng(5)
+    codes = rng.integers(0, 4, 50)
+    values = rng.normal(size=50)
+    a = np.asarray(kernels.generic_kernel("quantile", codes, values, size=4, q=0.3, method=method))
+    expected = np.stack(
+        [np.quantile(values[codes == g], 0.3, method=method) for g in range(4)]
+    )
+    np.testing.assert_allclose(a, expected, rtol=1e-12, atol=1e-12)
+
+
+def test_quantile_nearest_half_to_even():
+    # np.quantile 'nearest' rounds the virtual index half-to-even
+    values = np.array([0.0, 1.0, 2.0, 3.0])
+    codes = np.zeros(4, dtype=np.int64)
+    got = float(np.asarray(
+        kernels.generic_kernel("quantile", codes, values, size=1, q=0.5, method="nearest")
+    )[0])
+    assert got == np.quantile(values, 0.5, method="nearest")
+
+
+def test_nan_fill_promotes_int_data():
+    # NaN fill on integer input must produce NaN, not a truncated 0
+    codes = np.array([0, 0, 0])
+    values = np.array([5, 7, 9], dtype=np.int64)
+    for func in ["first", "max", "mode"]:
+        a = np.asarray(kernels.generic_kernel(func, codes, values, size=2, fill_value=np.nan))
+        b = np.asarray(engine_numpy_mod.generic_kernel(func, codes, values, size=2, fill_value=np.nan))
+        assert np.isnan(a[1]) and np.isnan(b[1]), func
+
+
+def test_complex_nan_fill_keeps_imaginary():
+    from flox_tpu import engine_numpy
+
+    vals = np.array([1 + 2j, 3 - 1j, 2 + 2j])
+    codes = np.array([0, 0, 0])
+    b = np.asarray(engine_numpy.generic_kernel("sum", codes, vals, size=2, fill_value=np.nan))
+    assert b.dtype.kind == "c" and b[0] == 6 + 3j and np.isnan(b[1].real)
