@@ -36,8 +36,16 @@ def _ensure_responsive_backend(timeout_s: float = 90.0) -> None:
     platform = os.environ.get("JAX_PLATFORMS", "")
     if platform and not any(t in platform for t in ("tpu", "axon")):
         return
+    probe_code = (
+        "import jax, jax.numpy as jnp; jax.devices(); "
+        "import sys; sys.path.insert(0, %r); "
+        "from flox_tpu.pallas_kernels import segment_sum_pallas; "
+        "out = segment_sum_pallas(jnp.ones((8, 128), jnp.float32), "
+        "jnp.zeros(8, jnp.int32), 2); "
+        "assert float(out[0, 0]) == 8.0"
+    ) % os.path.dirname(os.path.abspath(__file__))
     proc = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices()"],
+        [sys.executable, "-c", probe_code],
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
     )
@@ -53,10 +61,31 @@ def _ensure_responsive_backend(timeout_s: float = 90.0) -> None:
         except subprocess.TimeoutExpired:
             pass
     if not healthy:
+        # either the backend is wedged or the pallas lowering misbehaves in a
+        # way an in-process try/except cannot catch; find out which
+        basic = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        backend_ok = False
+        try:
+            backend_ok = basic.wait(timeout=timeout_s) == 0
+        except subprocess.TimeoutExpired:
+            basic.kill()
+            try:
+                basic.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
         import jax
 
-        print("flox-tpu bench: accelerator unreachable; benchmarking on CPU", file=sys.stderr, flush=True)
-        jax.config.update("jax_platforms", "cpu")
+        if backend_ok:
+            print("flox-tpu bench: pallas probe failed; using the XLA GEMM path", file=sys.stderr, flush=True)
+            from flox_tpu.options import OPTIONS
+
+            OPTIONS["segment_sum_impl"] = "matmul"
+        else:
+            print("flox-tpu bench: accelerator unreachable; benchmarking on CPU", file=sys.stderr, flush=True)
+            jax.config.update("jax_platforms", "cpu")
 
 
 def main() -> None:

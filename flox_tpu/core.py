@@ -399,10 +399,14 @@ def groupby_reduce(
         func, dtype, arr.dtype if datetime_dtype is None else np.dtype("int64"),
         fill_value, min_count_, finalize_kwargs
     )
-    if datetime_dtype is not None and agg.preserves_dtype and fill_value is None:
+    if datetime_dtype is not None and agg.preserves_dtype:
         # missing marker for datetimes is NaT (INT64_MIN), never float NaN:
-        # going through float would corrupt ns-resolution timestamps
-        agg.final_fill_value = _NAT_INT
+        # going through float would corrupt ns-resolution timestamps; an
+        # explicit datetime/NaT fill is viewed to its int64 representation
+        if fill_value is None:
+            agg.final_fill_value = _NAT_INT
+        elif isinstance(agg.final_fill_value, (np.datetime64, np.timedelta64)):
+            agg.final_fill_value = int(agg.final_fill_value.astype("int64"))
         agg.final_dtype = np.dtype("int64")
 
     # -- flatten for the kernel -------------------------------------------
@@ -513,20 +517,18 @@ def _where(cond, fill, x):
         import jax.numpy as jnp
 
         cond = jnp.broadcast_to(jnp.asarray(cond), x.shape)
-        if _fill_needs_float(fill) and not jnp.issubdtype(x.dtype, jnp.floating):
+        inexact = jnp.issubdtype(x.dtype, jnp.floating) or jnp.issubdtype(
+            x.dtype, jnp.complexfloating
+        )
+        if utils.is_nan_fill(fill) and not inexact:
             x = x.astype(jnp.float64 if utils.x64_enabled() else jnp.float32)
         return jnp.where(cond, jnp.asarray(fill).astype(x.dtype), x)
     cond = np.broadcast_to(np.asarray(cond), np.shape(x))
-    if _fill_needs_float(fill) and not np.issubdtype(np.asarray(x).dtype, np.floating):
+    xdt = np.asarray(x).dtype
+    inexact = np.issubdtype(xdt, np.floating) or np.issubdtype(xdt, np.complexfloating)
+    if utils.is_nan_fill(fill) and not inexact:
         x = np.asarray(x).astype(np.float64)
     return np.where(cond, fill, x)
-
-
-def _fill_needs_float(fill) -> bool:
-    try:
-        return bool(np.isnan(fill))
-    except (TypeError, ValueError):
-        return False
 
 
 def _astype_final(result, agg: Aggregation, datetime_dtype=None):

@@ -51,10 +51,9 @@ def _apply_fill(out, codes, valid, size, fill_value, identity=None):
 
 
 def _nanlike(v) -> bool:
-    try:
-        return bool(np.isnan(v))
-    except (TypeError, ValueError):
-        return False
+    from . import utils as _u
+
+    return _u.is_nan_fill(v)
 
 
 _NAT_INT = np.iinfo(np.int64).min  # NaT viewed as int64 (core passes nat=True)

@@ -82,8 +82,7 @@ def _use_matmul_path(op: str, data, size: int) -> bool:
     """
     from .options import OPTIONS
 
-    policy = OPTIONS["segment_sum_impl"]
-    if policy != "matmul" or op != "sum":
+    if op != "sum":
         return False
     if not (size <= OPTIONS["matmul_num_groups_max"] and jnp.issubdtype(data.dtype, jnp.floating)):
         return False
@@ -150,6 +149,37 @@ def _seg_matmul_sum(data, codes, size: int):
     return out_v.reshape((size,) + data.shape[1:])
 
 
+_PALLAS_PROBE_RESULT: list = []  # memoized one-time runtime validation
+
+
+def _pallas_runtime_ok() -> bool:
+    """One-time probe: compile+run the Pallas kernel on a tiny input on the
+    real backend. The kernel is tested in interpret mode on CPU, but a real
+    TPU lowering can still fail (tiling constraints, toolchain drift) — and
+    the 'auto' policy must never take down a reduction it could have run on
+    the battle-tested paths. Any failure logs once and disables pallas for
+    the process."""
+    if _PALLAS_PROBE_RESULT:
+        return _PALLAS_PROBE_RESULT[0]
+    try:
+        from .pallas_kernels import segment_sum_pallas
+
+        probe = segment_sum_pallas(
+            jnp.ones((8, 128), jnp.float32), jnp.zeros(8, jnp.int32), 2
+        )
+        ok = bool(np.asarray(probe)[0, 0] == 8.0)
+    except Exception as exc:  # noqa: BLE001 — any lowering failure disables it
+        import logging
+
+        logging.getLogger("flox_tpu").warning(
+            "pallas segment-sum unavailable on this backend (%s); "
+            "falling back to the XLA paths", exc,
+        )
+        ok = False
+    _PALLAS_PROBE_RESULT.append(ok)
+    return ok
+
+
 def _segment_sum_impl(data, size: int) -> str:
     """Pick the segment-sum implementation per the policy + constraints."""
     from .options import OPTIONS
@@ -165,11 +195,15 @@ def _segment_sum_impl(data, size: int) -> str:
         and size <= OPTIONS["pallas_num_groups_max"]
         and data.shape[0] >= 8
     )
+    on_tpu = jax.default_backend() in ("tpu", "axon")
     if policy == "pallas":
-        return "pallas" if pallas_ok else "scatter"
-    # auto: pallas on TPU backends, scatter elsewhere
-    if jax.default_backend() in ("tpu", "axon") and pallas_ok:
+        return "pallas" if pallas_ok and (not on_tpu or _pallas_runtime_ok()) else "scatter"
+    # auto on TPU: pallas if it validates at runtime, else the GEMM path if
+    # its guards pass (pure XLA, no custom lowering), else scatter
+    if on_tpu and pallas_ok and _pallas_runtime_ok():
         return "pallas"
+    if on_tpu and _use_matmul_path("sum", data, size):
+        return "matmul"
     return "scatter"
 
 
@@ -215,10 +249,9 @@ def _counts(codes, size: int, mask=None, dtype=jnp.int32):
 
 
 def _is_nan_fill(fv) -> bool:
-    try:
-        return bool(np.isnan(fv))
-    except (TypeError, ValueError):
-        return False
+    from . import utils as _u
+
+    return _u.is_nan_fill(fv)
 
 
 def _promote_for_nan_fill(out, fv):
@@ -690,7 +723,10 @@ def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna, meth
     outs = []
     nmax = sorted_data.shape[0]
     for qi in qs:
-        nnf = nn_full.astype(sorted_data.dtype)
+        # index arithmetic in f32/f64, never the data dtype: bf16 cannot even
+        # represent odd counts above 256, which would select wrong elements
+        idx_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        nnf = nn_full.astype(idx_dtype)
         pos = qi * (nnf + 1 - alpha - beta) + (alpha - 1)  # within-group, float
         pos = jnp.clip(pos, 0, jnp.maximum(nnf - 1, 0))
         lo_in = jnp.floor(pos).astype(jnp.int32)
@@ -701,7 +737,7 @@ def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna, meth
         hi_c = jnp.clip(hi, 0, nmax - 1)
         v_lo = jnp.take_along_axis(sorted_data, lo_c, axis=0)
         v_hi = jnp.take_along_axis(sorted_data, hi_c, axis=0)
-        frac = pos - lo_in
+        frac = (pos - lo_in).astype(sorted_data.dtype)
         if method == "lower":
             val = v_lo
         elif method == "higher":

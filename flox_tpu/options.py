@@ -19,8 +19,10 @@ OPTIONS: dict[str, Any] = {
     # additive segment reductions with at most this many groups may use the
     # one-hot matmul (MXU) or Pallas path instead of scatter-add
     "matmul_num_groups_max": 384,
-    # segment-sum implementation: "auto" picks pallas on TPU backends and
-    # scatter elsewhere; explicit "scatter" | "matmul" | "pallas" override
+    # segment-sum implementation: "auto" on TPU tries pallas (after a
+    # one-time runtime validation), then the one-hot GEMM (matmul) when its
+    # footprint guards pass, then scatter; off-TPU auto is always scatter.
+    # Explicit "scatter" | "matmul" | "pallas" override.
     "segment_sum_impl": "auto",
     # group-count ceiling for the Pallas path (VMEM-bounded; independent of
     # the matmul knob so disabling one path does not disable the other)

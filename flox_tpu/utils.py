@@ -141,3 +141,15 @@ def reapply_nonfinite(sums, nan_c, pos_c, neg_c):
             jnp.where(neg_c > 0, jnp.asarray(-jnp.inf, sums.dtype), sums),
         ),
     )
+
+
+def is_nan_fill(v) -> bool:
+    """True only for genuine float/complex NaN fills. NaT (datetime64 /
+    timedelta64) answers True to np.isnan but must NOT trigger float
+    promotion — timestamps would lose ns precision through float64."""
+    if isinstance(v, (np.datetime64, np.timedelta64)):
+        return False
+    try:
+        return bool(np.isnan(v))
+    except (TypeError, ValueError):
+        return False

@@ -415,3 +415,25 @@ def test_fill_value_applied_to_absent_groups(engine, func):
     res = np.asarray(result).astype(float)
     assert res[1] == -123.0, (func, res)
     assert res[0] != -123.0 and res[2] != -123.0
+
+
+def test_explicit_nat_fill(engine):
+    # an explicit NaT fill must not crash or round timestamps through float
+    dt = np.array(["2000-01-01T00:00:00.123456789", "2000-01-02"], dtype="datetime64[ns]")
+    labels = np.array([0, 0])
+    result, _ = groupby_reduce(
+        dt, labels, func="first", engine=engine,
+        expected_groups=np.array([0, 1]), fill_value=np.datetime64("NaT"),
+    )
+    assert result.dtype == dt.dtype
+    assert result[0] == dt[0] and np.isnat(result[1])
+
+
+def test_min_count_complex(engine):
+    # min_count masking must not destroy imaginary parts
+    vals = np.array([1 + 2j, 3 - 1j, 9 + 9j])
+    labels = np.array([0, 0, 1])
+    result, _ = groupby_reduce(vals, labels, func="nansum", engine=engine, min_count=2)
+    res = np.asarray(result)
+    assert res.dtype.kind == "c"
+    assert res[0] == 4 + 1j and np.isnan(res[1].real)

@@ -415,3 +415,37 @@ def test_complex_nan_fill_keeps_imaginary():
     codes = np.array([0, 0, 0])
     b = np.asarray(engine_numpy.generic_kernel("sum", codes, vals, size=2, fill_value=np.nan))
     assert b.dtype.kind == "c" and b[0] == 6 + 3j and np.isnan(b[1].real)
+
+
+def test_pallas_probe_failure_falls_back(monkeypatch):
+    # if the pallas kernel cannot lower on the real backend, auto/pallas
+    # policies must degrade to the XLA paths instead of failing the reduction
+    import flox_tpu
+    from flox_tpu import kernels as K
+    from flox_tpu import pallas_kernels
+
+    monkeypatch.setattr(K, "_PALLAS_PROBE_RESULT", [])
+    def boom(*a, **k):
+        raise RuntimeError("lowering failed")
+    monkeypatch.setattr(pallas_kernels, "segment_sum_pallas", boom)
+    monkeypatch.setattr("jax.default_backend", lambda: "tpu")
+    import jax.numpy as jnp
+
+    with flox_tpu.set_options(segment_sum_impl="pallas"):
+        assert K._segment_sum_impl(jnp.zeros((64, 4), jnp.float32), 12) == "scatter"
+    monkeypatch.setattr(K, "_PALLAS_PROBE_RESULT", [])
+    with flox_tpu.set_options(segment_sum_impl="auto"):
+        # auto degrades pallas -> matmul (guards pass) on a TPU backend
+        assert K._segment_sum_impl(jnp.zeros((64, 4), jnp.float32), 12) == "matmul"
+
+
+def test_quantile_bf16_large_group():
+    # index arithmetic must not run in bf16 (cannot represent odd counts >256)
+    import jax.numpy as jnp
+
+    n = 301
+    values = jnp.arange(n, dtype=jnp.bfloat16)
+    codes = np.zeros(n, dtype=np.int64)
+    got = kernels.generic_kernel("quantile", codes, values, size=1, q=0.9, method="lower")
+    expected = np.quantile(np.arange(n, dtype=np.float64), 0.9, method="lower")
+    assert float(np.asarray(got.astype(jnp.float32))[0]) == expected
