@@ -28,10 +28,8 @@ from typing import Any, Callable, Sequence
 import numpy as np
 import pandas as pd
 
-from . import aggregations as agg_mod
 from . import dtypes, factorize as fct, utils
 from .aggregations import Aggregation, _initialize_aggregation, generic_aggregate
-from .multiarray import MultiArray
 from .options import OPTIONS
 
 logger = logging.getLogger("flox_tpu")

@@ -30,8 +30,6 @@ reduction with the collectives.
 from __future__ import annotations
 
 import functools
-from typing import Any, Sequence
-
 import numpy as np
 
 from .. import utils
@@ -226,7 +224,7 @@ def sharded_groupby_reduce(
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     if mesh is None:
         mesh = _cached_mesh_default()

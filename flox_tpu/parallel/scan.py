@@ -24,7 +24,6 @@ import numpy as np
 
 from .. import utils
 from ..aggregations import Scan
-from .mesh import make_mesh
 from .mapreduce import _cached_mesh_default, _flat_axis_index, _norm_axes, _pad_to
 
 _SCAN_CACHE: dict = {}

@@ -19,8 +19,6 @@ range, so one flat segmented scan handles every row without crossing rows.
 from __future__ import annotations
 
 import logging
-from typing import Sequence
-
 import numpy as np
 
 from . import factorize as fct

@@ -437,3 +437,21 @@ def test_min_count_complex(engine):
     res = np.asarray(result)
     assert res.dtype.kind == "c"
     assert res[0] == 4 + 1j and np.isnan(res[1].real)
+
+
+def test_custom_aggregation(engine):
+    # users can define custom aggregations (public Aggregation export,
+    # reference aggregations.py:161)
+    from flox_tpu import Aggregation
+
+    def sum_of_cubes(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+        import flox_tpu.engine_numpy as en
+
+        arr = np.asarray(array)
+        return en.generic_kernel("sum", group_idx, arr**3, size=size, fill_value=fill_value)
+
+    agg = Aggregation("sum_of_cubes", numpy=(sum_of_cubes,), chunk=(sum_of_cubes,), combine=("sum",))
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    labels = np.array([0, 0, 1, 1])
+    result, groups = groupby_reduce(vals, labels, func=agg, engine=engine)
+    np.testing.assert_allclose(np.asarray(result).astype(float), [9.0, 91.0])
