@@ -252,3 +252,18 @@ def test_mesh_missing_axis_errors():
             np.arange(16.0), np.arange(16) % 2, func="sum",
             method="map-reduce", mesh=mesh2, axis_name="bogus",
         )
+
+
+def test_pre_sharded_input(mesh):
+    # a user array already placed with a NamedSharding flows through the
+    # mesh path without a host round-trip
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = 128
+    codes = RNG.integers(0, 4, n).astype(np.int64)
+    values = _data((n,), False, n)
+    sharded_vals = jax.device_put(jnp.asarray(values), NamedSharding(mesh, P("data")))
+    out, _ = groupby_reduce(sharded_vals, codes, func="nanmean", method="map-reduce", mesh=mesh)
+    eager, _ = groupby_reduce(values, codes, func="nanmean", engine="jax")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(eager), rtol=1e-12)
