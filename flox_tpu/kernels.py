@@ -28,6 +28,9 @@ Design notes (why this is not a port):
 * Grouped scans (cumsum/ffill) use a segmented binary operator under
   ``jax.lax.associative_scan`` — log-depth on device, and the same operator
   is reused across shards by the distributed Blelloch scan.
+* Denormal (subnormal) inputs follow XLA's flush-to-zero semantics — the
+  same behavior TPU hardware has — so comparisons against host numpy can
+  differ in the last bit for values below ~1e-308 (f64) / ~1e-38 (f32).
 * Everything here is shape-static and jit-safe; ``core.chunk_reduce`` traces
   the full multi-kernel bundle into ONE jitted program so XLA fuses the
   shared factorize/scatter work across outputs (e.g. mean = sum+count in one

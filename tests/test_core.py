@@ -455,3 +455,21 @@ def test_custom_aggregation(engine):
     labels = np.array([0, 0, 1, 1])
     result, groups = groupby_reduce(vals, labels, func=agg, engine=engine)
     np.testing.assert_allclose(np.asarray(result).astype(float), [9.0, 91.0])
+
+
+def test_three_groupers_product_grid(engine):
+    # nby=3 (reference sweep covers nby 1-3, test_core.py:222-388)
+    rng = np.random.default_rng(77)
+    n = 60
+    b1 = rng.integers(0, 2, n)
+    b2 = rng.integers(0, 3, n)
+    b3 = rng.integers(0, 2, n)
+    vals = np.round(rng.normal(size=n), 1)
+    result, g1, g2, g3 = groupby_reduce(vals, b1, b2, b3, func="sum", engine=engine)
+    assert np.asarray(result).shape == (2, 3, 2)
+    expected = np.zeros((2, 3, 2))
+    for i in range(2):
+        for j in range(3):
+            for k in range(2):
+                expected[i, j, k] = vals[(b1 == i) & (b2 == j) & (b3 == k)].sum()
+    np.testing.assert_allclose(np.asarray(result).astype(float), expected, rtol=1e-12)
