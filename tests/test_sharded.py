@@ -267,3 +267,16 @@ def test_pre_sharded_input(mesh):
     out, _ = groupby_reduce(sharded_vals, codes, func="nanmean", method="map-reduce", mesh=mesh)
     eager, _ = groupby_reduce(values, codes, func="nanmean", engine="jax")
     np.testing.assert_allclose(np.asarray(out), np.asarray(eager), rtol=1e-12)
+
+
+def test_partial_axis_on_mesh(mesh):
+    # offset codes (per-row group spaces) shard over the flat span correctly
+    labels = np.array([[0, 1, 0, 1] * 8, [1, 1, 0, 0] * 8])  # (2, 32)
+    vals = np.round(RNG.normal(size=(2, 32)), 1)
+    eager, _ = groupby_reduce(vals, labels, func="sum", engine="jax", axis=-1)
+    sharded, _ = groupby_reduce(vals, labels, func="sum", axis=-1,
+                                method="map-reduce", mesh=mesh)
+    np.testing.assert_allclose(
+        np.asarray(sharded).astype(float), np.asarray(eager).astype(float),
+        rtol=1e-12, atol=1e-12,
+    )
