@@ -95,9 +95,16 @@ def main() -> None:
 
     from flox_tpu.kernels import generic_kernel
 
-    nlat = int(os.environ.get("FLOX_TPU_BENCH_NLAT", 181))
+    # However execution ended up on CPU (explicit env, wedged-accelerator
+    # fallback, or a host with no accelerator at all), bound the default
+    # workload: a full-size ERA5 pass takes ~15 min on one host core and the
+    # CPU number is only a liveness signal. Env vars still override.
+    on_cpu = jax.default_backend() == "cpu"
+    default_ntime = (24 * 365) if on_cpu else (24 * 365 * 3)
+    default_nlat = 60 if on_cpu else 181
+    nlat = int(os.environ.get("FLOX_TPU_BENCH_NLAT", default_nlat))
     nlon = int(os.environ.get("FLOX_TPU_BENCH_NLON", 360))
-    ntime = int(os.environ.get("FLOX_TPU_BENCH_NTIME", 24 * 365 * 3))
+    ntime = int(os.environ.get("FLOX_TPU_BENCH_NTIME", default_ntime))
     reps = int(os.environ.get("FLOX_TPU_BENCH_REPS", 5))
 
     # month-of-year labels for 3 years of hourly stamps (12 groups)
