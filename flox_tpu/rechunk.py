@@ -80,14 +80,18 @@ def reshard_for_blockwise(codes: np.ndarray, n_shards: int) -> BlockwiseLayout:
         loads[s] += counts[gi]
     shard_len = int(loads.max()) if len(uniq) else 1
 
-    # build per-shard index lists (stable within group: original order kept)
+    # build per-shard index lists (stable within group: original order kept).
+    # One stable sort by code gives every group's positions contiguously —
+    # O(n log n) total instead of a per-group O(n) scan.
     perm = np.full((n_shards, shard_len), -1, dtype=np.int64)
     out_codes = np.full((n_shards, shard_len), -1, dtype=np.int64)
     cursors = np.zeros(n_shards, dtype=np.int64)
-    # iterate groups in label order for determinism
-    for g in uniq:
+    valid_idx = np.flatnonzero(valid)
+    by_code = valid_idx[np.argsort(codes[valid_idx], kind="stable")]
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    for gi, g in enumerate(uniq):
         s = assignment[g]
-        idx = np.flatnonzero(codes == g)
+        idx = by_code[starts[gi] : starts[gi + 1]]
         c = cursors[s]
         perm[s, c : c + idx.size] = idx
         out_codes[s, c : c + idx.size] = g
