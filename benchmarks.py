@@ -133,6 +133,56 @@ def bench_random_big(engine: str, scale: str):
     return [{"bench": f"random_big_array[{engine}]", "value": round(gbps, 2), "unit": "GB/s"}]
 
 
+def bench_mesh_methods(scale: str):
+    """Mesh execution-method comparison (the analogue of the reference's
+    time_combine: _simple_combine vs _grouped_combine, combine.py:27-77 —
+    here the combine strategies are whole SPMD programs)."""
+    from flox_tpu import groupby_reduce
+    from flox_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    n = 500_000 if scale == "full" else 100_000
+    rng = np.random.default_rng(0)
+    labels = np.tile(np.arange(366), n // 366 + 1)[:n]
+    vals = rng.normal(size=(8, n)).astype(np.float32)
+    out = []
+    for method in ["map-reduce", "cohorts"]:
+        t = _timeit(
+            lambda: _block(
+                groupby_reduce(vals, labels, func="nanmean", method=method, mesh=mesh)[0]
+            )
+        )
+        out.append({"bench": f"time_mesh_combine[{method}]", "value": round(t * 1e3, 2), "unit": "ms"})
+    return out
+
+
+def bench_scan(engine: str, scale: str):
+    """Grouped-scan timing (reference tracks scans through its asv suite)."""
+    from flox_tpu import groupby_scan
+
+    n = 500_000 if scale == "full" else 100_000
+    rng = np.random.default_rng(0)
+    labels = np.tile(np.arange(12), n // 12 + 1)[:n]
+    vals = rng.normal(size=n)
+    out = []
+    for func in ["cumsum", "ffill"]:
+        t = _timeit(lambda: _block(groupby_scan(vals, labels, func=func, engine=engine)))
+        out.append({"bench": f"time_scan[{func}-{engine}]", "value": round(t * 1e3, 2), "unit": "ms"})
+    return out
+
+
+def bench_scan_blelloch(scale: str):
+    """Distributed Blelloch scan over the mesh (jax backend; once per run)."""
+    from flox_tpu import groupby_scan
+
+    n = 500_000 if scale == "full" else 100_000
+    rng = np.random.default_rng(0)
+    labels = np.tile(np.arange(12), n // 12 + 1)[:n]
+    vals = rng.normal(size=n)
+    t = _timeit(lambda: _block(groupby_scan(vals, labels, func="cumsum", method="blelloch")))
+    return [{"bench": "time_scan[cumsum-blelloch]", "value": round(t * 1e3, 2), "unit": "ms"}]
+
+
 def bench_cohort_detection(scale: str):
     """time_find_group_cohorts + track_num_cohorts parity."""
     from flox_tpu.cohorts import _COHORTS_CACHE, chunks_from_shards, find_group_cohorts
@@ -168,6 +218,12 @@ def main() -> None:
         results += bench_era5_dayofyear(engine, args.scale)
         results += bench_nwm_zonal(engine, args.scale)
         results += bench_random_big(engine, args.scale)
+        results += bench_scan(engine, args.scale)
+    if "jax" in engines:
+        # mesh benchmarks need a working jax backend; keep --engine numpy
+        # runnable on hosts without one
+        results += bench_mesh_methods(args.scale)
+        results += bench_scan_blelloch(args.scale)
     results += bench_cohort_detection(args.scale)
     for r in results:
         print(json.dumps(r))
