@@ -350,12 +350,11 @@ def _apply_final_fill(result, counts, agg: Aggregation):
         raise TypeError("string fill values are not supported on device")
     threshold = max(agg.min_count, 1)
     empty = counts < threshold
-    empty_b = jnp.broadcast_to(
-        empty.reshape(empty.shape + (1,) * (result.ndim - empty.ndim))
-        if empty.ndim < result.ndim
-        else empty,
-        result.shape,
-    )
+    # counts are (..., size) with the group axis LAST, exactly like the
+    # trailing dims of the result — standard right-aligned broadcasting
+    # covers both extra leading dims (quantile's q) and matching shapes.
+    # (Padding with trailing 1s here would mis-align the group axis.)
+    empty_b = jnp.broadcast_to(empty, result.shape)
     # host-side NaN check: under shard_map tracing even constants are tracers
     try:
         fill_is_nan = bool(np.isnan(final_fill))

@@ -280,3 +280,34 @@ def test_partial_axis_on_mesh(mesh):
         np.asarray(sharded).astype(float), np.asarray(eager).astype(float),
         rtol=1e-12, atol=1e-12,
     )
+
+
+def test_blockwise_multi_q_quantile(mesh):
+    # vector q adds a leading dim; the blockwise owner-selection must
+    # broadcast through it
+    ndev = len(jax.devices())
+    per = 16
+    codes = np.repeat(np.arange(ndev), per).astype(np.int64)
+    values = np.round(RNG.normal(size=ndev * per), 1)
+    sharded, _ = groupby_reduce(
+        values, codes, func="quantile", method="blockwise", mesh=mesh,
+        finalize_kwargs={"q": [0.25, 0.5, 0.75]},
+    )
+    eager, _ = groupby_reduce(
+        values, codes, func="quantile", engine="jax",
+        finalize_kwargs={"q": [0.25, 0.5, 0.75]},
+    )
+    assert np.asarray(sharded).shape == (3, ndev)
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(eager), rtol=1e-12, atol=1e-12)
+
+
+def test_sharded_datetime_firstlast(mesh):
+    dt = np.array(
+        ["2020-01-03", "NaT", "2020-01-01", "2020-01-05", "NaT", "2020-01-02"],
+        dtype="datetime64[ns]",
+    )
+    labels = np.array([0, 0, 0, 1, 1, 1])
+    for func in ["first", "last", "nanfirst", "nanlast"]:
+        sharded, _ = groupby_reduce(dt, labels, func=func, method="map-reduce", mesh=mesh)
+        eager, _ = groupby_reduce(dt, labels, func=func, engine="numpy")
+        np.testing.assert_array_equal(np.asarray(sharded), np.asarray(eager), err_msg=func)
