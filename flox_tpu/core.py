@@ -175,7 +175,9 @@ def chunk_reduce(
         key = (
             func_n if isinstance(func_n, str) else id(func_n),
             None if fv is None else (repr(fv)),
-            None if dt is None else np.dtype(dt).str,
+            # .name, not .str: extension dtypes (bfloat16) stringify to
+            # '|V2' via .str, which round-trips to a void dtype
+            None if dt is None else np.dtype(dt).name,
             tuple(sorted(merged.items())),
         )
         if key in seen:
@@ -187,7 +189,7 @@ def chunk_reduce(
 
     if engine == "jax" and jit and all(isinstance(p[0], str) for p in plan):
         funcs_key = tuple(
-            (f, _hashable_fill(fv), None if dt is None else np.dtype(dt).str, tuple(sorted(kw.items())))
+            (f, _hashable_fill(fv), None if dt is None else np.dtype(dt).name, tuple(sorted(kw.items())))
             for f, fv, dt, kw in plan
         )
         from .options import trace_fingerprint
@@ -279,6 +281,16 @@ def groupby_reduce(
     if method not in (None, "map-reduce", "blockwise", "cohorts"):
         raise ValueError(
             f"method must be one of None, 'map-reduce', 'blockwise', 'cohorts'; got {method!r}"
+        )
+    if reindex not in (None, True):
+        # dense-by-design: every intermediate is already dense over
+        # expected_groups (shape-static is what XLA fusion and mesh
+        # collectives require — docs/implementation.md), so reindex=True is
+        # implicit and the reference's reindex=False / sparse strategies
+        # (reindex.py:106-157) have no dense-graph to skip.
+        raise NotImplementedError(
+            "reindex=False and ReindexStrategy are not supported: intermediates "
+            "are always dense over expected_groups (reindex=True is implicit)."
         )
     nby = len(by)
 
@@ -406,6 +418,20 @@ def groupby_reduce(
         elif isinstance(agg.final_fill_value, (np.datetime64, np.timedelta64)):
             agg.final_fill_value = int(agg.final_fill_value.astype("int64"))
         agg.final_dtype = np.dtype("int64")
+    elif (
+        datetime_dtype is not None
+        and agg.reduction_type != "argreduce"
+        and agg.name not in ("count", "len", "any", "all")
+    ):
+        # float-returning reductions of datetimes (mean/var/median/quantile/
+        # sum): convert NaT -> NaN once, here, so every skipna/propagation
+        # rule applies unchanged; timestamp-valued results round back to the
+        # datetime dtype in _astype_final (parity: core.py:985-1001,
+        # 1205-1211). f64 keeps ~256 ns resolution on epoch values — the
+        # same loss the reference's float interpolation/division has.
+        arr_f = np.asarray(arr).astype(np.float64)
+        arr_f[np.asarray(arr) == _NAT_INT] = np.nan
+        arr = arr_f
 
     # -- flatten for the kernel -------------------------------------------
     nred = int(np.prod(nred_shape)) if nred_shape else 1
@@ -478,11 +504,15 @@ def _reduce_blockwise(arr_flat, codes_flat, agg: Aggregation, *, size, engine, d
         kdtypes.append(None)
         kwargss.append({"nat": True} if datetime_dtype is not None else {})
 
-    # dtype request for the kernel: the final dtype for accumulating funcs
-    if not agg.preserves_dtype and agg.name in ("sum", "nansum", "prod", "nanprod"):
-        kdtypes[0] = agg.final_dtype
-    if agg.name in ("mean", "nanmean", "var", "nanvar", "std", "nanstd") and np.dtype(agg.final_dtype).kind == "f":
-        kdtypes[0] = agg.final_dtype
+    # dtype request for the kernel: the final dtype for accumulating funcs.
+    # Not on the datetime path — there the data was converted to float64
+    # with NaT as NaN, and an int64 request would cast the NaNs to garbage
+    # mid-reduction; the int64 view happens once, in _astype_final.
+    if datetime_dtype is None:
+        if not agg.preserves_dtype and agg.name in ("sum", "nansum", "prod", "nanprod"):
+            kdtypes[0] = agg.final_dtype
+        if agg.name in ("mean", "nanmean", "var", "nanvar", "std", "nanstd") and np.dtype(agg.final_dtype).kind == "f":
+            kdtypes[0] = agg.final_dtype
 
     results = chunk_reduce(
         arr_flat,
@@ -529,6 +559,14 @@ def _where(cond, fill, x):
     return np.where(cond, fill, x)
 
 
+# datetime reductions whose result is NOT a point in time: counts, bools,
+# indices, and variance (units of ns²) stay numeric (the reference casts
+# var/std back too, core.py:1205-1211 — a unit error this build corrects)
+_DT_KEEP_NUMERIC = frozenset(
+    {"count", "len", "any", "all", "var", "nanvar", "std", "nanstd"}
+)
+
+
 def _astype_final(result, agg: Aggregation, datetime_dtype=None):
     final = np.dtype(agg.final_dtype)
     if datetime_dtype is not None and agg.preserves_dtype:
@@ -537,6 +575,22 @@ def _astype_final(result, agg: Aggregation, datetime_dtype=None):
         if res.dtype.kind == "f":  # only via an explicit float user fill
             res = np.where(np.isnan(res), _NAT_INT, res)
         return res.astype("int64").view(datetime_dtype)
+    if (
+        datetime_dtype is not None
+        and agg.name not in _DT_KEEP_NUMERIC
+        and agg.reduction_type != "argreduce"
+    ):
+        # non-dtype-preserving timestamp results (mean/median/quantile/sum of
+        # datetimes) round-trip back from float epoch values, NaN -> NaT
+        # (parity: core.py:1205-1211)
+        res = np.asarray(result)
+        if res.dtype.kind == "f":
+            nanmask = np.isnan(res)
+            out = np.round(np.where(nanmask, 0.0, res)).astype("int64")
+            out[nanmask] = _NAT_INT
+        else:
+            out = res.astype("int64")
+        return out.view(datetime_dtype)
     if utils.is_jax_array(result):
         import jax.numpy as jnp
 

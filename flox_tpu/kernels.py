@@ -72,6 +72,20 @@ def _safe_codes(group_idx, size: int):
     return jnp.where(codes < 0, size, codes)
 
 
+def _acc_dtype(dt):
+    """Accumulation dtype for additive segment reductions.
+
+    Sub-f32 floats (bf16/f16) accumulate in f32: their mantissas cannot even
+    count past 256, so running sums and counts saturate (nanmean of 2000 bf16
+    values would return the last partial, not the mean). The MXU natively
+    accumulates bf16 GEMMs into f32, so the GEMM/Pallas paths pay nothing
+    for this; the scatter path pays one upcast.
+    """
+    if dt in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return dt
+
+
 def _use_matmul_path(op: str, data, size: int) -> bool:
     """Additive segment reductions over few groups run as a one-hot matmul.
 
@@ -135,11 +149,14 @@ def _seg_matmul_sum(data, codes, size: int):
         [zeroed, isnan.astype(flat.dtype), ispos.astype(flat.dtype), isneg.astype(flat.dtype)],
         axis=1,
     )  # (N, 4K)
+    # bf16 operands stream at full rate while the MXU accumulates into f32
+    # (its native mode); without this the sums AND the marker counts would
+    # saturate at bf16's 8-bit mantissa.
     out = jax.lax.dot_general(
         onehot,
         stacked,
         dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=flat.dtype,
+        preferred_element_type=_acc_dtype(flat.dtype),
         precision=jax.lax.Precision.HIGHEST,
     )  # (size, 4K)
     sums = out[:, :k]
@@ -218,6 +235,9 @@ def _seg(op: str, data, codes, size: int):
     reductions may take the MXU one-hot-matmul or Pallas path per the
     ``segment_sum_impl`` policy; both carry non-finite marker columns, since
     even skipna-masked data may contain legitimate ±inf values.
+
+    Additive ops on sub-f32 floats accumulate — and return — f32 (see
+    ``_acc_dtype``); callers that want the input dtype back cast at the end.
     """
     if op == "sum":
         impl = _segment_sum_impl(data, size)
@@ -232,6 +252,10 @@ def _seg(op: str, data, codes, size: int):
             # non-finite handling is built into the GEMM (marker columns), so
             # skipna-masked and raw data take the same path
             return _seg_matmul_sum(data, codes, size)
+    if op in ("sum", "prod") and jnp.issubdtype(data.dtype, jnp.floating):
+        acc = _acc_dtype(data.dtype)
+        if data.dtype != acc:
+            data = data.astype(acc)
     fn = {
         "sum": jax.ops.segment_sum,
         "prod": jax.ops.segment_prod,
@@ -316,12 +340,23 @@ def _make_addlike(op: str, identity, skipna: bool):
         if mask is not None:
             data = jnp.where(mask, data, jnp.asarray(identity, dtype=data.dtype))
         data = _maybe_cast(data, dtype)
-        out = _seg(op, data, codes, size)
+        out = _seg(op, data, codes, size)  # f32-accumulated for bf16/f16
         if fill_value is not None and fill_value != identity:
             # numpy semantics: nansum of an all-NaN group is the identity (0),
             # so "empty" means zero *total* elements, not zero non-NaN ones.
             present = _counts(codes, size) > 0
             out = _fill_empty(out, present, fill_value)
+        if (
+            jnp.issubdtype(data.dtype, jnp.floating)
+            and out.dtype != data.dtype
+            and not kw.get("keep_acc", False)
+        ):
+            # result dtype contract: same as the (request-resolved) input.
+            # (int data is untouched — a NaN fill may have promoted it.)
+            # keep_acc=True keeps the f32 accumulator — the mesh chunk stage
+            # uses it so bf16 intermediates travel/psum in f32, casting back
+            # only at finalize.
+            out = out.astype(data.dtype)
         return _from_leading(out)
 
     return kernel
@@ -420,11 +455,14 @@ def _mean_impl(group_idx, array, *, size, fill_value, dtype, skipna):
         dtype = jnp.result_type(data.dtype, jnp.float32)
     sdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
     sdata = _maybe_cast(sdata, dtype)
-    total = _seg("sum", sdata, codes, size)
-    cnt = _counts(codes, size, mask=mask, dtype=sdata.dtype)
-    cnt = _bcast_present(cnt, total)
-    out = total / cnt
+    total = _seg("sum", sdata, codes, size)  # f32-accumulated for bf16/f16
+    # counts in int32: exact, and immune to the data dtype (bf16 counts
+    # saturate at 256 — the mean of 2000 values must not divide by 256)
+    cnt = _bcast_present(_counts(codes, size, mask=mask), total)
+    out = total / cnt.astype(total.dtype)
     out = _fill_empty(out, cnt > 0, fill_value if fill_value is not None else jnp.nan)
+    if out.dtype != sdata.dtype and jnp.issubdtype(sdata.dtype, jnp.floating):
+        out = out.astype(sdata.dtype)  # divide in f32, present as bf16
     return _from_leading(out)
 
 
@@ -438,9 +476,16 @@ def nanmean(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **k
 
 def _sum_of_squares(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, skipna=False, **kw):
     arr = jnp.asarray(array)
-    return (nansum if skipna else sum_)(
-        group_idx, arr * arr, axis=axis, size=size, fill_value=fill_value, dtype=dtype
+    out_dtype = arr.dtype
+    if jnp.issubdtype(arr.dtype, jnp.floating) and _acc_dtype(arr.dtype) != arr.dtype:
+        arr = arr.astype(_acc_dtype(arr.dtype))  # square in f32, not bf16
+    out = (nansum if skipna else sum_)(
+        group_idx, arr * arr, axis=axis, size=size, fill_value=fill_value, dtype=dtype,
+        keep_acc=kw.get("keep_acc", False),
     )
+    if dtype is None and not kw.get("keep_acc", False) and out.dtype != out_dtype and jnp.issubdtype(out_dtype, jnp.floating):
+        out = out.astype(out_dtype)
+    return out
 
 
 sum_of_squares = partial(_sum_of_squares, skipna=False)
@@ -461,22 +506,26 @@ def _var_impl(group_idx, array, *, size, fill_value, dtype, ddof, skipna, std):
         dtype = jnp.result_type(data.dtype, jnp.float32)
     zdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
     zdata = _maybe_cast(zdata, dtype)
-    cnt = _counts(codes, size, mask=mask, dtype=zdata.dtype)
-    total = _seg("sum", zdata, codes, size)
-    cnt_b = _bcast_present(cnt, total)
-    mean_g = total / jnp.where(cnt_b > 0, cnt_b, 1)
+    total = _seg("sum", zdata, codes, size)  # f32-accumulated for bf16/f16
+    cnt_b = _bcast_present(_counts(codes, size, mask=mask), total)  # int32, exact
+    cnt_f = cnt_b.astype(total.dtype)
+    mean_g = total / jnp.where(cnt_f > 0, cnt_f, 1)
     # gather each element's group mean and accumulate squared deviations
+    # (zdata - gathered promotes bf16 deviations to the f32 mean dtype, so
+    # the squared-deviation accumulation stays f32 end-to-end)
     gathered = jnp.take(jnp.concatenate([mean_g, jnp.zeros((1,) + mean_g.shape[1:], mean_g.dtype)]), codes, axis=0)
     dev = zdata - gathered
     if mask is not None:
         dev = jnp.where(mask, dev, jnp.zeros((), dev.dtype))
     m2 = _seg("sum", dev * dev, codes, size)
-    denom = cnt_b - ddof
+    denom = cnt_f - ddof
     out = m2 / jnp.where(denom > 0, denom, 1)
     out = jnp.where(denom > 0, out, jnp.asarray(jnp.nan, out.dtype))
     if std:
         out = jnp.sqrt(out)
     out = _fill_empty(out, cnt_b > 0, fill_value if fill_value is not None else jnp.nan)
+    if out.dtype != zdata.dtype and jnp.issubdtype(zdata.dtype, jnp.floating):
+        out = out.astype(zdata.dtype)
     return _from_leading(out)
 
 
@@ -512,10 +561,10 @@ def var_chunk(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, s
         dtype = jnp.result_type(data.dtype, jnp.float32)
     zdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
     zdata = _maybe_cast(zdata, dtype)
-    cnt = _counts(codes, size, mask=mask, dtype=zdata.dtype)
-    total = _seg("sum", zdata, codes, size)
-    cnt_b = _bcast_present(cnt, total)
-    mean_g = total / jnp.where(cnt_b > 0, cnt_b, 1)
+    total = _seg("sum", zdata, codes, size)  # f32-accumulated for bf16/f16
+    cnt_b = _bcast_present(_counts(codes, size, mask=mask), total)  # int32, exact
+    cnt_f = cnt_b.astype(total.dtype)
+    mean_g = total / jnp.where(cnt_f > 0, cnt_f, 1)
     gathered = jnp.take(
         jnp.concatenate([mean_g, jnp.zeros((1,) + mean_g.shape[1:], mean_g.dtype)]), codes, axis=0
     )
@@ -523,10 +572,13 @@ def var_chunk(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, s
     if mask is not None:
         dev = jnp.where(mask, dev, jnp.zeros((), dev.dtype))
     m2 = _seg("sum", dev * dev, codes, size)
-    if cnt_b.shape != total.shape:
-        cnt_b = jnp.broadcast_to(cnt_b, total.shape)
+    # the triple stays in the f32 accumulator dtype deliberately: these are
+    # cross-shard intermediates (psum'd by the Chan merge); the final dtype
+    # cast happens once, at finalize
+    if cnt_f.shape != total.shape:
+        cnt_f = jnp.broadcast_to(cnt_f, total.shape)
     return MultiArray(
-        (_from_leading(m2), _from_leading(total), _from_leading(cnt_b))
+        (_from_leading(m2), _from_leading(total), _from_leading(cnt_f))
     )
 
 
@@ -883,7 +935,12 @@ def _cumsum_impl(group_idx, array, *, size, dtype, skipna):
     mask = _nan_mask(sorted_data) if skipna else None
     vals = sorted_data if mask is None else jnp.where(mask, sorted_data, jnp.zeros((), sorted_data.dtype))
     vals = _maybe_cast(vals, dtype)
+    out_dtype = vals.dtype
+    if jnp.issubdtype(vals.dtype, jnp.floating) and _acc_dtype(vals.dtype) != vals.dtype:
+        vals = vals.astype(_acc_dtype(vals.dtype))  # bf16 running sums saturate
     scanned = _segmented_scan(vals, flags, jnp.add)
+    if scanned.dtype != out_dtype:
+        scanned = scanned.astype(out_dtype)
     return _from_leading(jnp.take(scanned, inv, axis=0))
 
 

@@ -93,8 +93,8 @@ def _kernel(
 
 @functools.lru_cache(maxsize=128)
 def _build(
-    n_pad: int, k_pad: int, size_p: int, dtype_str: str, n_tile: int, k_tile: int,
-    interpret: bool, compensated: bool,
+    n_pad: int, k_pad: int, size_p: int, dtype_str: str, acc_str: str, n_tile: int,
+    k_tile: int, interpret: bool, compensated: bool,
 ):
     import jax
     import jax.numpy as jnp
@@ -102,12 +102,15 @@ def _build(
 
     kern = functools.partial(_kernel, size_p=size_p, n_tile=n_tile, compensated=compensated)
     grid = (k_pad // k_tile, n_pad // n_tile)
-    dtype = jnp.dtype(dtype_str)
+    # Accumulator blocks are ``acc_str`` (f32 for bf16 data): the data tile
+    # streams HBM→VMEM at its narrow width and the MXU contracts bf16×bf16
+    # into f32 natively — a bf16 running sum would saturate at 256.
+    acc = jnp.dtype(acc_str)
     # the Kahan compensation term rides as a 5th output block (revisited per
     # k-tile like the sums); pallas scratch does not persist across the k
     # grid axis, an output block does. Uncompensated builds skip it entirely.
     n_out = 5 if compensated else 4
-    out_shape = [jax.ShapeDtypeStruct((size_p, k_pad), dtype)] * n_out
+    out_shape = [jax.ShapeDtypeStruct((size_p, k_pad), acc)] * n_out
 
     fn = pl.pallas_call(
         kern,
@@ -127,9 +130,10 @@ def segment_sum_pallas(data, codes, size: int, *, interpret: bool = False, compe
     """Segment-sum ``data`` (N, K...) by ``codes`` (N,) -> (size, K...).
 
     Exact IEEE semantics (NaN/±inf propagate per group+column); missing
-    labels (code outside [0, size)) drop out. f32/bf16 only.
-    ``compensated`` (default: the ``pallas_compensated`` option) applies
-    Kahan summation across tiles.
+    labels (code outside [0, size)) drop out. f32/bf16 only. bf16 data
+    accumulates — and returns — f32 (the MXU's native accumulate mode;
+    see kernels._acc_dtype). ``compensated`` (default: the
+    ``pallas_compensated`` option) applies Kahan summation across tiles.
     """
     import jax.numpy as jnp
 
@@ -156,8 +160,11 @@ def segment_sum_pallas(data, codes, size: int, *, interpret: bool = False, compe
     codes_p = jnp.pad(codes, (0, n_pad - n), constant_values=size_p).reshape(1, n_pad)
     flat_p = jnp.pad(flat, ((0, n_pad - n), (0, k_pad - k)))
 
+    from .kernels import _acc_dtype
+
     fn = _build(
-        n_pad, k_pad, size_p, str(flat.dtype), n_tile, k_tile, interpret, bool(compensated)
+        n_pad, k_pad, size_p, str(flat.dtype), str(jnp.dtype(_acc_dtype(flat.dtype))),
+        n_tile, k_tile, interpret, bool(compensated),
     )
     sums, nan_c, pos_c, neg_c, *_comp = fn(codes_p, flat_p)
 

@@ -58,6 +58,11 @@ def _local_chunk(agg: Aggregation, codes_sh, arr_sh, size: int, nat: bool):
             name, extra = entry, {}
         if nat:
             extra["nat"] = True
+        if name in ("sum", "nansum", "prod", "nanprod", "sum_of_squares", "nansum_of_squares"):
+            # bf16/f16 intermediates must travel and psum in the f32
+            # accumulator; the cast back to the final dtype happens once,
+            # at finalize (kernels._acc_dtype)
+            extra["keep_acc"] = True
         extra.update(agg.finalize_kwargs if name.startswith("var_chunk") else {})
         inters.append(
             generic_kernel(name, codes_sh, arr_sh, size=size, fill_value=fv, **extra)

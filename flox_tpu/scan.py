@@ -126,7 +126,7 @@ def groupby_scan(
             arr_flat, codes_flat, scan, size=size, dtype=dtype, mesh=mesh
         )
     else:
-        out = _apply_scan(scan, arr_flat, codes_flat, engine=engine, dtype=dtype)
+        out = _apply_scan(scan, arr_flat, codes_flat, size=size, engine=engine, dtype=dtype)
 
     # missing labels scan to NaN (they belong to no group)
     if (np.asarray(codes_flat) < 0).any():
@@ -142,7 +142,7 @@ def groupby_scan(
     return out
 
 
-def _apply_scan(scan: Scan, arr_flat, codes_flat, *, engine, dtype):
+def _apply_scan(scan: Scan, arr_flat, codes_flat, *, size, engine, dtype):
     from .aggregations import generic_aggregate
 
     return generic_aggregate(
@@ -150,7 +150,7 @@ def _apply_scan(scan: Scan, arr_flat, codes_flat, *, engine, dtype):
         arr_flat,
         engine=engine,
         func=scan.scan,
-        size=int(codes_flat.max()) + 1 if codes_flat.size else 1,
+        size=size,
         dtype=dtype,
     )
 

@@ -251,6 +251,60 @@ def test_datetime_minmax(engine):
     )
 
 
+def test_datetime_mean_casts_back(engine):
+    # non-dtype-preserving reductions of datetimes return the datetime dtype,
+    # NaN -> NaT (parity: reference core.py:1205-1211); var-like results keep
+    # numeric units (ns²) and counts/indices stay integral
+    dt = np.array(
+        ["2021-01-01T00", "2021-01-01T12", "2021-01-02T00", "NaT"],
+        dtype="datetime64[ns]",
+    )
+    labels = np.array([0, 0, 1, 1])
+    result, _ = groupby_reduce(dt, labels, func="nanmean", engine=engine)
+    assert result.dtype == dt.dtype
+    np.testing.assert_array_equal(
+        result, np.array(["2021-01-01T06", "2021-01-02T00"], dtype="datetime64[ns]")
+    )
+    # non-skipna mean propagates NaT
+    result, _ = groupby_reduce(dt, labels, func="mean", engine=engine)
+    assert not np.isnat(result[0]) and np.isnat(result[1])
+    # all-NaT group -> NaT
+    result, _ = groupby_reduce(
+        np.array(["2021-01-01", "NaT", "NaT"], dtype="datetime64[ns]"),
+        np.array([0, 1, 1]), func="nanmean", engine=engine,
+    )
+    assert np.isnat(result[1])
+    result, _ = groupby_reduce(dt, labels, func="nanmedian", engine=engine)
+    assert result.dtype == dt.dtype
+    assert result[1] == np.datetime64("2021-01-02T00", "ns")
+    result, _ = groupby_reduce(dt, labels, func="nanvar", engine=engine)
+    assert result.dtype.kind == "f"
+    result, _ = groupby_reduce(dt, labels, func="count", engine=engine)
+    assert result.dtype.kind == "i" and list(result) == [2, 1]
+    result, _ = groupby_reduce(dt, labels, func="nanargmax", engine=engine)
+    assert result.dtype.kind == "i" and list(result) == [1, 2]
+    # timedelta round-trips the same way
+    td = dt - dt[0]
+    result, _ = groupby_reduce(td, labels, func="nanmean", engine=engine)
+    assert result.dtype == td.dtype
+    assert result[0] == np.timedelta64(6 * 3600 * 10**9, "ns")
+
+
+def test_datetime_mean_mesh():
+    from flox_tpu.parallel import make_mesh
+
+    dt = np.array(
+        ["2021-01-01T00", "2021-01-01T12", "2021-01-02T00", "NaT"],
+        dtype="datetime64[ns]",
+    )
+    labels = np.array([0, 0, 1, 1])
+    result, _ = groupby_reduce(dt, labels, func="nanmean", method="map-reduce", mesh=make_mesh(4))
+    assert result.dtype == dt.dtype
+    np.testing.assert_array_equal(
+        result, np.array(["2021-01-01T06", "2021-01-02T00"], dtype="datetime64[ns]")
+    )
+
+
 def test_bool_input(engine):
     labels = np.array([0, 0, 1, 1])
     vals = np.array([True, False, True, True])
@@ -473,3 +527,16 @@ def test_three_groupers_product_grid(engine):
             for k in range(2):
                 expected[i, j, k] = vals[(b1 == i) & (b2 == j) & (b3 == k)].sum()
     np.testing.assert_allclose(np.asarray(result).astype(float), expected, rtol=1e-12)
+
+
+def test_datetime_sum_nat_propagates(engine):
+    # review regression: non-skipna sum must not cast the NaN-bearing float
+    # back to int64 mid-reduction (kernel dtype request skipped on the
+    # datetime path)
+    td = np.array([1000, 2000, 3000, "NaT"], dtype="timedelta64[ns]")
+    labels = np.array([0, 0, 1, 1])
+    result, _ = groupby_reduce(td, labels, func="sum", engine=engine)
+    assert result.dtype == td.dtype
+    assert result[0] == np.timedelta64(3000, "ns") and np.isnat(result[1])
+    result, _ = groupby_reduce(td, labels, func="nansum", engine=engine)
+    assert result[1] == np.timedelta64(3000, "ns")
