@@ -6,8 +6,9 @@ built on JAX/XLA: device-resident group codes, jit-compiled segment-reduce
 kernels, and shard_map/collective execution strategies over a TPU mesh.
 """
 
-from . import kernels, profiling
+from . import kernels, profiling, xrlite
 from .aggregations import Aggregation, Scan, is_supported_aggregation
+from .xarray import xarray_reduce
 from .rechunk import rechunk_for_blockwise, rechunk_for_cohorts, reshard_for_blockwise
 from .reindex import ReindexArrayType, ReindexStrategy
 from .core import groupby_reduce
@@ -40,6 +41,8 @@ __all__ = [
     "ReindexArrayType",
     "ReindexStrategy",
     "set_options",
+    "xarray_reduce",
+    "xrlite",
 ]
 
 __version__ = "0.1.0"

@@ -2,12 +2,12 @@
 
 Parity target: /root/reference/flox/xarray.py:73-516 — named/DataArray
 groupers, dim=... semantics, skipna -> nan-func rewriting (xarray.py:369-371),
-``xr.apply_ufunc`` dispatch (416-446), coordinate/attr restoration (448-516).
+``xr.apply_ufunc`` dispatch (416-446), coordinate/attr restoration and dim
+order (448-516, 37-50), MultiIndex group coords (263-269, 468-479).
 
-xarray is an optional dependency (as in the reference); every entry point
-raises a clear ImportError without it. The helpers that do not need xarray
-objects (func rewriting, dim resolution) are plain functions so they stay
-unit-testable without the package.
+The adapter binds to real xarray when installed and to :mod:`flox_tpu.xrlite`
+otherwise — the same code path runs either way, so adapter behavior is
+exercised in CI even without the xarray package.
 """
 
 from __future__ import annotations
@@ -23,15 +23,35 @@ from .utils import HAS_XARRAY
 __all__ = ["xarray_reduce", "rechunk_for_blockwise"]
 
 
-def _require_xarray():
-    if not HAS_XARRAY:
-        raise ImportError(
-            "xarray is required for flox_tpu.xarray.xarray_reduce; install xarray "
-            "or use flox_tpu.groupby_reduce on raw arrays."
-        )
-    import xarray as xr
+def _get_xr():
+    """Real xarray if installed, else the bundled xrlite subset."""
+    if HAS_XARRAY:
+        import xarray as xr
 
-    return xr
+        return xr
+    from . import xrlite
+
+    return xrlite
+
+
+_require_xarray = _get_xr  # backwards-compatible alias
+
+
+def _restore_dim_order(result, obj, by, no_groupby_reorder: bool = False):
+    """Reorder result dims to match the input object's order, slotting the
+    new group dim where the grouped dim was (parity: xarray.py:37-50)."""
+
+    def lookup_order(dimension):
+        if dimension == by.name and by.ndim == 1:
+            (dimension,) = by.dims
+            if no_groupby_reorder:
+                return -1e6  # group dim first
+        if dimension in obj.dims:
+            return list(obj.dims).index(dimension)
+        return 1e6  # new dims (e.g. quantile) go last
+
+    new_order = sorted(result.dims, key=lookup_order)
+    return result.transpose(*new_order)
 
 
 def _rewrite_func_for_skipna(func: str, skipna: bool | None) -> str:
@@ -82,9 +102,10 @@ def xarray_reduce(
     ``by`` entries may be variable/coordinate names or DataArrays. Returns
     an object of the same type with the reduced dims replaced by one dim per
     grouper (named after the grouper, with the discovered/expected groups as
-    its coordinate).
+    its coordinate). Works on real xarray objects when xarray is installed,
+    and on :mod:`flox_tpu.xrlite` objects otherwise.
     """
-    xr = _require_xarray()
+    xr = _get_xr()
     from .core import groupby_reduce
 
     if not by:
@@ -103,13 +124,24 @@ def xarray_reduce(
         passthrough = {}
         for name, var in obj.data_vars.items():
             if all(d in var.dims for d in target_dims):
-                reduced_vars[name] = xarray_reduce(
+                reduced = xarray_reduce(
                     var, *by_named, func=func, expected_groups=expected_groups,
                     isbin=isbin, sort=sort, dim=dim, fill_value=fill_value,
                     dtype=dtype, method=method, engine=engine,
                     keep_attrs=keep_attrs, skipna=None, min_count=min_count,
                     mesh=mesh, **finalize_kwargs,
                 )
+                if len(by_named) == 1 and reduced.ndim > 1:
+                    # dataset members put the group dim first (parity:
+                    # xarray.py:497-505, no_groupby_reorder)
+                    first_isbin = isbin if isinstance(isbin, bool) else bool(isbin[0])
+                    by_o = by_named[0]
+                    if first_isbin:
+                        by_o = by_o.rename(f"{by_o.name}_bins")
+                    reduced = _restore_dim_order(
+                        reduced, var, by_o, no_groupby_reorder=True
+                    )
+                reduced_vars[name] = reduced
             else:
                 passthrough[name] = var
         out = xr.Dataset(reduced_vars, attrs=obj.attrs if keep_attrs else None)
@@ -130,6 +162,21 @@ def xarray_reduce(
         else:
             by_das.append(b)
     by_names = [getattr(b, "name", None) or f"group_{i}" for i, b in enumerate(by_das)]
+
+    def _mi_level_names(b):
+        """Level names when the grouper is MultiIndex-backed, else None."""
+        if isinstance(getattr(b, "data", None), pd.MultiIndex):
+            return tuple(b.data.names)
+        if getattr(b, "ndim", 0) == 1 and hasattr(b, "to_index"):
+            try:
+                idx = b.to_index()
+            except Exception:
+                return None
+            if isinstance(idx, pd.MultiIndex):
+                return tuple(idx.names)
+        return None
+
+    mi_names = [_mi_level_names(b) for b in by_das]
 
     grouper_dims = tuple(dict.fromkeys(d for b in by_das for d in b.dims))
     dims = _resolve_dim(dim, grouper_dims, tuple(obj.dims))
@@ -220,13 +267,41 @@ def xarray_reduce(
     )
 
     # attach group coordinates (parity: xarray.py:448-516)
-    for name, groups in zip(new_dim_names, groups_out):
-        if isinstance(groups, pd.IntervalIndex):
+    def _assign_multiindex(obj_, name, mi):
+        """Modern real xarray rejects a raw MultiIndex in assign_coords;
+        it wants Coordinates.from_pandas_multiindex. xrlite (and older
+        xarray) accept the index directly."""
+        if HAS_XARRAY and hasattr(xr, "Coordinates"):
+            try:
+                return obj_.assign_coords(xr.Coordinates.from_pandas_multiindex(mi, name))
+            except Exception:
+                pass
+        return obj_.assign_coords({name: mi})
+
+    for name, groups, names_mi in zip(new_dim_names, groups_out, mi_names):
+        if isinstance(groups, pd.MultiIndex):
+            actual = _assign_multiindex(actual, name, groups)
+        elif isinstance(groups, pd.IntervalIndex):
             actual = actual.assign_coords({name: groups})
+        elif names_mi is not None and len(groups) and isinstance(groups[0], tuple):
+            # grouping by a MultiIndex coord: factorize discovered tuples;
+            # rebuild the MultiIndex with its level names (parity:
+            # xarray.py:468-479)
+            actual = _assign_multiindex(
+                actual, name, pd.MultiIndex.from_tuples(list(groups), names=names_mi)
+            )
         else:
             actual = actual.assign_coords({name: np.asarray(groups)})
     if has_q_dim:
         actual = actual.assign_coords({"quantile": np.asarray(q, dtype=float)})
+    # dim order: slot the group dim where the grouped dim was
+    # (parity: xarray.py:37-50, applied at 495-505). The lookup compares
+    # against the result's dim name, so binned groupers need the _bins name.
+    if nby == 1 and actual.ndim > 1:
+        by_for_order = by_das[0]
+        if new_dim_names[0] != by_names[0]:
+            by_for_order = by_for_order.rename(new_dim_names[0])
+        actual = _restore_dim_order(actual, obj, by_for_order)
     return actual
 
 
