@@ -92,6 +92,14 @@ class Aggregation:
     * ``chunk``:   kernels run per shard/block producing dense intermediates.
     * ``combine``: merge ops applied across shards/blocks (collectives).
     * ``finalize``: maps combined intermediates -> final result.
+
+    ``numpy``/``chunk`` entries may be user callables with the engine plugin
+    signature ``f(group_idx, array, *, axis, size, fill_value, dtype, **kw)``.
+    ``combine`` entries may be user callables too: on the mesh the shards'
+    dense intermediates are all-gathered and the callable folds the stack,
+    ``op(stacked)`` with ``stacked`` shaped ``(n_shards, ..., size)`` ->
+    ``(..., size)`` (the collective analogue of the reference's
+    ``_grouped_combine``, dask.py:233-317).
     """
 
     name: str

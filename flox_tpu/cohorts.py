@@ -33,7 +33,67 @@ import numpy as np
 
 logger = logging.getLogger("flox_tpu")
 
-__all__ = ["find_group_cohorts", "chunks_from_shards"]
+__all__ = ["find_group_cohorts", "chunks_from_shards", "ownership_permutation"]
+
+
+def ownership_permutation(
+    mapping: dict[tuple[int, ...], list[int]], size: int, n_shards: int
+) -> np.ndarray | None:
+    """Group-ownership permutation aligning psum_scatter slices with cohorts.
+
+    ``mapping`` is ``find_group_cohorts``' cohort → labels dict. The cohorts
+    mesh program scatters the group axis in ``n_shards`` equal tiles — tile
+    ``d`` lands on device ``d`` — so ownership is positional. This computes a
+    permutation placing each cohort's labels in the tiles of the shards that
+    actually hold the cohort's data (the locality economics of the
+    reference's per-cohort subgraphs, cohorts.py:109-301, expressed as a
+    static gather): device ``d`` then finalizes groups whose rows mostly
+    live on ``d``, and downstream shard-local consumers read their own
+    groups without cross-device traffic.
+
+    Returns ``perm`` of length ``n_shards * ceil(size / n_shards)`` mapping
+    slot → group id (ids ≥ ``size`` are padding), or None when the mapping
+    gives no usable locality (empty, or one cohort spanning everything).
+    """
+    if not mapping:
+        return None
+    cap = math.ceil(size / n_shards)
+    size_pad = cap * n_shards
+    load = [0] * n_shards
+    slots: list[list[int]] = [[] for _ in range(n_shards)]
+
+    def place(label: int, prefs: Sequence[int]) -> None:
+        for d in prefs:
+            if load[d] < cap:
+                slots[d].append(label)
+                load[d] += 1
+                return
+        d = int(np.argmin(load))
+        slots[d].append(label)
+        load[d] += 1
+
+    assigned = np.zeros(size, dtype=bool)
+    # widest cohorts first so their preferred shards still have capacity
+    for chunk_set, labels in sorted(mapping.items(), key=lambda kv: -len(kv[1])):
+        prefs = sorted(
+            (d for d in chunk_set if d < n_shards), key=lambda d: load[d]
+        )
+        for lab in labels:
+            if 0 <= lab < size and not assigned[lab]:
+                place(int(lab), prefs)
+                assigned[lab] = True
+    for lab in np.flatnonzero(~assigned):
+        place(int(lab), ())
+
+    perm = np.full(size_pad, size, dtype=np.int64)  # `size` = zero-pad column
+    for d in range(n_shards):
+        start = d * cap
+        perm[start : start + len(slots[d])] = slots[d]
+    identity = np.arange(size_pad)
+    identity[size:] = size
+    if np.array_equal(perm, identity):
+        return None  # positional ownership is already aligned
+    return perm
 
 
 def chunks_from_shards(n: int, n_shards: int) -> tuple[int, ...]:

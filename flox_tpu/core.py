@@ -372,12 +372,18 @@ def groupby_reduce(
     if method is None and mesh is not None:
         # user opted into the mesh without picking a method: let cohort
         # detection recommend one (the reference's _choose_method defers to
-        # find_group_cohorts the same way)
+        # find_group_cohorts the same way). Shard count = the product of the
+        # *named* mesh axes — on a 2-D mesh sharded over one axis, the data
+        # splits over that axis only, not mesh.devices.size.
         from .cohorts import chunks_from_shards, find_group_cohorts
+        from .parallel.mapreduce import _norm_axes
 
+        n_shards = int(
+            np.prod([mesh.shape[a] for a in _norm_axes(axis_name, mesh)])
+        )
         flat = np.asarray(codes).reshape(-1)
         method, _ = find_group_cohorts(
-            flat, chunks_from_shards(flat.shape[0], mesh.devices.size),
+            flat, chunks_from_shards(flat.shape[0], n_shards),
             expected_groups=range(size),
         )
         logger.debug("groupby_reduce: auto-selected method=%s", method)
@@ -531,7 +537,13 @@ def _reduce_blockwise(arr_flat, codes_flat, agg: Aggregation, *, size, engine, d
     else:
         counts = None
 
-    result = results[0]
+    if agg.finalize is not None and len(agg.numpy) > 1:
+        # multi-stage custom Aggregation: the eager stages are intermediates
+        # and finalize folds them (parity: _finalize_results, core.py:410-475).
+        # Registry aggs use a single fused eager kernel, already final.
+        result = agg.finalize(*results, **agg.finalize_kwargs)
+    else:
+        result = results[0]
 
     if counts is not None:
         result = _where(counts < agg.min_count, agg.final_fill_value, result)

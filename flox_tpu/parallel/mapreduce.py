@@ -46,7 +46,12 @@ _BIG = np.iinfo(np.int32).max
 
 
 def _local_chunk(agg: Aggregation, codes_sh, arr_sh, size: int, nat: bool):
-    """Run the agg's chunk kernels on this shard -> list of intermediates."""
+    """Run the agg's chunk kernels on this shard -> list of intermediates.
+
+    Chunk entries may be kernel names or user callables with the plugin
+    signature ``f(group_idx, array, *, axis, size, fill_value, dtype, **kw)``
+    (the reference's custom-Aggregation contract, aggregations.py:161-301).
+    """
     from ..kernels import generic_kernel
 
     inters = []
@@ -58,6 +63,9 @@ def _local_chunk(agg: Aggregation, codes_sh, arr_sh, size: int, nat: bool):
             name, extra = entry, {}
         if nat:
             extra["nat"] = True
+        if callable(name):
+            inters.append(name(codes_sh, arr_sh, size=size, fill_value=fv, **extra))
+            continue
         if name in ("sum", "nansum", "prod", "nanprod", "sum_of_squares", "nansum_of_squares"):
             # bf16/f16 intermediates must travel and psum in the f32
             # accumulator; the cast back to the final dtype happens once,
@@ -264,6 +272,19 @@ def sharded_groupby_reduce(
             for fv in agg.fill_value.get("intermediate", ())
         )
 
+    cohort_perm = None
+    if method == "cohorts":
+        # align psum_scatter ownership tiles with detected cohorts (memoized
+        # detection — the auto-method path already ran it on these codes)
+        from ..cohorts import chunks_from_shards, find_group_cohorts, ownership_permutation
+
+        codes_np = np.asarray(codes).reshape(-1)
+        _, mapping = find_group_cohorts(
+            codes_np, chunks_from_shards(codes_np.shape[0], ndev),
+            expected_groups=range(size),
+        )
+        cohort_perm = ownership_permutation(mapping, size, ndev)
+
     arr = utils.asarray_device(array)
     codes_dev = jnp.asarray(np.asarray(codes), dtype=jnp.int32)
     n = codes_dev.shape[0]
@@ -289,12 +310,13 @@ def sharded_groupby_reduce(
     cache_key = (
         _agg_cache_key(agg), size, size_pad, method, axes, shard_len, nat,
         mesh, arr.ndim, trace_fingerprint(),
+        None if cohort_perm is None else cohort_perm.tobytes(),
     )
     fn = _PROGRAM_CACHE.get(cache_key)
     if fn is None:
         program = _build_program(
             agg, size=size, size_pad=size_pad, method=method, axis_name=axes,
-            shard_len=shard_len, nat=nat,
+            shard_len=shard_len, nat=nat, cohort_perm=cohort_perm,
         )
         # check_vma=False: outputs are replicated by construction (psum /
         # all_gather), but the static checker cannot infer that through
@@ -325,7 +347,9 @@ def _agg_cache_key(agg: Aggregation):
         if isinstance(v, dict):
             return tuple(sorted((k, h(x)) for k, x in v.items()))
         if callable(v):
-            return getattr(v, "__qualname__", repr(v))
+            # id() too: distinct lambdas share a "<lambda>" qualname and must
+            # not collide in the program cache
+            return (getattr(v, "__qualname__", repr(v)), id(v))
         return repr(v) if isinstance(v, np.generic) else v
 
     return (
@@ -376,9 +400,18 @@ def _apply_final_fill(result, counts, agg: Aggregation):
     return jnp.where(empty_b, fv.astype(result.dtype), result)
 
 
-def _build_program(agg, *, size, size_pad, method, axis_name, shard_len, nat):
+def _build_program(agg, *, size, size_pad, method, axis_name, shard_len, nat, cohort_perm=None):
     import jax
     import jax.numpy as jnp
+
+    if cohort_perm is not None:
+        # slot -> group (size_pad; `size` = zero-pad column) and its inverse
+        # group -> slot (size) — static constants baked into the program
+        perm_c = jnp.asarray(cohort_perm, dtype=jnp.int32)
+        inv_np = np.empty(size, dtype=np.int64)
+        valid = cohort_perm < size
+        inv_np[cohort_perm[valid]] = np.flatnonzero(valid)
+        inv_c = jnp.asarray(inv_np, dtype=jnp.int32)
 
     skipna = agg.name.startswith("nan") or agg.name == "count"
     # min_count thresholds count non-NaN contributions (the reference appends
@@ -430,6 +463,19 @@ def _build_program(agg, *, size, size_pad, method, axis_name, shard_len, nat):
         for inter, op in zip(inters, agg.combine):
             if op == "var":
                 combined.append(_combine_var(inter, axis_name))
+            elif callable(op):
+                # general combine for user Aggregations (the reference's
+                # _grouped_combine role, dask.py:233-317): gather every
+                # shard's dense intermediate and hand the stack to the user
+                # fold — contract: op(stacked) with stacked (ndev, ..., size)
+                # -> (..., size). Leaf-wise over MultiArray pytrees.
+                if isinstance(inter, MultiArray):
+                    gathered = MultiArray(
+                        tuple(jax.lax.all_gather(a, axis_name) for a in inter.arrays)
+                    )
+                else:
+                    gathered = jax.lax.all_gather(inter, axis_name)
+                combined.append(op(gathered))
             else:
                 # marker re-injection only for propagating (non-skipna) aggs:
                 # skipna identity fills (iinfo.min for int nanmax) would
@@ -456,7 +502,21 @@ def _build_program(agg, *, size, size_pad, method, axis_name, shard_len, nat):
             widths = [(0, 0)] * (x.ndim - 1) + [(0, size_pad - size)]
             return jnp.pad(x, widths)
 
-        counts_local = pad_groups(_local_counts(codes_sh, arr_sh, size, count_skipna, nat))
+        def to_slots(x):
+            """Pad the group axis and place groups in their ownership slots
+            (identity layout when no cohort alignment was found)."""
+            x = pad_groups(x)
+            if cohort_perm is not None:
+                x = jnp.take(x, perm_c, axis=-1)
+            return x
+
+        def from_slots(full):
+            """Gathered slot layout -> original group order, cropped."""
+            if cohort_perm is not None:
+                return jnp.take(full, inv_c, axis=-1)
+            return _crop(full, size)
+
+        counts_local = to_slots(_local_counts(codes_sh, arr_sh, size, count_skipna, nat))
         counts_own = jax.lax.psum_scatter(
             jnp.moveaxis(counts_local, -1, 0), axis_name, scatter_dimension=0, tiled=True
         )
@@ -470,23 +530,23 @@ def _build_program(agg, *, size, size_pad, method, axis_name, shard_len, nat):
                 # totals, so do it leaf-wise after scattering sums
                 m2, total, nn = inter.arrays
                 mu_d = total / jnp.where(nn > 0, nn, 1)
-                big_t = _pscatter(pad_groups(total), axis_name)
-                big_n = _pscatter(pad_groups(nn), axis_name)
+                big_t = _pscatter(to_slots(total), axis_name)
+                big_n = _pscatter(to_slots(nn), axis_name)
                 # mu over owned slice must be compared against each shard's
                 # mu_d — requires the adjustment before scattering:
                 # psum_scatter(m2 + n*(mu_d - mu)^2) with mu broadcast back.
                 mu = big_t / jnp.where(big_n > 0, big_n, 1)
                 mu_full = _unscatter_broadcast(mu, axis_name)
-                adj = nn * (mu_d - _crop(mu_full, nn.shape[-1])) ** 2
-                big_m2 = _pscatter(pad_groups(m2 + adj), axis_name)
+                adj = nn * (mu_d - from_slots(mu_full)) ** 2
+                big_m2 = _pscatter(to_slots(m2 + adj), axis_name)
                 owned.append(MultiArray((big_m2, big_t, big_n)))
             else:
-                owned.append(_pscatter(pad_groups(inter), axis_name))
+                owned.append(_pscatter(to_slots(inter), axis_name))
 
         result_own = finalize(owned, counts_own)
         # replicate: gather the owned slices back into the full group axis
         full = jax.lax.all_gather(jnp.moveaxis(result_own, -1, 0), axis_name, tiled=True)
-        return _crop(jnp.moveaxis(full, 0, -1), size)
+        return from_slots(jnp.moveaxis(full, 0, -1))
 
     def blockwise_program(arr_sh, codes_sh):
         from ..kernels import generic_kernel
@@ -496,10 +556,18 @@ def _build_program(agg, *, size, size_pad, method, axis_name, shard_len, nat):
         if nat:
             kw["nat"] = True
         locals_ = [
-            generic_kernel(f, codes_sh, arr_sh, size=size, fill_value=None, **kw)
+            f(codes_sh, arr_sh, size=size, fill_value=None, **kw)
+            if callable(f)
+            else generic_kernel(f, codes_sh, arr_sh, size=size, fill_value=None, **kw)
             for f in agg.numpy
         ]
-        result_local = locals_[1] if agg.reduction_type == "argreduce" and len(locals_) > 1 else locals_[0]
+        if agg.reduction_type == "argreduce" and len(locals_) > 1:
+            result_local = locals_[1]
+        elif agg.finalize is not None and len(agg.numpy) > 1:
+            # multi-stage custom Aggregation (see core._reduce_blockwise)
+            result_local = agg.finalize(*locals_, **agg.finalize_kwargs)
+        else:
+            result_local = locals_[0]
         if agg.reduction_type == "argreduce":
             offset = _flat_axis_index(axis_name).astype(jnp.int32) * shard_len
             result_local = jnp.where(result_local >= 0, result_local + offset, -1)

@@ -38,9 +38,16 @@ def sharded_groupby_scan(
     mesh=None,
     axis_name: str = "data",
     dtype=None,
+    method: str = "blelloch",
 ):
     """Sharded grouped scan over the trailing axis. Returns same shape as
-    ``array`` (padded positions stripped)."""
+    ``array`` (padded positions stripped).
+
+    ``method="blockwise"`` skips the carry exchange entirely — valid only
+    when every group is shard-local (validated host-side; the analogue of
+    the reference's blockwise scan after rechunk_for_blockwise,
+    scan.py:48-78 + dask.py:624-651).
+    """
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -49,6 +56,9 @@ def sharded_groupby_scan(
         mesh = _cached_mesh_default()
     axes = _norm_axes(axis_name, mesh)
     ndev = int(np.prod([mesh.shape[a] for a in axes]))
+
+    if method == "blockwise":
+        _validate_shard_local(np.asarray(codes).reshape(-1), ndev)
 
     arr = utils.asarray_device(array)
     if dtype is not None:
@@ -67,10 +77,13 @@ def sharded_groupby_scan(
 
     from ..options import trace_fingerprint
 
-    cache_key = (scan.name, size, axes, mesh, arr.ndim, str(arr.dtype), trace_fingerprint())
+    cache_key = (scan.name, size, axes, mesh, arr.ndim, str(arr.dtype), method, trace_fingerprint())
     fn = _SCAN_CACHE.get(cache_key)
     if fn is None:
-        program = _build_scan_program(scan, size=size, axis_name=axes)
+        if method == "blockwise":
+            program = _build_blockwise_scan_program(scan, size=size)
+        else:
+            program = _build_scan_program(scan, size=size, axis_name=axes)
         fn = jax.jit(
             jax.shard_map(program, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
         )
@@ -81,6 +94,42 @@ def sharded_groupby_scan(
     if pad:
         out = out[..., :n]
     return out
+
+
+def _validate_shard_local(codes: np.ndarray, ndev: int) -> None:
+    """Blockwise precondition: every group's positions within one shard."""
+    n = codes.shape[0]
+    shard_len = -(-n // ndev) if n else 1
+    valid = codes >= 0
+    if not valid.any():
+        return
+    shard_of = np.arange(n) // shard_len
+    order = np.argsort(codes[valid], kind="stable")
+    grp = codes[valid][order]
+    shd = shard_of[valid][order]
+    boundaries = np.flatnonzero(np.diff(grp)) + 1
+    firsts = np.r_[0, boundaries]
+    lasts = np.r_[boundaries, grp.size] - 1
+    bad = np.flatnonzero(shd[firsts] != shd[lasts])
+    if bad.size:
+        i = firsts[bad[0]]
+        raise ValueError(
+            f"method='blockwise' needs every group on one shard, but group "
+            f"{int(grp[i])} spans shards {int(shd[i])}..{int(shd[lasts[bad[0]]])}; "
+            "reshard first (rechunk.reshard_for_blockwise) or use "
+            "method='blelloch'."
+        )
+
+
+def _build_blockwise_scan_program(scan: Scan, *, size):
+    """Shard-local groups: the within-shard segmented scan IS the answer —
+    zero collectives (parity: the reference's blockwise scan, dask.py:624-651)."""
+    from ..kernels import generic_kernel
+
+    def program(arr_sh, codes_sh):
+        return generic_kernel(scan.scan, codes_sh, arr_sh, size=size)
+
+    return program
 
 
 def _build_scan_program(scan: Scan, *, size, axis_name):

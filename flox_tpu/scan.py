@@ -55,16 +55,12 @@ def groupby_scan(
         raise ValueError("groupby_scan supports a single axis only (like the reference).")
     if method not in (None, "blelloch", "blockwise"):
         raise ValueError(f"scan method must be None, 'blelloch' or 'blockwise'; got {method!r}")
-    if method is None and mesh is not None:
-        if engine is not None:
-            raise ValueError(
-                "engine= selects a single-device kernel but mesh= requests "
-                "distributed execution; pass method='blelloch' (engine is "
-                "ignored on the mesh) or drop one of the two."
-            )
-        # a mesh without a method means distributed: Blelloch is the general
-        # scan (parity: _choose_scan_method, reference scan.py:48-78)
-        method = "blelloch"
+    if method is None and mesh is not None and engine is not None:
+        raise ValueError(
+            "engine= selects a single-device kernel but mesh= requests "
+            "distributed execution; pass method='blelloch' (engine is "
+            "ignored on the mesh) or drop one of the two."
+        )
     engine = engine or OPTIONS["default_engine"]
     nby = len(by)
 
@@ -113,17 +109,33 @@ def groupby_scan(
     if scan.name in ("cumsum", "nancumsum") and dtype is None:
         if arr_dtype.kind in "iub":
             dtype = np.result_type(arr_dtype, np.int_)
-    if method == "blockwise" and mesh is not None:
-        raise NotImplementedError(
-            "method='blockwise' with a mesh is not implemented for scans; "
-            "use method='blelloch' (distributed) or omit method (single device)."
+    if method is None and mesh is not None:
+        # auto method (parity: _choose_scan_method, reference scan.py:48-78):
+        # blockwise when the layout analysis proves every group shard-local
+        # AND the scan covers all by dims; the general fallback is Blelloch
+        from .cohorts import chunks_from_shards, find_group_cohorts
+        from .parallel.mapreduce import _norm_axes
+
+        # shard count = the named mesh axes the scan executes over ("data"),
+        # not the whole mesh (same fix as core.groupby_reduce's heuristic)
+        n_shards = int(
+            np.prod([mesh.shape[a] for a in _norm_axes("data", mesh)])
         )
-    if method == "blelloch":
-        # sharded Blelloch scan over the mesh (parallel/scan.py)
+        preferred, _ = find_group_cohorts(
+            codes_flat, chunks_from_shards(codes_flat.shape[0], n_shards),
+            expected_groups=range(size),
+        )
+        method = "blockwise" if (preferred == "blockwise" and bndim == 1) else "blelloch"
+        logger.debug("groupby_scan: auto-selected method=%s", method)
+
+    if mesh is not None or method == "blelloch":
+        # sharded scan over the mesh (parallel/scan.py); method='blelloch'
+        # without a mesh means "distribute over the default mesh"
         from .parallel.scan import sharded_groupby_scan
 
         out = sharded_groupby_scan(
-            arr_flat, codes_flat, scan, size=size, dtype=dtype, mesh=mesh
+            arr_flat, codes_flat, scan, size=size, dtype=dtype, mesh=mesh,
+            method=method or "blelloch",
         )
     else:
         out = _apply_scan(scan, arr_flat, codes_flat, size=size, engine=engine, dtype=dtype)

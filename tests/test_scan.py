@@ -87,3 +87,60 @@ def test_ffill_bfill_reversal(engine):
     b = np.asarray(groupby_scan(values, codes, func="bfill", engine=engine))
     f_rev = np.asarray(groupby_scan(values[::-1], codes[::-1], func="ffill", engine=engine))[::-1]
     np.testing.assert_allclose(b, f_rev, equal_nan=True)
+
+
+class TestScanMethodSelection:
+    """_choose_scan_method parity (reference scan.py:48-78) + the mesh
+    blockwise scan (VERDICT #6)."""
+
+    def _mesh(self):
+        from flox_tpu.parallel import make_mesh
+
+        return make_mesh(8)
+
+    def test_auto_blockwise_when_shard_local(self):
+        from flox_tpu import groupby_scan
+
+        n = 96
+        vals = np.random.default_rng(3).normal(size=n)
+        labels = np.arange(n) // 12  # one group per shard
+        out_mesh = groupby_scan(vals, labels, func="nancumsum", mesh=self._mesh())
+        out_eager = groupby_scan(vals, labels, func="nancumsum")
+        np.testing.assert_allclose(
+            np.asarray(out_mesh), np.asarray(out_eager), rtol=1e-12, equal_nan=True
+        )
+
+    def test_auto_blelloch_when_spread(self):
+        from flox_tpu import groupby_scan
+
+        n = 96
+        vals = np.random.default_rng(4).normal(size=n)
+        labels = np.arange(n) % 5
+        out_mesh = groupby_scan(vals, labels, func="cumsum", mesh=self._mesh())
+        out_eager = groupby_scan(vals, labels, func="cumsum")
+        np.testing.assert_allclose(
+            np.asarray(out_mesh), np.asarray(out_eager), rtol=1e-12
+        )
+
+    @pytest.mark.parametrize("func", ["cumsum", "nancumsum", "ffill", "bfill"])
+    def test_forced_blockwise_matches_eager(self, func):
+        from flox_tpu import groupby_scan
+
+        n = 96
+        vals = np.random.default_rng(5).normal(size=n)
+        vals[::7] = np.nan
+        labels = np.arange(n) // 12
+        out_bw = groupby_scan(vals, labels, func=func, method="blockwise", mesh=self._mesh())
+        out_eager = groupby_scan(vals, labels, func=func)
+        np.testing.assert_allclose(
+            np.asarray(out_bw), np.asarray(out_eager), rtol=1e-12, equal_nan=True
+        )
+
+    def test_forced_blockwise_invalid_layout_raises(self):
+        from flox_tpu import groupby_scan
+
+        n = 96
+        vals = np.random.default_rng(6).normal(size=n)
+        labels = np.arange(n) % 5  # every group spans every shard
+        with pytest.raises(ValueError, match="spans shards"):
+            groupby_scan(vals, labels, func="cumsum", method="blockwise", mesh=self._mesh())

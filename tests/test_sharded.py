@@ -311,3 +311,113 @@ def test_sharded_datetime_firstlast(mesh):
         sharded, _ = groupby_reduce(dt, labels, func=func, method="map-reduce", mesh=mesh)
         eager, _ = groupby_reduce(dt, labels, func=func, engine="numpy")
         np.testing.assert_array_equal(np.asarray(sharded), np.asarray(eager), err_msg=func)
+
+
+def test_custom_aggregation_on_mesh():
+    """User Aggregation with callable chunk/combine/finalize produces
+    identical results eager vs every mesh method (VERDICT #4; the collective
+    analogue of the reference's _grouped_combine, dask.py:233-317)."""
+    import jax.numpy as jnp
+
+    from flox_tpu import Aggregation, groupby_reduce
+    from flox_tpu.parallel import make_mesh
+
+    def sq_sum(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+        from flox_tpu.kernels import generic_kernel
+
+        a = jnp.asarray(array)
+        return generic_kernel("nansum", group_idx, a * a, size=size, fill_value=0.0)
+
+    def cnt(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+        from flox_tpu.kernels import generic_kernel
+
+        return generic_kernel("nanlen", group_idx, array, size=size)
+
+    rms = Aggregation(
+        "rms", numpy=(sq_sum, cnt), chunk=(sq_sum, cnt),
+        combine=(lambda s: s.sum(0), lambda s: s.sum(0)),
+        finalize=lambda ss, n, **kw: (ss / n) ** 0.5,
+        fill_value={"intermediate": (0.0, 0)}, final_fill_value=np.nan,
+    )
+
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=96)
+    labels = np.arange(96) % 5
+    oracle = np.array([np.sqrt((vals[labels == g] ** 2).mean()) for g in range(5)])
+    mesh = make_mesh(8)
+
+    res_eager, _ = groupby_reduce(vals, labels, func=rms)
+    np.testing.assert_allclose(np.asarray(res_eager, dtype=float), oracle, rtol=1e-12)
+    for method in ["map-reduce", "cohorts"]:
+        res, _ = groupby_reduce(vals, labels, func=rms, method=method, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(res, dtype=float), oracle, rtol=1e-12)
+    # blockwise: shard-aligned labels
+    labels_b = np.arange(96) // 12
+    oracle_b = np.array([np.sqrt((vals[labels_b == g] ** 2).mean()) for g in range(8)])
+    res, _ = groupby_reduce(vals, labels_b, func=rms, method="blockwise", mesh=mesh)
+    np.testing.assert_allclose(np.asarray(res, dtype=float), oracle_b, rtol=1e-12)
+
+
+def test_cohort_aligned_ownership():
+    """Interleaved-months layout: psum_scatter ownership tiles follow the
+    detected cohorts, and the permuted program matches eager (VERDICT #5)."""
+    from flox_tpu import groupby_reduce
+    from flox_tpu.cohorts import (
+        chunks_from_shards,
+        find_group_cohorts,
+        ownership_permutation,
+    )
+    from flox_tpu.parallel import make_mesh
+
+    # shard s (of 4) holds months {s, s+4, s+8}: cohorts are shard-local but
+    # positionally interleaved across the group axis
+    labels = np.concatenate([np.tile([s, s + 4, s + 8], 8) for s in range(4)])
+    n = labels.shape[0]
+    method, mapping = find_group_cohorts(
+        labels, chunks_from_shards(n, 4), expected_groups=range(12)
+    )
+    assert method in ("cohorts", "blockwise")
+    perm = ownership_permutation(mapping, 12, 4)
+    assert perm is not None
+    for s in range(4):  # device s's tile holds exactly its months
+        assert set(perm[3 * s : 3 * s + 3]) == {s, s + 4, s + 8}
+
+    vals = np.random.default_rng(1).normal(size=(5, n))
+    mesh = make_mesh(4)
+    for func, tol in [("nanmean", 1e-12), ("nanvar", 1e-10), ("nansum", 1e-12)]:
+        r_eager, _ = groupby_reduce(vals, labels, func=func)
+        r_coh, _ = groupby_reduce(vals, labels, func=func, method="cohorts", mesh=mesh)
+        np.testing.assert_allclose(np.asarray(r_coh), np.asarray(r_eager), rtol=tol)
+
+
+def test_ownership_permutation_edge_cases():
+    from flox_tpu.cohorts import ownership_permutation
+
+    assert ownership_permutation({}, 12, 4) is None
+    # already-contiguous cohorts: identity -> None (no gather inserted)
+    mapping = {(0,): [0, 1, 2], (1,): [3, 4, 5], (2,): [6, 7, 8], (3,): [9, 10, 11]}
+    assert ownership_permutation(mapping, 12, 4) is None
+    # non-divisible size pads with the sentinel column
+    mapping = {(0,): [0, 4], (1,): [1, 3], (2,): [2]}
+    perm = ownership_permutation(mapping, 5, 3)
+    assert perm is not None and perm.shape == (6,)
+    assert sorted(p for p in perm if p < 5) == [0, 1, 2, 3, 4]
+    assert (perm >= 5).sum() == 1
+
+
+def test_2d_mesh_single_axis_automethod():
+    """Auto-method heuristic sizes by the *named* sharded axes, not the whole
+    mesh (VERDICT Weak #4's second half)."""
+    from flox_tpu import groupby_reduce
+    from flox_tpu.parallel import make_mesh
+
+    n = 96
+    vals = np.random.default_rng(2).normal(size=(5, n))
+    labels = np.arange(n) // 24
+    mesh = make_mesh(shape=(2, 4), axis_names=("dcn", "ici"))
+    r_eager, _ = groupby_reduce(vals, labels, func="nansum")
+    r, _ = groupby_reduce(vals, labels, func="nansum", mesh=mesh, axis_name="ici")
+    np.testing.assert_allclose(np.asarray(r), np.asarray(r_eager), rtol=1e-12)
+    r2, _ = groupby_reduce(vals, labels, func="nanmean", mesh=mesh, axis_name=("dcn", "ici"))
+    re2, _ = groupby_reduce(vals, labels, func="nanmean")
+    np.testing.assert_allclose(np.asarray(r2), np.asarray(re2), rtol=1e-12)
