@@ -23,15 +23,17 @@ import pandas as pd
 
 from . import dtypes
 
-__all__ = ["reindex_", "ReindexStrategy", "ReindexArrayType"]
+__all__ = ["reindex_", "reindex_sparse_coo", "HostCOO", "ReindexStrategy", "ReindexArrayType"]
 
 
 class ReindexArrayType(Enum):
     """Which array type holds the reindexed result (reindex.py:23-50).
 
-    The reference offers sparse.COO for enormous group spaces; that backend
-    is unavailable here, so AUTO always resolves to NUMPY (device results
-    are dense by construction).
+    SPARSE_COO targets enormous group spaces (the reference's NWM-county
+    case, reindex.py:106-157): instead of materializing a dense
+    ``(…, len(to))`` array, only the found groups' columns are stored —
+    as a jax ``BCOO`` (device-ready, zero fill) or a host COO (non-zero
+    fill values).
     """
 
     AUTO = auto()
@@ -48,12 +50,94 @@ class ReindexStrategy:
     blockwise: bool | None = None
     array_type: ReindexArrayType = ReindexArrayType.AUTO
 
-    def __post_init__(self):
-        if self.array_type == ReindexArrayType.SPARSE_COO:
-            raise NotImplementedError(
-                "sparse.COO reindexing requires the 'sparse' package, which is "
-                "not available in this build."
-            )
+
+@dataclass
+class HostCOO:
+    """Minimal host-side COO result for non-zero fill values, the shape the
+    reference gets from pydata sparse (reindex.py:106-157): last axis
+    sparse, everything before it dense.
+
+    ``columns`` are the populated positions along the last axis; ``data``
+    is ``(…, len(columns))``.
+    """
+
+    columns: np.ndarray
+    data: np.ndarray
+    shape: tuple[int, ...]
+    fill_value: Any
+
+    @property
+    def nnz(self) -> int:
+        return int(self.data.size)
+
+    def todense(self) -> np.ndarray:
+        out = np.full(self.shape, self.fill_value, dtype=self.data.dtype)
+        out[..., self.columns] = self.data
+        return out
+
+
+def _is_nan_scalar(v) -> bool:
+    try:
+        return np.ndim(v) == 0 and bool(np.isnan(v))
+    except (TypeError, ValueError):
+        return False
+
+
+def reindex_sparse_coo(array, from_: pd.Index, to: pd.Index, *, fill_value=None, dtype=None):
+    """Reindex the trailing group axis into a sparse container.
+
+    For huge ``to`` spaces (e.g. every county id) the dense result would be
+    mostly fill; store only the found groups. Returns a jax ``BCOO`` when
+    the fill is zero — directly consumable by further jax computation — and
+    a :class:`HostCOO` otherwise (BCOO's implicit value is always 0).
+    Parity: reindex_pydata_sparse_coo (reference reindex.py:106-157).
+    """
+    if not isinstance(from_, pd.Index):
+        from_ = pd.Index(from_)
+    if not isinstance(to, pd.Index):
+        to = pd.Index(to)
+    array = np.asarray(array)
+    if dtype is not None:
+        array = array.astype(dtype, copy=False)
+
+    idx = to.get_indexer(from_)  # target position of each source column
+    mask = idx >= 0
+    needs_fill = len(to) > int(mask.sum())
+    if (fill_value is dtypes.NA or _is_nan_scalar(fill_value)) and array.dtype.kind not in "fc":
+        # a NaN-ish fill on int data promotes, exactly like the dense path
+        promoted, _ = dtypes.maybe_promote(array.dtype)
+        array = array.astype(promoted, copy=False)
+    if fill_value in (dtypes.INF, dtypes.NINF, dtypes.NA):
+        fill_value = dtypes.get_fill_value(array.dtype, fill_value)
+    if fill_value is None:
+        if needs_fill:
+            raise ValueError("Filling is required. fill_value cannot be None.")
+        fill_value = 0
+    shape = array.shape[:-1] + (len(to),)
+    cols = idx[mask]
+    data = array[..., mask]
+
+    is_zero = False
+    try:
+        is_zero = not np.any(np.asarray(fill_value))
+    except (TypeError, ValueError):
+        pass
+    if not is_zero:
+        return HostCOO(columns=cols, data=data, shape=shape, fill_value=fill_value)
+
+    from jax.experimental import sparse as jsparse
+    import jax.numpy as jnp
+
+    # BCOO layout: leading dims batch, trailing group axis sparse
+    nbatch = array.ndim - 1
+    indices = jnp.broadcast_to(
+        jnp.asarray(cols, dtype=jnp.int32).reshape((1,) * nbatch + (-1, 1)),
+        array.shape[:-1] + (cols.shape[0], 1),
+    )
+    return jsparse.BCOO(
+        (jnp.asarray(data), indices), shape=shape,
+        indices_sorted=bool(np.all(np.diff(cols) > 0)), unique_indices=True,
+    )
 
 
 def reindex_(
@@ -64,12 +148,18 @@ def reindex_(
     fill_value: Any = None,
     axis: int = -1,
     promote: bool = False,
+    array_type: ReindexArrayType = ReindexArrayType.AUTO,
 ) -> np.ndarray:
     """Gather ``array``'s group axis from ``from_`` order into ``to`` order.
 
     Missing target groups are filled with ``fill_value`` (sentinels resolved
-    against the array dtype). Parity: reindex_numpy (reindex.py:92-103).
+    against the array dtype). Parity: reindex_numpy (reindex.py:92-103);
+    ``array_type=SPARSE_COO`` routes to :func:`reindex_sparse_coo`.
     """
+    if array_type == ReindexArrayType.SPARSE_COO:
+        if axis != -1:
+            raise NotImplementedError("sparse reindex supports axis=-1 only")
+        return reindex_sparse_coo(array, from_, to, fill_value=fill_value)
     if not isinstance(from_, pd.Index):
         from_ = pd.Index(from_)
     if not isinstance(to, pd.Index):

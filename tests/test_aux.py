@@ -36,9 +36,11 @@ def test_reindex_sentinel_fill():
     assert out[2] == np.iinfo(np.int64).min or np.isneginf(out[2])
 
 
-def test_reindex_strategy_sparse_unavailable():
-    with pytest.raises(NotImplementedError):
-        ReindexStrategy(blockwise=True, array_type=ReindexArrayType.SPARSE_COO)
+def test_reindex_strategy_sparse_supported():
+    # SPARSE_COO became a real strategy (reindex_sparse_coo); the old
+    # NotImplementedError gate is gone
+    s = ReindexStrategy(blockwise=True, array_type=ReindexArrayType.SPARSE_COO)
+    assert s.array_type is ReindexArrayType.SPARSE_COO
 
 
 def test_reshard_layout_roundtrip():
@@ -84,15 +86,19 @@ def test_xarray_helpers_no_xarray():
     assert _resolve_dim("time", ("time",), ("x", "time")) == ("time",)
 
 
-def test_xarray_reduce_gated():
-    from flox_tpu import utils
+def test_xarray_adapter_backend_binding():
+    # without xarray installed the adapter binds to the bundled xrlite
+    # subset (same code path as real xarray); with xarray it binds to it
+    from flox_tpu import utils, xrlite
+    from flox_tpu.xarray import _get_xr
 
+    xr = _get_xr()
     if utils.HAS_XARRAY:
-        pytest.skip("xarray installed; gating not applicable")
-    from flox_tpu.xarray import xarray_reduce
+        import xarray
 
-    with pytest.raises(ImportError, match="xarray"):
-        xarray_reduce(object(), "time", func="mean")
+        assert xr is xarray
+    else:
+        assert xr is xrlite
 
 
 def test_visualize_gated():

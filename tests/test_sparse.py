@@ -2,6 +2,7 @@
 aggregate_sparse semantics, tests via dense equivalence)."""
 
 import numpy as np
+import pandas as pd
 import pytest
 
 import jax.numpy as jnp
@@ -88,3 +89,71 @@ def test_sparse_int_sum_fill():
     got, _ = groupby_reduce(mat, np.array([0, 0, 2, 2]), func="sum",
                             expected_groups=np.arange(3), fill_value=-999)
     np.testing.assert_array_equal(np.asarray(got), [3, -999, 5])
+
+
+class TestSparseReindex:
+    """Sparse-COO reindex for huge group spaces (reference reindex.py:106-157;
+    VERDICT missing #6). Zero fills produce a device-ready jax BCOO; non-zero
+    fills a host COO."""
+
+    def test_bcoo_zero_fill(self):
+        from flox_tpu.reindex import ReindexArrayType, reindex_
+
+        found = pd.Index([3, 10, 250000])
+        target = pd.RangeIndex(1_000_000)
+        vals = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+        out = reindex_(vals, found, target, fill_value=0.0,
+                       array_type=ReindexArrayType.SPARSE_COO)
+        from jax.experimental import sparse as jsparse
+
+        assert isinstance(out, jsparse.BCOO)
+        assert out.shape == (2, 1_000_000)
+        dense_cols = np.asarray(out.todense()[:, [3, 10, 250000]])
+        np.testing.assert_allclose(dense_cols, vals)
+        assert float(np.asarray(out.todense()[:, :3]).sum()) == 0.0
+
+    def test_host_coo_nan_fill(self):
+        from flox_tpu.reindex import HostCOO, reindex_sparse_coo
+
+        found = pd.Index([0, 5])
+        target = pd.RangeIndex(100)
+        vals = np.array([1.0, 2.0])
+        out = reindex_sparse_coo(vals, found, target, fill_value=np.nan)
+        assert isinstance(out, HostCOO)
+        dense = out.todense()
+        assert dense.shape == (100,)
+        assert dense[0] == 1.0 and dense[5] == 2.0
+        assert np.isnan(dense[1]) and out.nnz == 2
+
+    def test_missing_fill_required(self):
+        from flox_tpu.reindex import reindex_sparse_coo
+
+        with pytest.raises(ValueError, match="fill_value"):
+            reindex_sparse_coo(np.ones(2), pd.Index([0, 1]), pd.RangeIndex(5),
+                               fill_value=None)
+
+    def test_reorder_only_no_fill_needed(self):
+        from flox_tpu.reindex import reindex_sparse_coo
+
+        out = reindex_sparse_coo(np.array([1.0, 2.0, 3.0]), pd.Index([2, 0, 1]),
+                                 pd.Index([0, 1, 2]), fill_value=None)
+        np.testing.assert_allclose(np.asarray(out.todense()), [2.0, 3.0, 1.0])
+
+    def test_strategy_accepts_sparse(self):
+        from flox_tpu.reindex import ReindexArrayType, ReindexStrategy
+
+        s = ReindexStrategy(blockwise=False, array_type=ReindexArrayType.SPARSE_COO)
+        assert s.array_type is ReindexArrayType.SPARSE_COO
+
+
+def test_sparse_reindex_int_na_promotes():
+    # review regression: NA fill on int data must promote to float, not
+    # cast NaN into INT64_MIN garbage
+    from flox_tpu import dtypes
+    from flox_tpu.reindex import reindex_sparse_coo
+
+    out = reindex_sparse_coo(np.array([1, 2]), pd.Index([0, 5]), pd.RangeIndex(8),
+                             fill_value=dtypes.NA)
+    dense = out.todense()
+    assert dense.dtype.kind == "f"
+    assert dense[0] == 1.0 and dense[5] == 2.0 and np.isnan(dense[1])
