@@ -14,6 +14,7 @@ from .reindex import ReindexArrayType, ReindexStrategy
 from .core import groupby_reduce
 from .device import codes_device, groupby_reduce_device
 from .scan import groupby_scan
+from .streaming import streaming_groupby_reduce
 from .dtypes import INF, NA, NINF
 from .factorize import factorize_, factorize_single
 from .multiarray import MultiArray
@@ -41,6 +42,7 @@ __all__ = [
     "ReindexArrayType",
     "ReindexStrategy",
     "set_options",
+    "streaming_groupby_reduce",
     "xarray_reduce",
     "xrlite",
 ]

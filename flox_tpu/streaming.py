@@ -1,0 +1,282 @@
+"""Out-of-core grouped reductions: stream host slabs through device
+accumulators (L5).
+
+The reference handles bigger-than-memory arrays by delegating to a chunked
+runtime (dask: /root/reference/flox/dask.py:325-573; cubed:
+cubed.py:30-162) whose workers each hold one chunk. On a TPU host the
+equivalent capability is *streaming*: the array lives in host RAM (or
+behind a loader callable — zarr, memmap, a file reader), slabs of the
+reduced axis are `device_put` one at a time, and dense per-group
+intermediates accumulate **on device** via the same pairwise merges the
+mesh runtime applies collectively. HBM holds one slab + the (…, size)
+accumulators — never the array.
+
+Design notes (TPU-first):
+
+* The per-slab step is ONE jitted function (chunk kernels + merge fused);
+  slabs all share a static shape (the tail slab is padded with ``-1``
+  codes), so it compiles once.
+* jax dispatch is async: the host can prepare/copy slab ``i+1`` while the
+  device reduces slab ``i`` — double buffering without explicit machinery.
+* The pairwise variance merge is the reference's ``_var_combine``
+  (aggregations.py:392-451) — the Chan update, applied slab-by-slab.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable
+
+import numpy as np
+
+from . import factorize as fct, utils
+from .aggregations import Aggregation, _initialize_aggregation
+from .multiarray import MultiArray
+
+__all__ = ["streaming_groupby_reduce"]
+
+_BIG = np.iinfo(np.int32).max
+
+
+def streaming_groupby_reduce(
+    array,
+    by,
+    *,
+    func: str | Aggregation,
+    batch_len: int | None = None,
+    batch_bytes: int = 256 * 2**20,
+    expected_groups=None,
+    isbin=False,
+    sort: bool = True,
+    fill_value=None,
+    dtype=None,
+    min_count: int | None = None,
+    finalize_kwargs: dict | None = None,
+):
+    """Grouped reduction over the trailing axis, streaming slabs to device.
+
+    ``array``: a host array ``(..., N)`` **or** a loader
+    ``callable(start, stop) -> np.ndarray`` returning ``(..., stop-start)``
+    slabs (zarr/memmap-style); with a loader, pass the full-axis labels in
+    ``by`` — its length defines ``N``. Returns ``(result, groups)`` exactly
+    like :func:`flox_tpu.groupby_reduce`.
+
+    Supported: every aggregation with a chunk stage (blockwise-only order
+    statistics — median/quantile/mode — need all of a group at once and
+    cannot stream; use the mesh blockwise method for those).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    labels = utils.asarray_host(by)
+    if labels.ndim != 1:
+        raise NotImplementedError("streaming supports 1-D labels over the last axis")
+    n = labels.shape[0]
+
+    loader: Callable[[int, int], Any]
+    if callable(array):
+        loader = array
+        lead_shape = None  # discovered from the first slab
+    else:
+        arr = np.asarray(array) if not utils.is_jax_array(array) else array
+        if arr.shape[-1] != n:
+            raise ValueError(f"array trailing axis {arr.shape[-1]} != len(by) {n}")
+        loader = lambda s, e: arr[..., s:e]
+        lead_shape = arr.shape[:-1]
+
+    # -- host factorize over the full label axis (cheap: labels only) ------
+    from .core import _convert_expected_groups_to_index, _normalize_expected, _normalize_isbin
+
+    expected = _normalize_expected(expected_groups, 1)
+    expected_idx = _convert_expected_groups_to_index(expected, _normalize_isbin(isbin, 1), sort)
+    codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
+        [labels], axes=(0,), expected_groups=expected_idx, sort=sort
+    )
+    codes = np.asarray(codes).reshape(-1)
+    if size == 0:
+        raise ValueError("No groups to reduce over (empty expected_groups?)")
+
+    probe = np.asarray(loader(0, 1))  # one probe: dtype AND lead shape
+    agg = _initialize_aggregation(
+        func, dtype, probe.dtype, fill_value,
+        0 if min_count is None else min_count, finalize_kwargs,
+    )
+    if agg.blockwise_only:
+        raise NotImplementedError(
+            f"{agg.name!r} needs whole groups at once and cannot stream; "
+            "use groupby_reduce(method='blockwise', mesh=...) after "
+            "rechunk.reshard_for_blockwise."
+        )
+    if (
+        n >= _BIG
+        and not utils.x64_enabled()
+        and (agg.reduction_type == "argreduce" or agg.combine in (("first",), ("last",)))
+    ):
+        raise ValueError(
+            f"position-tracking reductions over {n} elements need int64 "
+            "positions; enable jax_enable_x64 (int32 would wrap and collide "
+            "with the sentinel)."
+        )
+
+    if lead_shape is None:
+        lead_shape = probe.shape[:-1]
+    itemsize = probe.dtype.itemsize
+    row_bytes = int(np.prod(lead_shape, dtype=np.int64)) * itemsize if lead_shape else itemsize
+    if batch_len is None:
+        batch_len = max(1, min(n, batch_bytes // max(row_bytes, 1)))
+    nbatches = math.ceil(n / batch_len)
+
+    skipna = agg.name.startswith("nan") or agg.name == "count"
+    count_skipna = skipna or agg.min_count > 0
+
+    step = _build_step(agg, size=size, batch_len=batch_len, count_skipna=count_skipna)
+
+    state = None
+    for i in range(nbatches):
+        s, e = i * batch_len, min((i + 1) * batch_len, n)
+        slab = np.asarray(loader(s, e))
+        ccodes = codes[s:e]
+        pad = batch_len - (e - s)
+        if pad:
+            slab = np.concatenate(
+                [slab, np.zeros(lead_shape + (pad,), slab.dtype)], axis=-1
+            )
+            ccodes = np.concatenate([ccodes, np.full(pad, -1, dtype=ccodes.dtype)])
+        # async dispatch: this queues on device while the host loads slab i+1
+        state = step(state, jnp.asarray(slab), jnp.asarray(ccodes), jnp.asarray(np.int64(s)))
+
+    inters, counts = state
+    if agg.reduction_type == "argreduce":
+        result = inters[1]
+    elif agg.finalize is not None:
+        result = agg.finalize(*inters, **agg.finalize_kwargs)
+    else:
+        result = inters[0]
+
+    from .parallel.mapreduce import _apply_final_fill
+
+    result = _apply_final_fill(result, counts, agg)
+    from .core import _astype_final, _index_values
+
+    result = _astype_final(result, agg, None)
+    return (result,) + tuple(_index_values(g) for g in found_groups)
+
+
+def _build_step(agg: Aggregation, *, size: int, batch_len: int, count_skipna: bool):
+    """One jitted step: slab -> chunk intermediates -> merge into state."""
+    import jax
+    import jax.numpy as jnp
+
+    from .kernels import generic_kernel
+    from .parallel.mapreduce import _local_chunk, _local_counts
+
+    arg_of_max = agg.reduction_type == "argreduce" and "max" in str(agg.chunk[1])
+    is_last = agg.combine == ("last",)
+    is_first = agg.combine == ("first",)
+
+    def slab_stats(slab, ccodes, offset):
+        counts = _local_counts(ccodes, slab, size, count_skipna, False)
+        if agg.reduction_type == "argreduce":
+            val_f, arg_f = agg.chunk
+            val = generic_kernel(
+                val_f, ccodes, slab, size=size,
+                fill_value=agg.fill_value["intermediate"][0],
+            )
+            local_arg = generic_kernel(arg_f, ccodes, slab, size=size, fill_value=-1)
+            gidx = jnp.where(local_arg >= 0, local_arg + offset, -1)
+            return [val, gidx], counts
+        if is_first or is_last:
+            from .parallel.mapreduce import _local_firstlast
+
+            val, pos = _local_firstlast(
+                ccodes, slab, size, skipna=agg.name.startswith("nan"),
+                last=is_last, nat=False, offset=offset,
+            )
+            return [val, pos], counts
+        return _local_chunk(agg, ccodes, slab, size, False), counts
+
+    def merge(state, inters, counts):
+        acc_inters, acc_counts = state
+        out = []
+        if agg.reduction_type == "argreduce":
+            va, ia = acc_inters
+            vb, ib = inters
+            better = _argmerge_better(va, vb, arg_of_max)
+            tie = vb == va
+            if jnp.issubdtype(va.dtype, jnp.floating):
+                tie = tie | (jnp.isnan(va) & jnp.isnan(vb))
+            ia_safe = jnp.where(ia >= 0, ia, _BIG)
+            ib_safe = jnp.where(ib >= 0, ib, _BIG)
+            idx = jnp.where(better, ib_safe, jnp.where(tie, jnp.minimum(ia_safe, ib_safe), ia_safe))
+            out = [jnp.where(better, vb, va), jnp.where(idx < _BIG, idx, -1)]
+        elif is_first or is_last:
+            va, pa = acc_inters
+            vb, pb = inters
+            if is_last:
+                take_b = (pb >= 0) & ((pa < 0) | (pb > pa))
+            else:
+                take_b = (pb < _BIG) & ((pa >= _BIG) | (pb < pa))
+            out = [jnp.where(take_b, vb, va), jnp.where(take_b, pb, pa)]
+        else:
+            for a, b, op in zip(acc_inters, inters, agg.combine):
+                out.append(_pair_merge(op, a, b))
+        return out, acc_counts + counts
+
+    def step(state, slab, ccodes, offset):
+        inters, counts = slab_stats(slab, ccodes, offset)
+        if state is None:
+            return (inters, counts)
+        return merge(state, inters, counts)
+
+    jitted = jax.jit(step)
+
+    def run(state, slab, ccodes, offset):
+        # first call establishes the state pytree; jit caches both arities
+        return jitted(state, slab, ccodes, offset)
+
+    return run
+
+
+def _argmerge_better(va, vb, arg_of_max: bool):
+    import jax.numpy as jnp
+
+    better = (vb > va) if arg_of_max else (vb < va)
+    if jnp.issubdtype(va.dtype, jnp.floating):
+        # NaN-propagating semantics: a NaN extreme wins over a number
+        better = better | (jnp.isnan(vb) & ~jnp.isnan(va))
+    return better
+
+
+def _pair_merge(op, a, b):
+    """Sequential form of the mesh collectives (parallel/mapreduce.py):
+    psum -> add, pmax -> maximum, the var triple -> the Chan update
+    (reference _var_combine, aggregations.py:392-451)."""
+    import jax.numpy as jnp
+
+    if op == "var":
+        m2a, ta, na = a.arrays
+        m2b, tb, nb = b.arrays
+        nab = na + nb
+        tab = ta + tb
+        mua = ta / jnp.where(na > 0, na, 1)
+        mub = tb / jnp.where(nb > 0, nb, 1)
+        muab = tab / jnp.where(nab > 0, nab, 1)
+        m2 = m2a + m2b + na * (mua - muab) ** 2 + nb * (mub - muab) ** 2
+        return MultiArray((m2, tab, nab))
+    if op == "sum":
+        return a + b
+    if op == "prod":
+        return a * b
+    if op == "max":
+        return jnp.maximum(a, b)
+    if op == "min":
+        return jnp.minimum(a, b)
+    if callable(op):
+        # the mesh contract: op(stacked) over the shard axis — here the
+        # "shards" are the two accumulation halves; leaf-wise for pytrees
+        if isinstance(a, MultiArray):
+            return op(
+                MultiArray(tuple(jnp.stack([x, y]) for x, y in zip(a.arrays, b.arrays)))
+            )
+        return op(jnp.stack([a, b]))
+    raise NotImplementedError(f"streaming merge for combine op {op!r}")
