@@ -207,7 +207,19 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", choices=["small", "full"], default="small")
     ap.add_argument("--engine", choices=["jax", "numpy", "both"], default="jax")
+    ap.add_argument(
+        "--platform", choices=["default", "cpu"], default="default",
+        help="cpu forces the CPU backend before any device init (the "
+        "environment's sitecustomize otherwise selects the accelerator, "
+        "which hangs when the TPU tunnel is down)",
+    )
     args = ap.parse_args()
+
+    if args.platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    print(json.dumps({"bench": "platform", "value": args.platform, "unit": "config"}))
 
     engines = ["jax", "numpy"] if args.engine == "both" else [args.engine]
     results = []
