@@ -117,7 +117,7 @@ def _use_matmul_path(op: str, data, size: int) -> bool:
     return True
 
 
-def _seg_matmul_sum(data, codes, size: int):
+def _seg_matmul_sum(data, codes, size: int, *, skipna: bool = False, return_nan_counts: bool = False):
     """(N, ...) × one-hot(N, size) -> (size, ...) on the MXU.
 
     codes may contain the missing sentinel (== size); the one-hot row is all
@@ -165,8 +165,13 @@ def _seg_matmul_sum(data, codes, size: int):
     neg_c = out[:, 3 * k :]
     from .utils import reapply_nonfinite
 
-    out_v = reapply_nonfinite(sums, nan_c, pos_c, neg_c)
-    return out_v.reshape((size,) + data.shape[1:])
+    out_v = reapply_nonfinite(sums, nan_c, pos_c, neg_c, skipna=skipna)
+    out_v = out_v.reshape((size,) + data.shape[1:])
+    if return_nan_counts:
+        # lets nanmean fuse its count: non-NaN count = rowcount - nan_c,
+        # with rowcount a codes-only (no data traffic) segment sum
+        return out_v, nan_c.reshape((size,) + data.shape[1:])
+    return out_v
 
 
 _PALLAS_PROBE_RESULT: list = []  # memoized one-time runtime validation
@@ -450,19 +455,45 @@ def len_(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
 def _mean_impl(group_idx, array, *, size, fill_value, dtype, skipna):
     codes = _safe_codes(group_idx, size)
     data = _to_leading(array)
-    mask = _nan_mask(data) if skipna else None
     if dtype is None and not jnp.issubdtype(data.dtype, jnp.floating):
         dtype = jnp.result_type(data.dtype, jnp.float32)
-    sdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
-    sdata = _maybe_cast(sdata, dtype)
-    total = _seg("sum", sdata, codes, size)  # f32-accumulated for bf16/f16
-    # counts in int32: exact, and immune to the data dtype (bf16 counts
-    # saturate at 256 — the mean of 2000 values must not divide by 256)
-    cnt = _bcast_present(_counts(codes, size, mask=mask), total)
-    out = total / cnt.astype(total.dtype)
+
+    fused = None
+    if skipna and jnp.issubdtype(data.dtype, jnp.floating) and data.shape[0] < 2**24:
+        # fused single-pass nanmean on the marker-producing paths: the
+        # kernel zeroes non-finite values itself (no pre-mask pass) and
+        # non-NaN counts come from rowcount(codes) - nan_c — rowcount
+        # touches only the codes, not the data, so HBM sees the data ONCE.
+        # (2^24 guard: marker counts accumulate in f32.)
+        cast = _maybe_cast(data, dtype)
+        impl = _segment_sum_impl(cast, size)
+        if impl == "matmul":
+            fused = _seg_matmul_sum(cast, codes, size, skipna=True, return_nan_counts=True)
+        elif impl == "pallas":
+            from .pallas_kernels import segment_sum_pallas
+
+            fused = segment_sum_pallas(
+                cast, codes, size, skipna=True, return_nan_counts=True,
+                interpret=jax.default_backend() not in ("tpu", "axon"),
+            )
+    if fused is not None:
+        total, nan_c = fused
+        rowcount = _bcast_present(_counts(codes, size), total)  # codes-only
+        cnt = rowcount.astype(total.dtype) - nan_c.astype(total.dtype)
+        orig_dtype = cast.dtype
+    else:
+        mask = _nan_mask(data) if skipna else None
+        sdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
+        sdata = _maybe_cast(sdata, dtype)
+        total = _seg("sum", sdata, codes, size)  # f32-accumulated for bf16/f16
+        # counts in int32: exact, and immune to the data dtype (bf16 counts
+        # saturate at 256 — the mean of 2000 values must not divide by 256)
+        cnt = _bcast_present(_counts(codes, size, mask=mask), total).astype(total.dtype)
+        orig_dtype = sdata.dtype
+    out = total / cnt
     out = _fill_empty(out, cnt > 0, fill_value if fill_value is not None else jnp.nan)
-    if out.dtype != sdata.dtype and jnp.issubdtype(sdata.dtype, jnp.floating):
-        out = out.astype(sdata.dtype)  # divide in f32, present as bf16
+    if out.dtype != orig_dtype and jnp.issubdtype(orig_dtype, jnp.floating):
+        out = out.astype(orig_dtype)  # divide in f32, present as bf16
     return _from_leading(out)
 
 

@@ -126,7 +126,10 @@ def _build(
     return jax.jit(fn)
 
 
-def segment_sum_pallas(data, codes, size: int, *, interpret: bool = False, compensated: bool | None = None):
+def segment_sum_pallas(
+    data, codes, size: int, *, interpret: bool = False, compensated: bool | None = None,
+    skipna: bool = False, return_nan_counts: bool = False,
+):
     """Segment-sum ``data`` (N, K...) by ``codes`` (N,) -> (size, K...).
 
     Exact IEEE semantics (NaN/±inf propagate per group+column); missing
@@ -170,5 +173,8 @@ def segment_sum_pallas(data, codes, size: int, *, interpret: bool = False, compe
 
     from .utils import reapply_nonfinite
 
-    out = reapply_nonfinite(sums, nan_c, pos_c, neg_c)
-    return out[:size, :k].reshape((size,) + orig_shape[1:])
+    out = reapply_nonfinite(sums, nan_c, pos_c, neg_c, skipna=skipna)
+    out = out[:size, :k].reshape((size,) + orig_shape[1:])
+    if return_nan_counts:
+        return out, nan_c[:size, :k].reshape((size,) + orig_shape[1:])
+    return out

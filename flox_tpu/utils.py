@@ -125,13 +125,19 @@ def moveaxis_to_end(array, axes: tuple[int, ...]):
     return array.transpose(keep + list(axes)), tuple(keep)
 
 
-def reapply_nonfinite(sums, nan_c, pos_c, neg_c):
+def reapply_nonfinite(sums, nan_c, pos_c, neg_c, *, skipna: bool = False):
     """Re-apply IEEE non-finite propagation to segment sums computed on
     zero-filled data with NaN/+inf/-inf marker counts (shared by the MXU
-    GEMM and Pallas segment-sum paths so their semantics cannot drift)."""
+    GEMM and Pallas segment-sum paths so their semantics cannot drift).
+
+    ``skipna=True`` treats NaN as absent (the fused nan-aggregation path
+    sums over raw, unmasked data): zeroed NaNs simply do not contribute,
+    and only the ±inf rules apply."""
     import jax.numpy as jnp
 
-    poison = (nan_c > 0) | ((pos_c > 0) & (neg_c > 0))
+    poison = (pos_c > 0) & (neg_c > 0)
+    if not skipna:
+        poison = poison | (nan_c > 0)
     return jnp.where(
         poison,
         jnp.asarray(jnp.nan, sums.dtype),
