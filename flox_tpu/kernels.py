@@ -86,6 +86,13 @@ def _acc_dtype(dt):
     return dt
 
 
+def _on_tpu() -> bool:
+    """One definition of "a real TPU-like backend": the pallas kernel runs
+    natively there and in interpret mode anywhere else, and the auto policy
+    keys off it. New accelerator backend names belong HERE only."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def _use_matmul_path(op: str, data, size: int) -> bool:
     """Additive segment reductions over few groups run as a one-hot matmul.
 
@@ -220,7 +227,7 @@ def _segment_sum_impl(data, size: int) -> str:
         and size <= OPTIONS["pallas_num_groups_max"]
         and data.shape[0] >= 8
     )
-    on_tpu = jax.default_backend() in ("tpu", "axon")
+    on_tpu = _on_tpu()
     if policy == "pallas":
         return "pallas" if pallas_ok and (not on_tpu or _pallas_runtime_ok()) else "scatter"
     # auto on TPU: pallas if it validates at runtime, else the GEMM path if
@@ -251,7 +258,7 @@ def _seg(op: str, data, codes, size: int):
 
             # interpret mode keeps the kernel testable off-TPU
             return segment_sum_pallas(
-                data, codes, size, interpret=jax.default_backend() not in ("tpu", "axon")
+                data, codes, size, interpret=not _on_tpu()
             )
         if impl == "matmul":
             # non-finite handling is built into the GEMM (marker columns), so
@@ -452,39 +459,48 @@ def len_(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
     return _from_leading(out)
 
 
+def _fused_sum_counts(cast, codes, size: int):
+    """Single-pass skipna (total, non-NaN count) on the marker paths.
+
+    The GEMM/Pallas kernels zero non-finite values themselves and emit NaN
+    marker counts, so non-NaN counts are ``rowcount(codes) - nan_c`` —
+    rowcount touches only the codes, and HBM sees the data ONCE (no
+    pre-mask pass, no data-shaped count accumulation). Returns None when
+    the policy resolves to scatter or the f32 marker-count exactness guard
+    (2^24 contributions) fails.
+    """
+    if not jnp.issubdtype(cast.dtype, jnp.floating) or cast.shape[0] >= 2**24:
+        return None
+    impl = _segment_sum_impl(cast, size)
+    if impl == "matmul":
+        total, nan_c = _seg_matmul_sum(cast, codes, size, skipna=True, return_nan_counts=True)
+    elif impl == "pallas":
+        from .pallas_kernels import segment_sum_pallas
+
+        total, nan_c = segment_sum_pallas(
+            cast, codes, size, skipna=True, return_nan_counts=True,
+            interpret=not _on_tpu(),
+        )
+    else:
+        return None
+    rowcount = _bcast_present(_counts(codes, size), total)  # codes-only
+    return total, rowcount.astype(total.dtype) - nan_c.astype(total.dtype)
+
+
 def _mean_impl(group_idx, array, *, size, fill_value, dtype, skipna):
     codes = _safe_codes(group_idx, size)
     data = _to_leading(array)
     if dtype is None and not jnp.issubdtype(data.dtype, jnp.floating):
         dtype = jnp.result_type(data.dtype, jnp.float32)
 
-    fused = None
-    if skipna and jnp.issubdtype(data.dtype, jnp.floating) and data.shape[0] < 2**24:
-        # fused single-pass nanmean on the marker-producing paths: the
-        # kernel zeroes non-finite values itself (no pre-mask pass) and
-        # non-NaN counts come from rowcount(codes) - nan_c — rowcount
-        # touches only the codes, not the data, so HBM sees the data ONCE.
-        # (2^24 guard: marker counts accumulate in f32.)
-        cast = _maybe_cast(data, dtype)
-        impl = _segment_sum_impl(cast, size)
-        if impl == "matmul":
-            fused = _seg_matmul_sum(cast, codes, size, skipna=True, return_nan_counts=True)
-        elif impl == "pallas":
-            from .pallas_kernels import segment_sum_pallas
-
-            fused = segment_sum_pallas(
-                cast, codes, size, skipna=True, return_nan_counts=True,
-                interpret=jax.default_backend() not in ("tpu", "axon"),
-            )
+    cast = _maybe_cast(data, dtype)
+    fused = _fused_sum_counts(cast, codes, size) if skipna else None
     if fused is not None:
-        total, nan_c = fused
-        rowcount = _bcast_present(_counts(codes, size), total)  # codes-only
-        cnt = rowcount.astype(total.dtype) - nan_c.astype(total.dtype)
+        total, cnt = fused
         orig_dtype = cast.dtype
     else:
         mask = _nan_mask(data) if skipna else None
-        sdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
-        sdata = _maybe_cast(sdata, dtype)
+        sdata = cast if mask is None else jnp.where(mask, cast, jnp.zeros((), cast.dtype))
         total = _seg("sum", sdata, codes, size)  # f32-accumulated for bf16/f16
         # counts in int32: exact, and immune to the data dtype (bf16 counts
         # saturate at 256 — the mean of 2000 values must not divide by 256)
@@ -532,14 +548,21 @@ nansum_of_squares = partial(_sum_of_squares, skipna=True)
 def _var_impl(group_idx, array, *, size, fill_value, dtype, ddof, skipna, std):
     codes = _safe_codes(group_idx, size)
     data = _to_leading(array)
-    mask = _nan_mask(data) if skipna else None
     if dtype is None and not jnp.issubdtype(data.dtype, jnp.floating):
         dtype = jnp.result_type(data.dtype, jnp.float32)
-    zdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
-    zdata = _maybe_cast(zdata, dtype)
-    total = _seg("sum", zdata, codes, size)  # f32-accumulated for bf16/f16
-    cnt_b = _bcast_present(_counts(codes, size, mask=mask), total)  # int32, exact
-    cnt_f = cnt_b.astype(total.dtype)
+    cast = _maybe_cast(data, dtype)
+    # mask on the PRE-cast data: an int dtype request would destroy the
+    # NaNs before the mask sees them (review regression)
+    mask = _nan_mask(data) if skipna else None
+    zdata = cast if mask is None else jnp.where(mask, cast, jnp.zeros((), cast.dtype))
+    fused = _fused_sum_counts(cast, codes, size) if skipna else None
+    if fused is not None:
+        total, cnt_f = fused
+        cnt_b = cnt_f
+    else:
+        total = _seg("sum", zdata, codes, size)  # f32-accumulated for bf16/f16
+        cnt_b = _bcast_present(_counts(codes, size, mask=mask), total)  # int32, exact
+        cnt_f = cnt_b.astype(total.dtype)
     mean_g = total / jnp.where(cnt_f > 0, cnt_f, 1)
     # gather each element's group mean and accumulate squared deviations
     # (zdata - gathered promotes bf16 deviations to the f32 mean dtype, so
@@ -587,14 +610,19 @@ def var_chunk(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, s
     """
     codes = _safe_codes(group_idx, size)
     data = _to_leading(array)
-    mask = _nan_mask(data) if skipna else None
     if dtype is None and not jnp.issubdtype(data.dtype, jnp.floating):
         dtype = jnp.result_type(data.dtype, jnp.float32)
-    zdata = data if mask is None else jnp.where(mask, data, jnp.zeros((), data.dtype))
-    zdata = _maybe_cast(zdata, dtype)
-    total = _seg("sum", zdata, codes, size)  # f32-accumulated for bf16/f16
-    cnt_b = _bcast_present(_counts(codes, size, mask=mask), total)  # int32, exact
-    cnt_f = cnt_b.astype(total.dtype)
+    cast = _maybe_cast(data, dtype)
+    # mask on the PRE-cast data: an int dtype request would destroy the
+    # NaNs before the mask sees them (review regression)
+    mask = _nan_mask(data) if skipna else None
+    zdata = cast if mask is None else jnp.where(mask, cast, jnp.zeros((), cast.dtype))
+    fused = _fused_sum_counts(cast, codes, size) if skipna else None
+    if fused is not None:
+        total, cnt_f = fused
+    else:
+        total = _seg("sum", zdata, codes, size)  # f32-accumulated for bf16/f16
+        cnt_f = _bcast_present(_counts(codes, size, mask=mask), total).astype(total.dtype)
     mean_g = total / jnp.where(cnt_f > 0, cnt_f, 1)
     gathered = jnp.take(
         jnp.concatenate([mean_g, jnp.zeros((1,) + mean_g.shape[1:], mean_g.dtype)]), codes, axis=0

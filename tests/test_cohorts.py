@@ -105,3 +105,91 @@ def test_cohorts_memoized():
     r1 = find_group_cohorts(labels, chunks)
     r2 = find_group_cohorts(labels, chunks)
     assert r1 is r2  # cache hit returns the same object
+
+
+# --- the remaining reference snapshot scenarios (test_cohorts.py:10-29,
+# asv_bench/benchmarks/cohorts.py) as explicit expectations -----------------
+
+
+def test_oisst_daily_dayofyear():
+    # OISST: ~40 years of daily data in chunks of 10 days; each dayofyear
+    # label recurs yearly in a small chunk subset -> cohorts
+    ndays = 365 * 40
+    day = np.arange(ndays) % 365
+    chunks = chunks_from_shards(ndays, ndays // 10)
+    method, mapping = find_group_cohorts(day, chunks, expected_groups=range(365))
+    assert method == "cohorts"
+    labels = sorted(lab for labs in mapping.values() for lab in labs)
+    assert labels == list(range(365))
+
+
+def test_perfect_monthly():
+    # monthly data chunked by 4: quarters repeat exactly -> 3 clean cohorts
+    nyears = 20
+    month = np.arange(12 * nyears) % 12
+    chunks = chunks_from_shards(len(month), len(month) // 4)
+    method, mapping = find_group_cohorts(month, chunks, expected_groups=range(12))
+    assert method == "cohorts"
+    assert sorted(map(sorted, mapping.values())) == [
+        [0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 10, 11]
+    ]
+
+
+def test_perfect_blockwise_resampling():
+    # downsampling to a frequency aligned with chunk boundaries: every
+    # output group lives in exactly one chunk -> blockwise
+    n = 240
+    by = np.arange(n) // 24  # daily groups over hourly data
+    chunks = chunks_from_shards(n, n // 24)  # chunk == day
+    method, mapping = find_group_cohorts(by, chunks, expected_groups=range(10))
+    assert method == "blockwise"
+    assert len(mapping) == 10
+
+
+def test_era5_google_per_timestep_chunks():
+    # ERA5-Google: chunks of 1 along time; every chunk holds exactly one
+    # label occurrence but labels span many chunks -> cohorts (the
+    # chunksize-1 branch of the reference ladder, cohorts.py:192-199)
+    n = 365 * 2
+    day = np.arange(n) % 365
+    chunks = chunks_from_shards(n, n)  # one element per chunk
+    method, mapping = find_group_cohorts(day, chunks, expected_groups=range(365))
+    assert method == "cohorts"
+    # each label's cohort = its two yearly chunk positions
+    assert all(len(cset) == 2 for cset in mapping)
+
+
+def test_nwm_2d_labels():
+    # NWM county zonal stats: 2-D integer label map flattened; ~900 labels
+    # scattered over spatial chunks with high overlap -> map-reduce
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 900, size=(450, 360)).reshape(-1)
+    chunks = chunks_from_shards(labels.size, 25)
+    method, mapping = find_group_cohorts(labels, chunks, expected_groups=range(900))
+    assert method == "map-reduce"
+    assert mapping == {}
+
+
+def test_random_big_array():
+    # RandomBigArray: 5000 random labels, every chunk sees a wide spread ->
+    # containment is dense -> map-reduce
+    rng = np.random.default_rng(1)
+    labels = rng.integers(0, 5000, size=200_000)
+    chunks = chunks_from_shards(labels.size, 20)
+    method, mapping = find_group_cohorts(labels, chunks, expected_groups=range(5000))
+    assert method == "map-reduce"
+    assert mapping == {}
+
+
+def test_era5_monthhour():
+    # grouping by (month, hour) products: 288 labels recurring daily; with
+    # 48h chunks each label recurs in half the chunks of its month pair
+    nhours = 24 * 365
+    hour = np.arange(nhours) % 24
+    month = ((np.arange(nhours) // 24) % 365 // 30.44).astype(np.int64) % 12
+    mh = month * 24 + hour
+    chunks = chunks_from_shards(nhours, nhours // 48)
+    method, mapping = find_group_cohorts(mh, chunks, expected_groups=range(288))
+    assert method == "cohorts"
+    labels = sorted(lab for labs in mapping.values() for lab in labs)
+    assert labels == list(range(288))

@@ -601,3 +601,60 @@ class TestFusedNanmean:
         out = np.asarray(reapply_nonfinite(sums, nan_c, pos_c, neg_c, skipna=True))
         # NaN markers ignored; +inf -> inf; ±inf -> NaN
         assert out[0] == 1.0 and np.isposinf(out[1]) and np.isnan(out[2]) and out[3] == 4.0
+
+
+class TestFusedVariance:
+    """The variance family shares the fused marker-count sum (one data pass
+    for total+counts; the dev² pass follows)."""
+
+    @pytest.mark.parametrize("impl", ["scatter", "matmul", "pallas"])
+    @pytest.mark.parametrize("func", ["nanvar", "nanstd"])
+    def test_vs_oracle(self, impl, func):
+        import warnings
+
+        import flox_tpu
+
+        rng = np.random.default_rng(1)
+        data = rng.normal(size=(16, 4000)).astype(np.float32)
+        data[:, ::7] = np.nan
+        codes = rng.integers(0, 12, 4000)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            base = np.stack(
+                [np.nanvar(data[:, codes == g].astype(np.float64), axis=1) for g in range(12)], -1
+            )
+        expected = np.sqrt(base) if func == "nanstd" else base
+        with flox_tpu.set_options(segment_sum_impl=impl):
+            got = np.asarray(kernels.generic_kernel(func, codes, data, size=12))
+        np.testing.assert_allclose(got, expected, rtol=2e-4, atol=1e-6, equal_nan=True)
+
+    @pytest.mark.parametrize("impl", ["matmul", "pallas"])
+    def test_var_chunk_triple_matches_scatter(self, impl):
+        import flox_tpu
+
+        rng = np.random.default_rng(2)
+        data = rng.normal(size=(4, 512)).astype(np.float32)
+        data[:, ::5] = np.nan
+        codes = rng.integers(0, 6, 512)
+        with flox_tpu.set_options(segment_sum_impl="scatter"):
+            ref = kernels.generic_kernel("var_chunk", codes, data, size=6, skipna=True)
+        with flox_tpu.set_options(segment_sum_impl=impl):
+            got = kernels.generic_kernel("var_chunk", codes, data, size=6, skipna=True)
+        for a, b in zip(ref.arrays, got.arrays):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5, atol=1e-6)
+
+
+def test_var_int_dtype_request_keeps_nan_mask():
+    # review regression: the NaN mask must come from the PRE-cast data — an
+    # int dtype request would destroy NaNs before the mask sees them
+    out = np.asarray(
+        kernels.generic_kernel(
+            "nanvar", np.array([0, 0, 0]), np.array([1.0, np.nan, 3.0]), size=1, dtype=np.int32
+        )
+    )
+    assert abs(out[0] - 1.0) < 1e-12
+    ch = kernels.generic_kernel(
+        "var_chunk", np.array([0, 0, 0]), np.array([1.0, np.nan, 3.0]),
+        size=1, dtype=np.int32, skipna=True,
+    )
+    assert float(np.asarray(ch.arrays[2])[0]) == 2.0
