@@ -18,6 +18,16 @@ import numpy as np
 __all__ = ["KERNELS", "generic_kernel"]
 
 
+def _acc_dtype(dt):
+    """f32 accumulation for sub-f32 floats (mirrors kernels._acc_dtype):
+    f16/bf16 running sums and counts saturate at the narrow mantissa.
+    bfloat16 registers with numpy as kind 'V', so match it by name."""
+    dt = np.dtype(dt)
+    if (dt.kind == "f" and dt.itemsize < 4) or dt.name == "bfloat16":
+        return np.dtype(np.float32)
+    return dt
+
+
 def _prep(group_idx, array):
     """Transpose to (N, ...) and drop missing labels from the scatter."""
     codes = np.asarray(group_idx).reshape(-1).astype(np.int64)
@@ -75,8 +85,12 @@ def _make_addlike(ufunc, identity, skipna):
             data = np.where(mask, data, identity)
         if dtype is not None:
             data = data.astype(dtype, copy=False)
-        out = _scatter(ufunc, codes, data, valid, size, identity, dtype)
+        out_dtype = data.dtype
+        acc = _acc_dtype(out_dtype)
+        out = _scatter(ufunc, codes, data.astype(acc, copy=False), valid, size, identity, acc)
         out = _apply_fill(out, codes, valid, size, fill_value, identity)
+        if out.dtype == acc and acc != out_dtype:
+            out = out.astype(out_dtype)
         return np.moveaxis(out, 0, -1)
 
     return kernel
@@ -173,6 +187,8 @@ def _mean_impl(group_idx, array, *, size, fill_value, dtype, skipna):
     mask = _nan_mask(data) if skipna else None
     if dtype is None:
         dtype = np.result_type(data.dtype, np.float64) if data.dtype.kind in "iub" else data.dtype
+    out_dtype = np.dtype(dtype)
+    dtype = _acc_dtype(out_dtype)
     work = data if mask is None else np.where(mask, data, 0)
     total = _scatter(np.add, codes, work.astype(dtype, copy=False), valid, size, 0, dtype)
     if mask is None:
@@ -185,6 +201,8 @@ def _mean_impl(group_idx, array, *, size, fill_value, dtype, skipna):
         out = total / cnt
     empty = np.broadcast_to(cnt, out.shape) == 0
     out = np.where(empty, np.nan if fill_value is None else fill_value, out)
+    if out.dtype != out_dtype and out_dtype.kind == "f":
+        out = out.astype(out_dtype)
     return np.moveaxis(out, 0, -1)
 
 
@@ -201,6 +219,8 @@ def _var_impl(group_idx, array, *, size, fill_value, dtype, ddof, skipna, take_s
     mask = _nan_mask(data) if skipna else None
     if dtype is None:
         dtype = np.result_type(data.dtype, np.float64) if data.dtype.kind in "iub" else data.dtype
+    out_dtype = np.dtype(dtype)
+    dtype = _acc_dtype(out_dtype)
     work = (data if mask is None else np.where(mask, data, 0)).astype(dtype, copy=False)
     total = _scatter(np.add, codes, work, valid, size, 0, dtype)
     if mask is None:
@@ -224,6 +244,8 @@ def _var_impl(group_idx, array, *, size, fill_value, dtype, ddof, skipna, take_s
         out = np.sqrt(out)
     empty = np.broadcast_to(cnt, out.shape) == 0
     out = np.where(empty, np.nan if fill_value is None else fill_value, out)
+    if out.dtype != out_dtype and out_dtype.kind == "f":
+        out = out.astype(out_dtype)
     return np.moveaxis(out, 0, -1)
 
 
@@ -250,6 +272,7 @@ def var_chunk(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, s
     mask = _nan_mask(data) if skipna else None
     if dtype is None:
         dtype = np.result_type(data.dtype, np.float64) if data.dtype.kind in "iub" else data.dtype
+    dtype = _acc_dtype(dtype)  # intermediates stay f32 (cast at finalize)
     work = (data if mask is None else np.where(mask, data, 0)).astype(dtype, copy=False)
     total = _scatter(np.add, codes, work, valid, size, 0, dtype)
     cnt = np.zeros((size,) + data.shape[1:], dtype=dtype)
@@ -491,6 +514,9 @@ def _grouped_scan_host(group_idx, array, kind, dtype=None):
     data = np.moveaxis(np.asarray(array), -1, 0)
     if dtype is not None:
         data = data.astype(dtype, copy=False)
+    out_dtype = data.dtype
+    if kind in ("cumsum", "nancumsum"):
+        data = data.astype(_acc_dtype(out_dtype), copy=False)  # f16 running sums saturate
     perm = np.argsort(codes, kind="stable")
     inv = np.empty_like(perm)
     inv[perm] = np.arange(len(perm))
@@ -514,6 +540,8 @@ def _grouped_scan_host(group_idx, array, kind, dtype=None):
             else:
                 filled = s
             out[b:e] = filled if kind == "ffill" else filled[::-1]
+    if out.dtype != out_dtype:
+        out = out.astype(out_dtype)
     return np.moveaxis(np.take(out, inv, axis=0), 0, -1)
 
 

@@ -20,7 +20,7 @@ import pandas as pd
 from .aggregations import AGGREGATIONS
 from .utils import HAS_XARRAY
 
-__all__ = ["xarray_reduce", "rechunk_for_blockwise"]
+__all__ = ["xarray_reduce", "rechunk_for_blockwise", "rechunk_for_cohorts"]
 
 
 def _get_xr():
@@ -331,3 +331,29 @@ def rechunk_for_blockwise(obj, dim: str, labels, n_shards: int | None = None):
         coords={d: obj.coords[d] for d in obj.coords if d != dim and d in new_dims},
     )
     return out, codes, groups
+
+
+def rechunk_for_cohorts(
+    obj, dim: str, labels, force_new_chunk_at, chunksize: int | None = None
+):
+    """xarray-level wrapper over rechunk.rechunk_for_cohorts
+    (parity: reference xarray.py:519-566).
+
+    Returns the chunk-length tuple for ``dim`` with boundaries anchored at
+    ``force_new_chunk_at`` label starts — feed it to
+    ``cohorts.find_group_cohorts`` or use the lengths as shard sizes.
+    """
+    from . import rechunk as _rechunk
+
+    if dim not in getattr(obj, "dims", ()):
+        raise ValueError(f"Object has no dim {dim!r}; dims: {tuple(obj.dims)}")
+    labels_np = np.asarray(getattr(labels, "data", labels)).reshape(-1)
+    dim_len = obj.sizes[dim]
+    if labels_np.shape[0] != dim_len:
+        raise ValueError(
+            f"labels have length {labels_np.shape[0]} but dim {dim!r} has "
+            f"size {dim_len}; pass labels aligned with that dimension."
+        )
+    return _rechunk.rechunk_for_cohorts(
+        None, 0, labels_np, force_new_chunk_at, chunksize=chunksize,
+    )

@@ -78,3 +78,41 @@ def test_complex_dtype_parity():
         a = np.asarray(kernels.generic_kernel(func, codes, vals, size=2))
         b = np.asarray(engine_numpy.generic_kernel(func, codes, vals, size=2))
         np.testing.assert_allclose(a, b, equal_nan=True, err_msg=func)
+
+
+class TestF16Accumulation:
+    """The numpy engine mirrors the jax engine's f32 accumulation for
+    sub-f32 floats (f16 sums/counts saturate at the 11-bit mantissa)."""
+
+    def _x(self):
+        return np.linspace(0, 1, 2000).astype(np.float16), np.zeros(2000, np.int64)
+
+    @pytest.mark.parametrize(
+        "func,expect,tol",
+        [("nanmean", 0.5, 1e-3), ("nansum", 999.5, 1.5),
+         ("nanvar", 1 / 12, 1e-3), ("nanstd", (1 / 12) ** 0.5, 1e-3)],
+    )
+    def test_reductions(self, func, expect, tol):
+        x, z = self._x()
+        out = engine_numpy.generic_kernel(func, z, x, size=1)
+        assert out.dtype == np.float16
+        assert abs(float(out[0]) - expect) < tol
+
+    def test_cumsum(self):
+        x, z = self._x()
+        out = engine_numpy.generic_kernel("nancumsum", z, x, size=1)
+        assert out.dtype == np.float16
+        assert abs(float(out[-1]) - 999.5) < 1.5
+
+
+def test_bf16_accumulation_numpy_engine():
+    # review regression: bfloat16 registers with numpy as kind 'V'; the
+    # accumulation promotion must still catch it
+    import ml_dtypes
+
+    x = np.linspace(0, 1, 2000).astype(ml_dtypes.bfloat16)
+    z = np.zeros(2000, np.int64)
+    s = engine_numpy.generic_kernel("nansum", z, x, size=1)
+    m = engine_numpy.generic_kernel("nanmean", z, x, size=1)
+    assert abs(float(s[0]) - 1000) < 10
+    assert abs(float(m[0]) - 0.5) < 0.01

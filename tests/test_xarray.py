@@ -230,3 +230,14 @@ def test_binned_grouper_dim_order(da):
     out = xarray_reduce(da_t, "month", func="mean", isbin=True,
                         expected_groups=np.array([0, 6, 12]))
     assert out.dims == ("month_bins", "lat")
+
+
+def test_rechunk_for_cohorts_wrapper():
+    from flox_tpu.xarray import rechunk_for_cohorts
+
+    da = DataArray(np.zeros(48), dims=("time",),
+                   coords={"month": ("time", np.arange(48) % 12)})
+    chunks = rechunk_for_cohorts(da, "time", da["month"], force_new_chunk_at=[0], chunksize=12)
+    assert sum(chunks) == 48 and chunks == (12, 12, 12, 12)
+    with pytest.raises(ValueError, match="labels have length"):
+        rechunk_for_cohorts(da, "time", np.arange(20) % 12, force_new_chunk_at=[0])
