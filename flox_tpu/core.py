@@ -275,6 +275,27 @@ def groupby_reduce(
     as one SPMD program over ``mesh`` (default: a 1-D mesh over all
     devices), sharding the reduced axis and combining with collectives —
     the TPU analogue of the reference's dask execution methods (core.py:89).
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from flox_tpu import groupby_reduce
+    >>> values = np.array([1.0, 2.0, 4.0, 8.0])
+    >>> labels = np.array([0, 0, 1, 1])
+    >>> result, groups = groupby_reduce(values, labels, func="sum", engine="numpy")
+    >>> result
+    array([ 3., 12.])
+    >>> groups
+    array([0, 1])
+
+    Binning, and a group with no members filled per the aggregation:
+
+    >>> result, bins = groupby_reduce(
+    ...     values, values, func="count", engine="numpy",
+    ...     expected_groups=np.array([0.0, 3.0, 6.0, 9.0]), isbin=True,
+    ... )
+    >>> result
+    array([2, 1, 1])
     """
     if not by:
         raise TypeError("Must pass at least one `by`")

@@ -48,6 +48,17 @@ def groupby_scan(
     Parity: scan.py:101-315 — single-axis validation (scan.py:176-177),
     early factorization (210-220), integer dtype promotion for cumsum
     (272-283). Positions with missing labels (NaN-by) yield NaN.
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from flox_tpu import groupby_scan
+    >>> groupby_scan(np.array([1.0, 2.0, 4.0, 8.0]), np.array([0, 1, 0, 1]),
+    ...              func="cumsum", engine="numpy")
+    array([ 1.,  2.,  5., 10.])
+    >>> groupby_scan(np.array([1.0, np.nan, np.nan, 8.0]), np.array([0, 1, 0, 1]),
+    ...              func="ffill", engine="numpy")
+    array([ 1., nan,  1.,  8.])
     """
     if not by:
         raise TypeError("Must pass at least one `by`")

@@ -285,3 +285,17 @@ def test_scan_bad_axis_errors():
             np.arange(16.0), np.arange(16) % 2, SCANS["cumsum"], size=2,
             mesh=mesh2, axis_name="bogus",
         )
+
+
+def test_factorize_rangeindex_defensive_copy():
+    # reference regression test_core.py:1828: the RangeIndex fast path must
+    # copy — returning the caller's buffer caused a shared-memory race when
+    # the clamp wrote -1 into it
+    from flox_tpu.factorize import factorize_single
+
+    labels = np.array([0, 1, 5, 2], dtype=np.int64)
+    orig = labels.copy()
+    codes, groups = factorize_single(labels, pd.RangeIndex(3))
+    np.testing.assert_array_equal(labels, orig)  # input untouched
+    assert codes.base is not labels and codes is not labels
+    np.testing.assert_array_equal(codes, [0, 1, -1, 2])

@@ -1,0 +1,16 @@
+"""Doctest runner for the public API docstrings (the reference runs
+doctests on aggregations/core/xarray in CI, ci-additional.yaml:59-64)."""
+
+import doctest
+
+import pytest
+
+import flox_tpu.core
+import flox_tpu.scan
+
+
+@pytest.mark.parametrize("module", [flox_tpu.core, flox_tpu.scan])
+def test_doctests(module):
+    results = doctest.testmod(module, verbose=False)
+    assert results.attempted > 0
+    assert results.failed == 0
