@@ -460,3 +460,14 @@ def test_mesh_sweep_all_funcs(func, nby, nan_by, method):
         np.asarray(mesh_r).astype(np.float64), np.asarray(eager).astype(np.float64),
         rtol=1e-10, atol=1e-10, equal_nan=True,
     )
+
+
+def test_complex_on_mesh():
+    # complex128 intermediates ride psum/all_gather unchanged
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=96) + 1j * rng.normal(size=96)
+    labels = np.arange(96) % 5
+    for func in ["sum", "nansum", "mean", "nanmean", "count", "first", "last"]:
+        eager, _ = groupby_reduce(vals, labels, func=func, engine="jax")
+        mesh_r, _ = groupby_reduce(vals, labels, func=func, method="map-reduce", mesh=make_mesh(8))
+        np.testing.assert_allclose(np.asarray(mesh_r), np.asarray(eager), rtol=1e-12, err_msg=func)
