@@ -79,6 +79,71 @@ def _resolve_dim(dim, by_dims: tuple[Hashable, ...], obj_dims: tuple[Hashable, .
     return tuple(dim)
 
 
+def _plain_reduce(obj, dims, func: str, finalize_kwargs, keep_attrs: bool):
+    """Non-grouped reduction over ``dims`` (parity: xarray.py:303-322).
+
+    With real xarray, delegate to the object's own reduction method (as the
+    reference does) so coords/attrs survive natively. On xrlite, reduce with
+    the array's own namespace — jax arrays stay on device. Explicit
+    nan-funcs map to skipna semantics here (the reference instead raises
+    and asks for ``skipna=True`` — our skipna rewrite runs before this gate,
+    so both spellings are equivalent by the time they arrive).
+    """
+    if not isinstance(func, str):
+        raise NotImplementedError(
+            "func must be a string when reducing along dimensions not in `by`"
+        )
+    kwargs = dict(finalize_kwargs or {})
+    skipna = func.startswith("nan")
+    base = func.removeprefix("nan") if skipna else func
+
+    if HAS_XARRAY and hasattr(obj, base):
+        kw = dict(kwargs)
+        if skipna:
+            kw["skipna"] = True
+        kw["keep_attrs"] = keep_attrs
+        return getattr(obj, base)(dim=list(dims), **kw)
+
+    axes = tuple(list(obj.dims).index(d) for d in dims)
+    data = obj.data if hasattr(obj, "data") else obj
+    from .utils import is_jax_array
+
+    if is_jax_array(data):
+        import jax.numpy as xp
+    else:
+        xp = np
+        data = np.asarray(data)
+    q = kwargs.pop("q", 0.5) if base == "quantile" else None
+    if base in ("argmax", "argmin"):
+        if len(axes) != 1:
+            raise NotImplementedError("arg-reductions reduce a single dim")
+        result = getattr(xp, func)(data, axis=axes[0], **kwargs)
+    elif func == "count":
+        result = xp.sum(~xp.isnan(data), axis=axes)
+    elif base == "quantile":
+        result = (xp.nanquantile if skipna else xp.quantile)(data, q, axis=axes, **kwargs)
+    elif hasattr(xp, func):
+        result = getattr(xp, func)(data, axis=axes, **kwargs)
+    else:
+        raise NotImplementedError(
+            f"plain reduction over non-grouper dims has no array-namespace "
+            f"equivalent for {func!r}; reduce with groupby_reduce on the raw array."
+        )
+    out_dims = tuple(d for d in obj.dims if d not in dims)
+    vector_q = base == "quantile" and np.ndim(q) > 0
+    if vector_q:
+        out_dims = ("quantile",) + out_dims
+    xr = _get_xr()
+    da = xr.DataArray(result, dims=out_dims, name=getattr(obj, "name", None),
+                      attrs=dict(obj.attrs) if keep_attrs else {})
+    for cname, (cdims, cdata) in getattr(obj, "_coords", {}).items():
+        if all(d in out_dims for d in cdims):
+            da._coords[cname] = (cdims, cdata)
+    if vector_q:
+        da = da.assign_coords({"quantile": np.asarray(q, dtype=float)})
+    return da
+
+
 def xarray_reduce(
     obj,
     *by,
@@ -184,6 +249,12 @@ def xarray_reduce(
     if bad:
         raise ValueError(f"Cannot reduce over missing dims {bad}")
 
+    isbin_seq = (isbin,) * len(by_das) if isinstance(isbin, bool) else tuple(isbin)
+    if dims and all(d not in grouper_dims for d in dims) and not any(isbin_seq):
+        # groups do not vary along any reduced dim: this is a plain
+        # reduction, no groupby at all (parity: xarray.py:303-322)
+        return _plain_reduce(obj, dims, func, finalize_kwargs, keep_attrs)
+
     # broadcast groupers against each other (parity: xarray.py:284-301);
     # reduced dims the labels don't span are broadcast by expand_dims
     by_b = list(xr.broadcast(*by_das))
@@ -206,7 +277,7 @@ def xarray_reduce(
         expected_t = (expected_groups,)
     else:
         expected_t = tuple(expected_groups)
-    isbin_t = (isbin,) * nby if isinstance(isbin, bool) else tuple(isbin)
+    isbin_t = isbin_seq  # normalized once at the fast-path gate (same length)
 
     reduce_dims = tuple(d for d in by_dims if d in dims)
     # groupby_reduce requires by to span the trailing reduced dims of the

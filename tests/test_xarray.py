@@ -241,3 +241,31 @@ def test_rechunk_for_cohorts_wrapper():
     assert sum(chunks) == 48 and chunks == (12, 12, 12, 12)
     with pytest.raises(ValueError, match="labels have length"):
         rechunk_for_cohorts(da, "time", np.arange(20) % 12, force_new_chunk_at=[0])
+
+
+def test_plain_reduction_fast_path(da):
+    # reducing only over dims the groupers do not vary along is a plain
+    # reduction, no groupby (parity: reference xarray.py:303-322)
+    out = xarray_reduce(da, "month", func="mean", dim="lat")
+    assert out.dims == ("time",)
+    np.testing.assert_allclose(np.asarray(out.data), da.values.mean(0))
+    # coords on surviving dims carry over; the grouper coord survives too
+    assert "month" in out._coords
+    outc = xarray_reduce(da, "month", func="count", dim="lat")
+    np.testing.assert_array_equal(np.asarray(outc.data), np.full(48, 3))
+
+
+def test_plain_path_argmax_and_vector_q(da):
+    # review regressions: arg-reductions single-dim; vector q gets a coord;
+    # jax-backed data stays on device
+    import jax
+    import jax.numpy as jnp
+
+    da_t = DataArray(da.values, dims=da.dims, coords=da._coords)
+    out = xarray_reduce(da_t, "month", func="argmax", dim="lat")
+    np.testing.assert_array_equal(np.asarray(out.data), np.argmax(da.values, 0))
+    oq = xarray_reduce(da_t, "month", func="quantile", dim="lat", q=[0.25, 0.75])
+    np.testing.assert_allclose(np.asarray(oq["quantile"].data), [0.25, 0.75])
+    daj = DataArray(jnp.asarray(da.values), dims=da.dims, coords=da._coords)
+    oj = xarray_reduce(daj, "month", func="nanmean", dim="lat")
+    assert isinstance(oj.data, jax.Array)
