@@ -156,6 +156,27 @@ def bench_mesh_methods(scale: str):
     return out
 
 
+def bench_streaming(scale: str):
+    """Out-of-core streaming throughput (the role the reference's dask/cubed
+    chunked runtimes play) — ERA5-month shape streamed in bounded slabs."""
+    from flox_tpu.streaming import streaming_groupby_reduce
+
+    nt = 26304 if scale == "full" else 8760
+    nspace = 72 * 144 if scale == "full" else 24 * 48
+    rng = np.random.default_rng(0)
+    month = ((np.arange(nt) // (24 * 30.44)).astype(np.int64)) % 12
+    data = rng.normal(size=(nspace, nt)).astype(np.float32)
+    streaming_groupby_reduce(data, month, func="nanmean", batch_bytes=64 * 2**20)  # warm
+    t0 = time.perf_counter()
+    streaming_groupby_reduce(data, month, func="nanmean", batch_bytes=64 * 2**20)
+    t = time.perf_counter() - t0
+    return [
+        {"bench": "time_streaming[era5-nanmean]", "value": round(t * 1e3, 1), "unit": "ms"},
+        {"bench": "streaming_throughput[era5-nanmean]",
+         "value": round(data.nbytes / t / 1e9, 2), "unit": "GB/s"},
+    ]
+
+
 def bench_scan(engine: str, scale: str):
     """Grouped-scan timing (reference tracks scans through its asv suite)."""
     from flox_tpu import groupby_scan
@@ -236,6 +257,7 @@ def main() -> None:
         # runnable on hosts without one
         results += bench_mesh_methods(args.scale)
         results += bench_scan_blelloch(args.scale)
+        results += bench_streaming(args.scale)
     results += bench_cohort_detection(args.scale)
     for r in results:
         print(json.dumps(r))

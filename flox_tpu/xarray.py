@@ -96,13 +96,17 @@ def _plain_reduce(obj, dims, func: str, finalize_kwargs, keep_attrs: bool):
     kwargs = dict(finalize_kwargs or {})
     skipna = func.startswith("nan")
     base = func.removeprefix("nan") if skipna else func
+    if base in ("argmax", "argmin") and len(dims) != 1:
+        raise NotImplementedError("arg-reductions reduce a single dim")
 
     if HAS_XARRAY and hasattr(obj, base):
         kw = dict(kwargs)
         if skipna:
             kw["skipna"] = True
         kw["keep_attrs"] = keep_attrs
-        return getattr(obj, base)(dim=list(dims), **kw)
+        # scalar dim for arg-reductions: xarray returns a dict for list dims
+        dim_arg = dims[0] if base in ("argmax", "argmin") else list(dims)
+        return getattr(obj, base)(dim=dim_arg, **kw)
 
     axes = tuple(list(obj.dims).index(d) for d in dims)
     data = obj.data if hasattr(obj, "data") else obj
@@ -252,7 +256,18 @@ def xarray_reduce(
     isbin_seq = (isbin,) * len(by_das) if isinstance(isbin, bool) else tuple(isbin)
     if dims and all(d not in grouper_dims for d in dims) and not any(isbin_seq):
         # groups do not vary along any reduced dim: this is a plain
-        # reduction, no groupby at all (parity: xarray.py:303-322)
+        # reduction, no groupby at all (parity: xarray.py:303-322). The
+        # groupers still must align with the object — the general path
+        # enforces this via broadcast + join='exact', so the shortcut
+        # cannot be laxer.
+        for b in by_das:
+            for d, sz in b.sizes.items():
+                if d not in obj.dims or obj.sizes[d] != sz:
+                    raise ValueError(
+                        f"grouper {getattr(b, 'name', None)!r} dim {d!r} "
+                        f"(size {sz}) does not align with the object "
+                        f"(dims {dict(obj.sizes)})"
+                    )
         return _plain_reduce(obj, dims, func, finalize_kwargs, keep_attrs)
 
     # broadcast groupers against each other (parity: xarray.py:284-301);

@@ -269,3 +269,11 @@ def test_plain_path_argmax_and_vector_q(da):
     daj = DataArray(jnp.asarray(da.values), dims=da.dims, coords=da._coords)
     oj = xarray_reduce(daj, "month", func="nanmean", dim="lat")
     assert isinstance(oj.data, jax.Array)
+
+
+def test_plain_path_misaligned_grouper_raises(da):
+    # review regression: the fast path must enforce alignment like the
+    # general path's join='exact'
+    bad = DataArray(np.arange(20) % 12, dims=("time",), name="m")
+    with pytest.raises(ValueError, match="align"):
+        xarray_reduce(da, bad, func="mean", dim="lat")
