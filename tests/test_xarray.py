@@ -277,3 +277,54 @@ def test_plain_path_misaligned_grouper_raises(da):
     bad = DataArray(np.arange(20) % 12, dims=("time",), name="m")
     with pytest.raises(ValueError, match="align"):
         xarray_reduce(da, bad, func="mean", dim="lat")
+
+
+def test_sort_false_through_adapter(da):
+    out_sorted = xarray_reduce(da, "month", func="sum")
+    out_unsorted = xarray_reduce(da, "month", func="sum", sort=False)
+    # labels appear in order here either way; results must agree
+    np.testing.assert_allclose(
+        np.asarray(out_sorted.data), np.asarray(out_unsorted.data)
+    )
+
+
+def test_fill_value_through_adapter(da):
+    out = xarray_reduce(da, "month", func="sum", expected_groups=np.arange(14),
+                        fill_value=-777.0)
+    res = np.asarray(out.transpose("lat", "month").data)
+    assert (res[:, 12:] == -777.0).all()
+
+
+def test_cohorts_method_through_adapter(da):
+    from flox_tpu.parallel import make_mesh
+
+    out_eager = xarray_reduce(da, "month", func="nanvar", ddof=1)
+    out_coh = xarray_reduce(da, "month", func="nanvar", ddof=1,
+                            method="cohorts", mesh=make_mesh(8))
+    np.testing.assert_allclose(
+        np.asarray(out_coh.data), np.asarray(out_eager.data), rtol=1e-10
+    )
+
+
+def test_grouper_along_other_dim(da):
+    # grouping along lat while reducing lat: groups vary along the reduced
+    # dim -> the grouped path engages, dims = (time, lat-groups)
+    lat_band = DataArray(np.array([0, 0, 1]), dims=("lat",), name="band")
+    out = xarray_reduce(da, lat_band, func="mean", dim="lat")
+    assert out.sizes["band"] == 2
+    np.testing.assert_allclose(
+        np.asarray(out.transpose("band", "time").data)[0],
+        da.values[:2].mean(0),
+    )
+
+
+def test_dataset_multiple_reduced_vars(da):
+    ds = Dataset({
+        "a": da,
+        "b": DataArray(da.values * 2, dims=da.dims, coords=da._coords),
+        "static": DataArray(np.arange(3.0), dims=("lat",)),
+    })
+    out = xarray_reduce(ds, "month", func="nanmean")
+    np.testing.assert_allclose(np.asarray(out["b"].data),
+                               np.asarray(out["a"].data) * 2, rtol=1e-12)
+    np.testing.assert_array_equal(out["static"].values, np.arange(3.0))
