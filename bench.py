@@ -9,6 +9,8 @@ Scale knobs (env):
   FLOX_TPU_BENCH_NLAT / NLON / NTIME — workload shape (default 181x360x26304,
   ~6.8 GB float32: 3 years of hourly steps on a 1-degree grid).
   FLOX_TPU_BENCH_REPS — timed repetitions (default 5).
+  FLOX_TPU_BENCH_CHAIN — iterations in the differenced timing chain
+  (default 8, min 2; see the timing note in main()).
 """
 
 from __future__ import annotations
@@ -126,14 +128,41 @@ def main() -> None:
     dev_data = jax.device_put(data.reshape(nlat * nlon, ntime))
     dev_codes = jax.device_put(month)
 
-    fn = jax.jit(lambda c, v: generic_kernel("nanmean", c, v, size=size))
-    fn(dev_codes, dev_data).block_until_ready()  # compile + warm
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        fn(dev_codes, dev_data).block_until_ready()
-        times.append(time.perf_counter() - t0)
-    t_dev = min(times)
+    # Timing must NOT trust block_until_ready: through the axon tunnel it
+    # returns before execution finishes (observed: 2.3 GB "reduced" in
+    # 0.03 ms). Instead time a jitted chain of K dependent iterations with a
+    # host fetch of the (tiny) result, and difference against a 1-iteration
+    # chain so the constant fetch/dispatch overhead cancels:
+    #   t_iter = (t_K - t_1) / (K - 1)
+    # The inter-iteration dependence is a scalar broadcast folded into the
+    # reduction's input read, so per-iteration HBM traffic stays ~one pass
+    # over the data.
+    def chain(iters):
+        @jax.jit
+        def run(c, v):
+            out = generic_kernel("nanmean", c, v, size=size)
+            for _ in range(iters - 1):
+                out = generic_kernel("nanmean", c, v + out[..., :1] * 0.0, size=size)
+            return out
+
+        return run
+
+    chain_k = max(2, int(os.environ.get("FLOX_TPU_BENCH_CHAIN", 8)))
+
+    def best_time(fn):
+        np.asarray(fn(dev_codes, dev_data))  # compile + warm
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(fn(dev_codes, dev_data))
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    t_1 = best_time(chain(1))
+    t_k = best_time(chain(chain_k))
+    t_dev = (t_k - t_1) / (chain_k - 1)
+    if t_dev <= 0:  # noise floor: fall back to the single-shot fetch time
+        t_dev = t_1
     gbps = nbytes / t_dev / 1e9
 
     # --- host baseline: an independent numpy_groupies-equivalent -----------

@@ -121,6 +121,13 @@ def _use_matmul_path(op: str, data, size: int) -> bool:
         return False
     if n * size * itemsize > 2**31:
         return False
+    # the GEMM operand is the (N, 4K) zeroed-data + non-finite-marker
+    # stacking (_seg_matmul_sum): 4x the data footprint materialized in HBM.
+    # Cap it well below accelerator HBM (v5e: 16 GB) or a bench-scale array
+    # OOMs where the scatter path streams fine (observed on chip: 2.3 GB
+    # input -> 9.1 GB stacking -> allocation failure).
+    if 4 * n * k * itemsize > 2**32:
+        return False
     return True
 
 
