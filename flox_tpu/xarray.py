@@ -203,10 +203,16 @@ def xarray_reduce(
                 if len(by_named) == 1 and reduced.ndim > 1:
                     # dataset members put the group dim first (parity:
                     # xarray.py:497-505, no_groupby_reorder)
-                    first_isbin = isbin if isinstance(isbin, bool) else bool(isbin[0])
+                    # the group dim is whatever new dim the recursive call
+                    # produced (it already applied the binned-name rule);
+                    # don't re-derive it here
+                    (new_name,) = [
+                        d for d in reduced.dims
+                        if d not in var.dims and d != "quantile"
+                    ]
                     by_o = by_named[0]
-                    if first_isbin:
-                        by_o = by_o.rename(f"{by_o.name}_bins")
+                    if new_name != by_o.name:
+                        by_o = by_o.rename(new_name)
                     reduced = _restore_dim_order(
                         reduced, var, by_o, no_groupby_reorder=True
                     )
@@ -303,7 +309,12 @@ def xarray_reduce(
     )
     by_b = [b.transpose(*input_core) for b in by_b]
 
-    new_dim_names = [f"{name}_bins" if bin_ else name for name, bin_ in zip(by_names, isbin_t)]
+    # a grouper is binned when isbin is set OR its expected groups are an
+    # IntervalIndex (parity: xarray.py:334)
+    new_dim_names = [
+        f"{name}_bins" if (bin_ or isinstance(exp, pd.IntervalIndex)) else name
+        for name, bin_, exp in zip(by_names, isbin_t, expected_t)
+    ]
     keep_by_dims = [d for d in input_core if d not in reduce_dims]
     q = finalize_kwargs.get("q") if finalize_kwargs else None
     has_q_dim = func in ("quantile", "nanquantile") and q is not None and np.ndim(q) > 0

@@ -155,6 +155,54 @@ def test_groupby_reduce_all(engine, func, shape, add_nan):
     compare(result, expected, func)
 
 
+@pytest.mark.parametrize("nby", [2, 3])
+@pytest.mark.parametrize("nan_by", [False, True])
+@pytest.mark.parametrize("func", ALL_FUNCS)
+def test_groupby_reduce_all_multiby(engine, func, nby, nan_by):
+    """Product-grid correctness for every func at nby 2-3, with and without
+    NaN labels, against the per-group oracle (reference
+    tests/test_core.py:222-388 sweeps nby 1-3; the nby=1 leg is
+    test_groupby_reduce_all)."""
+    import zlib
+
+    rng = np.random.default_rng(zlib.crc32(f"{func}-{nby}-{nan_by}".encode()))
+    n = 60
+    values = np.round(rng.normal(size=n), 1)
+    sizes = (3, 2, 2)[:nby]
+    bys = [rng.integers(0, s, n).astype(np.float64) for s in sizes]
+    if nan_by:
+        for b in bys:
+            b[rng.random(n) < 0.15] = np.nan
+
+    fkw = {}
+    if func in ("var", "nanvar", "std", "nanstd"):
+        fkw = {"ddof": 1}
+    if func in ("quantile", "nanquantile"):
+        fkw = {"q": 0.7}
+
+    result, *groups = groupby_reduce(
+        values, *bys, func=func, engine=engine, finalize_kwargs=fkw
+    )
+
+    # oracle: row-major ravel of per-by codes over the discovered-group grid
+    exp_groups = [np.unique(b[~np.isnan(b)]) for b in bys]
+    for g, e in zip(groups, exp_groups):
+        np.testing.assert_array_equal(np.asarray(g, dtype=np.float64), e)
+    grid = tuple(len(e) for e in exp_groups)
+    flat_codes = np.zeros(n, dtype=np.int64)
+    invalid = np.zeros(n, dtype=bool)
+    for b, e in zip(bys, exp_groups):
+        nanmask = np.isnan(b)
+        c = np.searchsorted(e, np.where(nanmask, e[0], b))
+        flat_codes = flat_codes * len(e) + c
+        invalid |= nanmask
+    flat_codes[invalid] = -1
+
+    expected = reference_loop(func, values, flat_codes, int(np.prod(grid)), **fkw)
+    assert np.asarray(result).shape == grid
+    compare(np.asarray(result).reshape(-1), expected, func)
+
+
 @pytest.mark.parametrize("func", ["sum", "nanmean", "max", "count"])
 def test_expected_groups_reindex(engine, func):
     labels = np.array([1, 1, 3, 3, 5])

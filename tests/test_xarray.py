@@ -328,3 +328,37 @@ def test_dataset_multiple_reduced_vars(da):
     np.testing.assert_allclose(np.asarray(out["b"].data),
                                np.asarray(out["a"].data) * 2, rtol=1e-12)
     np.testing.assert_array_equal(out["static"].values, np.arange(3.0))
+
+
+def test_datetime_bin_resample(da):
+    # hourly -> daily-bin resampling via a datetime IntervalIndex, through
+    # the adapter (reference user story: resampling with datetime bins)
+    nt = 48
+    t = pd.date_range("2001-01-01", periods=nt, freq="h")
+    data = np.arange(float(nt))
+    da_t = DataArray(
+        data, dims=("time",), coords={"time": t.values}, name="x"
+    )
+    bins = pd.interval_range(t[0], periods=2, freq="24h")
+    out = xarray_reduce(da_t, "time", func="mean", expected_groups=bins)
+    groups = out["time_bins"].data
+    assert isinstance(groups, pd.IntervalIndex)
+    assert (groups == bins).all()
+    # right-closed pd.cut semantics: hour 0 falls outside the first bin
+    np.testing.assert_allclose(
+        np.asarray(out.data), [np.arange(1, 25).mean(), np.arange(25, 48).mean()]
+    )
+
+
+def test_nongrouped_coord_preserved(da):
+    # lat is not grouped and not reduced: its coordinate must survive
+    out = xarray_reduce(da, "month", func="mean")
+    assert "lat" in out._coords
+    np.testing.assert_array_equal(np.asarray(out["lat"].data), [10.0, 20.0, 30.0])
+
+
+def test_attrs_preserved_by_default(da):
+    out = xarray_reduce(da, "month", func="sum")
+    assert out.attrs == {"units": "K"}
+    ds_out = xarray_reduce(Dataset({"temp": da}, attrs={"title": "t"}), "month", func="sum")
+    assert ds_out.attrs == {"title": "t"}
