@@ -121,12 +121,13 @@ def _use_matmul_path(op: str, data, size: int) -> bool:
         return False
     if n * size * itemsize > 2**31:
         return False
-    # the GEMM operand is the (N, 4K) zeroed-data + non-finite-marker
-    # stacking (_seg_matmul_sum): 4x the data footprint materialized in HBM.
-    # Cap it well below accelerator HBM (v5e: 16 GB) or a bench-scale array
-    # OOMs where the scatter path streams fine (observed on chip: 2.3 GB
-    # input -> 9.1 GB stacking -> allocation failure).
-    if 4 * n * k * itemsize > 2**32:
+    # wide-K inputs are safe: _seg_matmul_sum blocks the K axis so the
+    # (N, 4kb) marker stacking stays ~matmul_block_bytes per block (an
+    # unblocked bench-scale array OOMed on chip: 2.3 GB input -> 9.1 GB
+    # stacking -> allocation failure). Blocking bounds K but not N, and the
+    # block width floors at 128 lanes — so the smallest possible block must
+    # still fit comfortably in HBM or we fall back to scatter.
+    if 4 * n * min(k, 128) * itemsize > 2**32:
         return False
     return True
 
@@ -148,35 +149,71 @@ def _seg_matmul_sum(data, codes, size: int, *, skipna: bool = False, return_nan_
     precision=HIGHEST keeps f32 operands f32 on the MXU (the default would
     demote them to bf16, losing accuracy vs the scatter path this replaces).
     """
+    from .options import OPTIONS
+
     n = data.shape[0]
     onehot = (codes[:, None] == jnp.arange(size, dtype=codes.dtype)[None, :]).astype(
         data.dtype
     )  # (N, size)
-    flat = data.reshape(n, -1)  # (N, K)
-    k = flat.shape[1]
-    isnan = jnp.isnan(flat)
-    ispos = jnp.isposinf(flat)
-    isneg = jnp.isneginf(flat)
-    nonfinite = isnan | ispos | isneg
-    zeroed = jnp.where(nonfinite, jnp.zeros((), flat.dtype), flat)
-    stacked = jnp.concatenate(
-        [zeroed, isnan.astype(flat.dtype), ispos.astype(flat.dtype), isneg.astype(flat.dtype)],
-        axis=1,
-    )  # (N, 4K)
-    # bf16 operands stream at full rate while the MXU accumulates into f32
-    # (its native mode); without this the sums AND the marker counts would
-    # saturate at bf16's 8-bit mantissa.
-    out = jax.lax.dot_general(
-        onehot,
-        stacked,
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=_acc_dtype(flat.dtype),
-        precision=jax.lax.Precision.HIGHEST,
-    )  # (size, 4K)
-    sums = out[:, :k]
-    nan_c = out[:, k : 2 * k]
-    pos_c = out[:, 2 * k : 3 * k]
-    neg_c = out[:, 3 * k :]
+    # explicit K: reshape(-1) is ambiguous for zero-length inputs
+    k = int(np.prod(data.shape[1:])) if data.ndim > 1 else 1
+    flat = data.reshape(n, k)  # (N, K)
+
+    def marker_gemm(block):
+        """(N, kb) -> (size, 4, kb): [sums, nan, +inf, -inf] per group/col.
+
+        bf16 operands stream at full rate while the MXU accumulates into f32
+        (its native mode); without this the sums AND the marker counts would
+        saturate at bf16's 8-bit mantissa.
+        """
+        kb = block.shape[1]
+        isnan = jnp.isnan(block)
+        ispos = jnp.isposinf(block)
+        isneg = jnp.isneginf(block)
+        nonfinite = isnan | ispos | isneg
+        zeroed = jnp.where(nonfinite, jnp.zeros((), block.dtype), block)
+        stacked = jnp.concatenate(
+            [zeroed, isnan.astype(block.dtype), ispos.astype(block.dtype),
+             isneg.astype(block.dtype)],
+            axis=1,
+        )  # (N, 4kb)
+        out = jax.lax.dot_general(
+            onehot,
+            stacked,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=_acc_dtype(block.dtype),
+            precision=jax.lax.Precision.HIGHEST,
+        )  # (size, 4kb)
+        return out.reshape(size, 4, kb)
+
+    # the (N, 4kb) marker stacking is the path's only HBM-scale temp; bound
+    # it by looping column blocks sequentially (lax.map) when K is wide —
+    # per-block temps stay ~matmul_block_bytes while the data still streams
+    # through the MXU once.
+    itemsize = np.dtype(str(flat.dtype)).itemsize
+    kb_max = max(
+        128,
+        (OPTIONS["matmul_block_bytes"] // (4 * max(n, 1) * itemsize)) // 128 * 128,
+    )
+    if k <= kb_max:
+        parts = marker_gemm(flat)  # (size, 4, K)
+    else:
+        nblocks = -(-k // kb_max)
+        pad = nblocks * kb_max - k
+        padded = jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
+
+        def one(i):
+            return marker_gemm(
+                jax.lax.dynamic_slice_in_dim(padded, i * kb_max, kb_max, axis=1)
+            )
+
+        outs = jax.lax.map(one, jnp.arange(nblocks))  # (nblocks, size, 4, kb)
+        parts = jnp.moveaxis(outs, 0, 2).reshape(size, 4, nblocks * kb_max)[..., :k]
+
+    sums = parts[:, 0]
+    nan_c = parts[:, 1]
+    pos_c = parts[:, 2]
+    neg_c = parts[:, 3]
     from .utils import reapply_nonfinite
 
     out_v = reapply_nonfinite(sums, nan_c, pos_c, neg_c, skipna=skipna)

@@ -30,6 +30,11 @@ OPTIONS: dict[str, Any] = {
     # Kahan-compensated accumulation across Pallas tiles (f32 accuracy on
     # hardware without float64)
     "pallas_compensated": True,
+    # per-block budget for the GEMM path's (N, 4*kb) marker stacking; wide-K
+    # inputs loop column blocks of this many bytes instead of materializing
+    # the whole stacking (256 MB default: big enough to keep the MXU fed,
+    # small next to HBM)
+    "matmul_block_bytes": 2**28,
 }
 
 _VALIDATORS = {
@@ -40,6 +45,7 @@ _VALIDATORS = {
     "segment_sum_impl": lambda x: x in ("auto", "scatter", "matmul", "pallas"),
     "pallas_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
     "pallas_compensated": lambda x: isinstance(x, bool),
+    "matmul_block_bytes": lambda x: isinstance(x, int) and x >= 2**20,
 }
 
 
@@ -54,6 +60,7 @@ def trace_fingerprint() -> tuple:
         OPTIONS["matmul_num_groups_max"],
         OPTIONS["pallas_num_groups_max"],
         OPTIONS["pallas_compensated"],
+        OPTIONS["matmul_block_bytes"],
     )
 
 

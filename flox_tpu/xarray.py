@@ -205,14 +205,16 @@ def xarray_reduce(
                     # xarray.py:497-505, no_groupby_reorder)
                     # the group dim is whatever new dim the recursive call
                     # produced (it already applied the binned-name rule);
-                    # don't re-derive it here
-                    (new_name,) = [
+                    # don't re-derive it here. No new dim means the group
+                    # dim reuses an existing name (grouping by a dim
+                    # coordinate) — keep the grouper's own name then.
+                    new_dims = [
                         d for d in reduced.dims
                         if d not in var.dims and d != "quantile"
                     ]
                     by_o = by_named[0]
-                    if new_name != by_o.name:
-                        by_o = by_o.rename(new_name)
+                    if new_dims and new_dims[0] != by_o.name:
+                        by_o = by_o.rename(new_dims[0])
                     reduced = _restore_dim_order(
                         reduced, var, by_o, no_groupby_reorder=True
                     )

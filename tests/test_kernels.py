@@ -291,6 +291,45 @@ class TestMatmulPath:
         a, _ = self._both("sum", codes, values, 2)
         np.testing.assert_allclose(a, [3.0, 3.0])
 
+    @pytest.mark.parametrize("func", ["sum", "nansum", "nanmean"])
+    def test_wide_k_blocked(self, func):
+        # K wide enough to trigger the lax.map column-blocking (incl. the
+        # non-multiple-of-kb padding path) must match scatter exactly
+        import flox_tpu
+
+        rng = np.random.default_rng(7)
+        n, k = 1000, 300  # kb floors to 128 at the minimum block budget
+        codes = rng.integers(0, 6, n)
+        values = rng.normal(size=(k, n))
+        values[rng.random((k, n)) < 0.05] = np.nan
+        values[0, :3] = np.inf
+        with flox_tpu.set_options(matmul_block_bytes=2**20):
+            a, b = self._both(func, codes, values, 6)
+        np.testing.assert_allclose(a, b, rtol=1e-10, atol=1e-12, equal_nan=True)
+
+    def test_huge_n_falls_back_to_scatter(self):
+        # blocking bounds K, not N: when even a 128-lane block exceeds the
+        # HBM ceiling, the path must refuse (shape-only check, no alloc)
+        class Fake:
+            shape = (2**24, 64)
+            ndim = 2
+            dtype = np.dtype("float32")
+
+        assert kernels._use_matmul_path("sum", Fake(), 12) is False
+
+        class FakeOk(Fake):
+            shape = (2**16, 64)
+
+        assert kernels._use_matmul_path("sum", FakeOk(), 12) is True
+
+    def test_empty_input(self):
+        # zero-length reductions must not divide by zero in the block sizing
+        codes = np.zeros(0, dtype=np.int64)
+        values = np.zeros((3, 0))
+        a, b = self._both("sum", codes, values, 2)
+        np.testing.assert_allclose(a, b)
+        np.testing.assert_allclose(a, np.zeros((3, 2)))
+
 
 def test_matmul_path_inf_exact():
     # inf must stay local to its group and column (0*inf hazard in the GEMM)

@@ -362,3 +362,19 @@ def test_attrs_preserved_by_default(da):
     assert out.attrs == {"units": "K"}
     ds_out = xarray_reduce(Dataset({"temp": da}, attrs={"title": "t"}), "month", func="sum")
     assert ds_out.attrs == {"title": "t"}
+
+
+def test_dataset_grouped_by_dim_coordinate():
+    # grouping by a dimension coordinate: the group dim keeps the dim's own
+    # name, which already exists on the variable (regression: the Dataset
+    # branch must not require a brand-new dim name)
+    x = np.array([0, 0, 1, 1])
+    da2 = DataArray(
+        np.arange(8.0).reshape(4, 2), dims=("x", "lat"), coords={"x": x}, name="a"
+    )
+    out = xarray_reduce(Dataset({"a": da2}), "x", func="mean")
+    assert out["a"].sizes["x"] == 2
+    np.testing.assert_allclose(
+        np.asarray(out["a"].transpose("x", "lat").data),
+        [[1.0, 2.0], [5.0, 6.0]],
+    )
