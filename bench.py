@@ -186,8 +186,13 @@ def main() -> None:
     from flox_tpu.options import OPTIONS
 
     if on_cpu and not os.environ.get("FLOX_TPU_BENCH_FORCE_SWEEP"):
+        from flox_tpu.kernels import _segment_sum_impl
+
         t_dev = measure_impl()
-        winner = OPTIONS["segment_sum_impl"]
+        # label with the impl the policy resolves to, not the policy string
+        winner = _segment_sum_impl(
+            jax.ShapeDtypeStruct((ntime, nlat * nlon), np.float32), size
+        )
         sweep_gbps = {}
     else:
         from flox_tpu.kernels import _segment_sum_impl

@@ -508,14 +508,20 @@ def nansum_of_squares(group_idx, array, **kw):
     return _sum_of_squares(group_idx, array, skipna=True, **kw)
 
 
-def _grouped_scan_host(group_idx, array, kind, dtype=None):
-    """Host grouped scans via stable argsort (mirrors the jax engine shape)."""
+def _grouped_scan_host(group_idx, array, kind, dtype=None, nat=False):
+    """Host grouped scans via stable argsort (mirrors the jax engine shape).
+
+    ``nat``: data is int64-viewed datetimes/timedeltas with missing =
+    INT64_MIN; ffill/bfill fill from the last valid and leave NaT where
+    nothing precedes, cumsum poisons the rest of the segment after a NaT
+    (numpy's NaT + x = NaT), nancumsum skips NaT.
+    """
     codes = np.asarray(group_idx).reshape(-1)
     data = np.moveaxis(np.asarray(array), -1, 0)
     if dtype is not None:
         data = data.astype(dtype, copy=False)
     out_dtype = data.dtype
-    if kind in ("cumsum", "nancumsum"):
+    if kind in ("cumsum", "nancumsum") and not nat:
         data = data.astype(_acc_dtype(out_dtype), copy=False)  # f16 running sums saturate
     perm = np.argsort(codes, kind="stable")
     inv = np.empty_like(perm)
@@ -526,17 +532,26 @@ def _grouped_scan_host(group_idx, array, kind, dtype=None):
     out = np.empty_like(sd)
     for b, e in zip(boundaries, np.r_[boundaries[1:], len(sc)]):
         seg = sd[b:e]
-        if kind == "cumsum":
-            out[b:e] = np.cumsum(seg, axis=0)
-        elif kind == "nancumsum":
-            out[b:e] = np.nancumsum(seg, axis=0)
+        if kind in ("cumsum", "nancumsum"):
+            if nat:
+                miss = seg == _NAT_INT
+                cs = np.where(miss, 0, seg).cumsum(axis=0)
+                if kind == "cumsum":
+                    cs = np.where(np.maximum.accumulate(miss, axis=0), _NAT_INT, cs)
+                out[b:e] = cs
+            elif kind == "cumsum":
+                out[b:e] = np.cumsum(seg, axis=0)
+            else:
+                out[b:e] = np.nancumsum(seg, axis=0)
         elif kind in ("ffill", "bfill"):
             s = seg if kind == "ffill" else seg[::-1]
-            if np.issubdtype(s.dtype, np.floating):
-                valid = ~np.isnan(s)
+            isfloat = np.issubdtype(s.dtype, np.floating)
+            if isfloat or nat:
+                valid = (s != _NAT_INT) if nat else ~np.isnan(s)
+                missing_val = _NAT_INT if nat else np.nan
                 idx = np.where(valid, np.arange(s.shape[0]).reshape((-1,) + (1,) * (s.ndim - 1)), -1)
                 np.maximum.accumulate(idx, axis=0, out=idx)
-                filled = np.where(idx >= 0, np.take_along_axis(s, idx.clip(0), axis=0), np.nan)
+                filled = np.where(idx >= 0, np.take_along_axis(s, idx.clip(0), axis=0), missing_val)
             else:
                 filled = s
             out[b:e] = filled if kind == "ffill" else filled[::-1]
@@ -546,19 +561,19 @@ def _grouped_scan_host(group_idx, array, kind, dtype=None):
 
 
 def cumsum(group_idx, array, *, axis=-1, size=None, fill_value=None, dtype=None, **kw):
-    return _grouped_scan_host(group_idx, array, "cumsum", dtype=dtype)
+    return _grouped_scan_host(group_idx, array, "cumsum", dtype=dtype, nat=kw.get("nat", False))
 
 
 def nancumsum(group_idx, array, *, axis=-1, size=None, fill_value=None, dtype=None, **kw):
-    return _grouped_scan_host(group_idx, array, "nancumsum", dtype=dtype)
+    return _grouped_scan_host(group_idx, array, "nancumsum", dtype=dtype, nat=kw.get("nat", False))
 
 
 def ffill(group_idx, array, *, axis=-1, size=None, fill_value=None, dtype=None, **kw):
-    return _grouped_scan_host(group_idx, array, "ffill")
+    return _grouped_scan_host(group_idx, array, "ffill", nat=kw.get("nat", False))
 
 
 def bfill(group_idx, array, *, axis=-1, size=None, fill_value=None, dtype=None, **kw):
-    return _grouped_scan_host(group_idx, array, "bfill")
+    return _grouped_scan_host(group_idx, array, "bfill", nat=kw.get("nat", False))
 
 
 KERNELS = {

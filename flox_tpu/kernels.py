@@ -1033,36 +1033,44 @@ def _grouped_scan_setup(group_idx, array):
     return sorted_codes, sorted_data, flags, inv
 
 
-def _cumsum_impl(group_idx, array, *, size, dtype, skipna):
+def _cumsum_impl(group_idx, array, *, size, dtype, skipna, nat=False):
     _, sorted_data, flags, inv = _grouped_scan_setup(group_idx, array)
-    mask = _nan_mask(sorted_data) if skipna else None
+    # nat: int64-viewed datetimes/timedeltas, missing = INT64_MIN. Unlike
+    # floats (where NaN propagates through + arithmetically), the sentinel
+    # must be masked out of the running sum and, for the non-skipna scan,
+    # re-poisoned from the first missing position onward (numpy cumsum of a
+    # NaT timedelta is NaT thereafter).
+    mask = _nan_mask(sorted_data, nat) if (skipna or nat) else None
     vals = sorted_data if mask is None else jnp.where(mask, sorted_data, jnp.zeros((), sorted_data.dtype))
     vals = _maybe_cast(vals, dtype)
     out_dtype = vals.dtype
     if jnp.issubdtype(vals.dtype, jnp.floating) and _acc_dtype(vals.dtype) != vals.dtype:
         vals = vals.astype(_acc_dtype(vals.dtype))  # bf16 running sums saturate
     scanned = _segmented_scan(vals, flags, jnp.add)
+    if nat and not skipna and mask is not None:
+        seen_missing = _segmented_scan((~mask).astype(jnp.int32), flags, jnp.maximum)
+        scanned = jnp.where(seen_missing > 0, jnp.asarray(_NAT_INT, scanned.dtype), scanned)
     if scanned.dtype != out_dtype:
         scanned = scanned.astype(out_dtype)
     return _from_leading(jnp.take(scanned, inv, axis=0))
 
 
 def cumsum(group_idx, array, *, axis=-1, size=None, fill_value=None, dtype=None, **kw):
-    return _cumsum_impl(group_idx, array, size=size, dtype=dtype, skipna=False)
+    return _cumsum_impl(group_idx, array, size=size, dtype=dtype, skipna=False, nat=kw.get("nat", False))
 
 
 def nancumsum(group_idx, array, *, axis=-1, size=None, fill_value=None, dtype=None, **kw):
-    return _cumsum_impl(group_idx, array, size=size, dtype=dtype, skipna=True)
+    return _cumsum_impl(group_idx, array, size=size, dtype=dtype, skipna=True, nat=kw.get("nat", False))
 
 
-def _ffill_impl(group_idx, array, *, reverse):
+def _ffill_impl(group_idx, array, *, reverse, nat=False):
     codes = jnp.asarray(group_idx).astype(jnp.int32).reshape(-1)
     data = _to_leading(array)
     if reverse:
         codes = codes[::-1]
         data = data[::-1]
     sorted_codes, sorted_data, flags, inv = _grouped_scan_setup(codes, _from_leading(data))
-    mask = _nan_mask(sorted_data)
+    mask = _nan_mask(sorted_data, nat)
     if mask is None:
         out = sorted_data
     else:
@@ -1070,7 +1078,13 @@ def _ffill_impl(group_idx, array, *, reverse):
         valid_idx = jnp.where(mask, iota, -1)
         last_valid = _segmented_scan(valid_idx, flags, jnp.maximum)
         gathered = jnp.take_along_axis(sorted_data, jnp.clip(last_valid, 0, None), axis=0)
-        out = jnp.where(last_valid >= 0, gathered, jnp.asarray(jnp.nan, sorted_data.dtype))
+        # "no prior valid" stays missing: NaT for int64-viewed datetimes
+        missing = (
+            jnp.asarray(_NAT_INT, sorted_data.dtype)
+            if nat and jnp.issubdtype(sorted_data.dtype, jnp.signedinteger)
+            else jnp.asarray(jnp.nan, sorted_data.dtype)
+        )
+        out = jnp.where(last_valid >= 0, gathered, missing)
     out = jnp.take(out, inv, axis=0)
     if reverse:
         out = out[::-1]
@@ -1078,11 +1092,11 @@ def _ffill_impl(group_idx, array, *, reverse):
 
 
 def ffill(group_idx, array, *, axis=-1, size=None, fill_value=None, dtype=None, **kw):
-    return _ffill_impl(group_idx, array, reverse=False)
+    return _ffill_impl(group_idx, array, reverse=False, nat=kw.get("nat", False))
 
 
 def bfill(group_idx, array, *, axis=-1, size=None, fill_value=None, dtype=None, **kw):
-    return _ffill_impl(group_idx, array, reverse=True)
+    return _ffill_impl(group_idx, array, reverse=True, nat=kw.get("nat", False))
 
 
 # ---------------------------------------------------------------------------

@@ -39,6 +39,7 @@ def sharded_groupby_scan(
     axis_name: str = "data",
     dtype=None,
     method: str = "blelloch",
+    nat: bool = False,
 ):
     """Sharded grouped scan over the trailing axis. Returns same shape as
     ``array`` (padded positions stripped).
@@ -77,13 +78,13 @@ def sharded_groupby_scan(
 
     from ..options import trace_fingerprint
 
-    cache_key = (scan.name, size, axes, mesh, arr.ndim, str(arr.dtype), method, trace_fingerprint())
+    cache_key = (scan.name, size, axes, mesh, arr.ndim, str(arr.dtype), method, nat, trace_fingerprint())
     fn = _SCAN_CACHE.get(cache_key)
     if fn is None:
         if method == "blockwise":
-            program = _build_blockwise_scan_program(scan, size=size)
+            program = _build_blockwise_scan_program(scan, size=size, nat=nat)
         else:
-            program = _build_scan_program(scan, size=size, axis_name=axes)
+            program = _build_scan_program(scan, size=size, axis_name=axes, nat=nat)
         fn = jax.jit(
             jax.shard_map(program, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
         )
@@ -121,26 +122,36 @@ def _validate_shard_local(codes: np.ndarray, ndev: int) -> None:
         )
 
 
-def _build_blockwise_scan_program(scan: Scan, *, size):
+def _build_blockwise_scan_program(scan: Scan, *, size, nat=False):
     """Shard-local groups: the within-shard segmented scan IS the answer —
     zero collectives (parity: the reference's blockwise scan, dask.py:624-651)."""
     from ..kernels import generic_kernel
 
     def program(arr_sh, codes_sh):
-        return generic_kernel(scan.scan, codes_sh, arr_sh, size=size)
+        return generic_kernel(scan.scan, codes_sh, arr_sh, size=size, nat=nat)
 
     return program
 
 
-def _build_scan_program(scan: Scan, *, size, axis_name):
+def _build_scan_program(scan: Scan, *, size, axis_name, nat=False):
     import jax
     import jax.numpy as jnp
 
     from ..kernels import generic_kernel
 
+    if nat and scan.mode == "apply_binary_op":
+        # NaT-aware cumsum needs the block summaries themselves to carry a
+        # "had NaT" channel through the carry fold; ffill/bfill (the real
+        # datetime use) and the blockwise method are supported
+        raise NotImplementedError(
+            "distributed blelloch cumsum over datetime/timedelta is not "
+            "supported; use method='blockwise' (after reshard_for_blockwise) "
+            "or run without a mesh."
+        )
+
     def program(arr_sh, codes_sh):
         # 1. within-shard segmented scan
-        local = generic_kernel(scan.scan, codes_sh, arr_sh, size=size)
+        local = generic_kernel(scan.scan, codes_sh, arr_sh, size=size, nat=nat)
 
         if scan.mode == "apply_binary_op":
             # 2. block summary: per-group sum of this shard
@@ -172,7 +183,7 @@ def _build_scan_program(scan: Scan, *, size, axis_name):
         reverse = scan.name == "bfill"
         is_float = jnp.issubdtype(arr_sh.dtype, jnp.floating)
         valid_f = generic_kernel(
-            "nanlen", codes_sh, arr_sh, size=size
+            "nanlen", codes_sh, arr_sh, size=size, nat=nat
         )  # per-group valid counts this shard
         last_val = generic_kernel(
             "nanlast" if not reverse else "nanfirst",
@@ -180,6 +191,7 @@ def _build_scan_program(scan: Scan, *, size, axis_name):
             arr_sh,
             size=size,
             fill_value=jnp.nan if is_float else 0,
+            nat=nat,
         )
         g_vals = jax.lax.all_gather(last_val, axis_name)  # (ndev, ..., size)
         g_valid = jax.lax.all_gather(valid_f > 0, axis_name)
@@ -206,7 +218,10 @@ def _build_scan_program(scan: Scan, *, size, axis_name):
 
         carry_e = gather_groups(carry)
         has_e = gather_groups(has_carry.astype(jnp.int8)) > 0
-        still = jnp.isnan(local) if jnp.issubdtype(local.dtype, jnp.floating) else jnp.zeros(local.shape, bool)
+        from ..kernels import _nan_mask
+
+        mask = _nan_mask(local, nat)  # None when nothing can be missing
+        still = ~mask if mask is not None else jnp.zeros(local.shape, bool)
         return jnp.where(still & has_e & (codes_sh >= 0), carry_e, local)
 
     return program

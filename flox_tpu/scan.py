@@ -115,9 +115,39 @@ def groupby_scan(
 
     scan = _initialize_scan(func)
 
-    # dtype promotion for accumulating scans (parity: scan.py:272-283)
+    # datetime64/timedelta64: scan on the exact int64 view with NaT as the
+    # missing sentinel (float64 round-trips lose ns precision; parity with
+    # the reference, whose numpy kernels handle NaT natively)
+    from . import dtypes as dtps
+
     arr_dtype = np.dtype(arr.dtype) if not array_is_jax else np.dtype(str(arr.dtype))
-    if scan.name in ("cumsum", "nancumsum") and dtype is None:
+    datetime_dtype = arr_dtype if dtps.is_datetime_like(arr_dtype) else None
+    if datetime_dtype is not None:
+        if scan.name in ("cumsum", "nancumsum") and arr_dtype.kind == "M":
+            raise TypeError(
+                "cumsum of datetime64 values is undefined (numpy cannot add "
+                "points in time); cumsum timedelta64 works."
+            )
+        if dtype is not None:
+            # a float dtype would silently drop sub-float64 ns on the int64
+            # round-trip — the exactness this path exists to provide
+            raise TypeError(
+                "dtype= is not supported for datetime/timedelta scans; the "
+                "scan runs on the exact int64 view and returns "
+                f"{arr_dtype} unchanged."
+            )
+        arr_flat = np.asarray(arr_flat).view("int64")
+        if engine == "jax" and mesh is None and method != "blelloch" and not utils.x64_enabled():
+            logger.debug("datetime scan with x64 disabled: using numpy engine")
+            engine = "numpy"
+        if (mesh is not None or method == "blelloch") and not utils.x64_enabled():
+            raise ValueError(
+                "datetime/timedelta scans on the mesh need jax_enable_x64 "
+                "(int64 NaT sentinels do not survive int32 truncation)."
+            )
+
+    # dtype promotion for accumulating scans (parity: scan.py:272-283)
+    if scan.name in ("cumsum", "nancumsum") and dtype is None and datetime_dtype is None:
         if arr_dtype.kind in "iub":
             dtype = np.result_type(arr_dtype, np.int_)
     if method is None and mesh is not None:
@@ -139,6 +169,7 @@ def groupby_scan(
         method = "blockwise" if (preferred == "blockwise" and bndim == 1) else "blelloch"
         logger.debug("groupby_scan: auto-selected method=%s", method)
 
+    nat = datetime_dtype is not None
     if mesh is not None or method == "blelloch":
         # sharded scan over the mesh (parallel/scan.py); method='blelloch'
         # without a mesh means "distribute over the default mesh"
@@ -146,16 +177,20 @@ def groupby_scan(
 
         out = sharded_groupby_scan(
             arr_flat, codes_flat, scan, size=size, dtype=dtype, mesh=mesh,
-            method=method or "blelloch",
+            method=method or "blelloch", nat=nat,
         )
     else:
-        out = _apply_scan(scan, arr_flat, codes_flat, size=size, engine=engine, dtype=dtype)
+        out = _apply_scan(
+            scan, arr_flat, codes_flat, size=size, engine=engine, dtype=dtype, nat=nat
+        )
 
-    # missing labels scan to NaN (they belong to no group)
+    # missing labels scan to NaN (NaT for datetimes — they belong to no group)
     if (np.asarray(codes_flat) < 0).any():
         nanmask = codes_flat < 0
-        out = _mask_positions(out, nanmask)
+        out = _mask_positions(out, nanmask, nat=nat)
 
+    if datetime_dtype is not None:
+        out = np.asarray(out).astype("int64").view(datetime_dtype)
     out = out.reshape(arr.shape) if out.shape != arr.shape else out
     out = out.reshape(lead_shape + bys[0].shape)
     # undo the axis transpose
@@ -165,9 +200,10 @@ def groupby_scan(
     return out
 
 
-def _apply_scan(scan: Scan, arr_flat, codes_flat, *, size, engine, dtype):
+def _apply_scan(scan: Scan, arr_flat, codes_flat, *, size, engine, dtype, nat=False):
     from .aggregations import generic_aggregate
 
+    kwargs = {"nat": True} if nat else {}
     return generic_aggregate(
         codes_flat,
         arr_flat,
@@ -175,10 +211,19 @@ def _apply_scan(scan: Scan, arr_flat, codes_flat, *, size, engine, dtype):
         func=scan.scan,
         size=size,
         dtype=dtype,
+        **kwargs,
     )
 
 
-def _mask_positions(out, nanmask):
+def _mask_positions(out, nanmask, nat=False):
+    if nat:
+        # int64-viewed datetimes: the missing marker is NaT, dtype unchanged
+        nat_val = np.iinfo(np.int64).min
+        if utils.is_jax_array(out):
+            import jax.numpy as jnp
+
+            return jnp.where(jnp.asarray(nanmask), nat_val, out)
+        return np.where(nanmask, nat_val, np.asarray(out))
     if utils.is_jax_array(out):
         import jax.numpy as jnp
 

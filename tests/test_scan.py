@@ -144,3 +144,75 @@ class TestScanMethodSelection:
         labels = np.arange(n) % 5  # every group spans every shard
         with pytest.raises(ValueError, match="spans shards"):
             groupby_scan(vals, labels, func="cumsum", method="blockwise", mesh=self._mesh())
+
+
+class TestDatetimeScans:
+    """datetime64/timedelta64 scans on the exact int64 view (the reference's
+    numpy kernels handle NaT natively; float64 would lose ns precision)."""
+
+    T = np.array(
+        ["2001-01-01T00:00:00.000000001", "NaT", "2001-01-03", "NaT", "NaT", "2001-01-06"],
+        dtype="datetime64[ns]",
+    )
+    LABELS = np.array([0, 0, 1, 0, 1, 1])
+
+    def test_ffill_datetime(self, engine):
+        out = groupby_scan(self.T, self.LABELS, func="ffill", engine=engine)
+        expected = self.T.copy()
+        expected[1] = self.T[0]  # group 0: carries the ns-exact first stamp
+        expected[3] = self.T[0]
+        expected[4] = self.T[2]  # group 1
+        assert out.dtype == self.T.dtype
+        np.testing.assert_array_equal(out, expected)
+
+    def test_bfill_datetime(self, engine):
+        out = groupby_scan(self.T, self.LABELS, func="bfill", engine=engine)
+        expected = self.T.copy()
+        expected[1] = self.T[3]  # NaT: group 0 has nothing after -> stays NaT
+        expected[4] = self.T[5]
+        np.testing.assert_array_equal(out, expected)
+
+    def test_ffill_datetime_on_mesh(self):
+        from flox_tpu.parallel import make_mesh
+
+        t = np.tile(self.T, 8)
+        labels = np.tile(self.LABELS, 8)
+        eager = groupby_scan(t, labels, func="ffill")
+        mesh_r = groupby_scan(t, labels, func="ffill", mesh=make_mesh(8))
+        np.testing.assert_array_equal(np.asarray(mesh_r), np.asarray(eager))
+
+    def test_cumsum_timedelta(self, engine):
+        td = np.array([1, 2, 4, 8], dtype="timedelta64[ns]")
+        labels = np.array([0, 1, 0, 1])
+        out = groupby_scan(td, labels, func="cumsum", engine=engine)
+        np.testing.assert_array_equal(
+            out, np.array([1, 2, 5, 10], dtype="timedelta64[ns]")
+        )
+
+    def test_cumsum_timedelta_nat_propagates(self, engine):
+        td = np.array([1, 2, "NaT", 8, 16], dtype="timedelta64[ns]")
+        labels = np.array([0, 1, 0, 0, 1])
+        out = groupby_scan(td, labels, func="cumsum", engine=engine)
+        expected = np.array([1, 2, "NaT", "NaT", 18], dtype="timedelta64[ns]")
+        np.testing.assert_array_equal(out, expected)
+        out_skip = groupby_scan(td, labels, func="nancumsum", engine=engine)
+        np.testing.assert_array_equal(
+            out_skip, np.array([1, 2, 1, 9, 18], dtype="timedelta64[ns]")
+        )
+
+    def test_cumsum_datetime_rejected(self):
+        with pytest.raises(TypeError, match="cumsum of datetime64"):
+            groupby_scan(self.T, self.LABELS, func="cumsum")
+
+    def test_dtype_kwarg_rejected(self):
+        # a float dtype would silently lose sub-float64 nanoseconds
+        td = np.array([1, 2], dtype="timedelta64[ns]")
+        with pytest.raises(TypeError, match="dtype= is not supported"):
+            groupby_scan(td, np.array([0, 0]), func="nancumsum", dtype=np.float64)
+
+    def test_nan_label_yields_nat(self, engine):
+        labels = np.array([0.0, np.nan, 0.0])
+        t = self.T[:3]
+        out = groupby_scan(t, labels, func="ffill", engine=engine)
+        assert np.isnat(out[1])
+        np.testing.assert_array_equal(out[[0, 2]], t[[0, 2]])
