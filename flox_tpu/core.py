@@ -247,6 +247,43 @@ def _choose_engine(engine, array, array_is_jax: bool) -> str:
     return OPTIONS["default_engine"]
 
 
+_NON_NUMERIC_FUNCS = ("first", "last", "nanfirst", "nanlast", "count")
+
+
+def _reduce_non_numeric(arr, bys, func: str, *, fill_value, **passthrough):
+    """first/last/count on string/object arrays (reference: its numpy
+    engines take any dtype, tests/strategies.py unicode data).
+
+    Non-numeric values cannot live on device, but their *positions* can:
+    reduce a float64 global-position proxy through the normal machinery
+    (so every engine/method/mesh works unchanged), then gather host-side.
+    Positions are exact to 2**53 elements with x64, 2**24 without (the jax
+    engine computes in f32 then) — the caller guards the latter.
+    """
+    import pandas as pd
+
+    valid = ~pd.isna(arr)
+    if func == "count":
+        proxy = np.where(valid, 1.0, np.nan)
+        return groupby_reduce(proxy, *bys, func="count", fill_value=fill_value, **passthrough)
+
+    pos = np.arange(arr.size, dtype=np.float64).reshape(arr.shape)
+    skipna = func.startswith("nan")
+    proxy = np.where(valid, pos, np.nan) if skipna else pos
+    minmax = "nanmin" if "first" in func else "nanmax"
+    posr, *groups = groupby_reduce(proxy, *bys, func=minmax, **passthrough)
+    posr = np.asarray(posr)
+    empty = ~np.isfinite(posr)
+    idx = np.where(empty, 0, posr).astype(np.int64)
+    out = arr.reshape(-1)[idx]
+    if empty.any():
+        fill = fill_value  # None is a fine missing marker for objects
+        if out.dtype.kind in "SU":
+            out = out.astype(object)
+        out[empty] = fill
+    return (out, *groups)
+
+
 def groupby_reduce(
     array,
     *by,
@@ -342,6 +379,37 @@ def groupby_reduce(
     engine = _choose_engine(engine, array, array_is_jax)
     arr = array if array_is_jax else np.asarray(array)
     _assert_by_is_aligned(arr.shape, bys)
+
+    if not array_is_jax and arr.dtype.kind in "OSU":
+        if not isinstance(func, str) or func not in _NON_NUMERIC_FUNCS:
+            raise TypeError(
+                f"non-numeric data (dtype {arr.dtype}) supports only "
+                f"{_NON_NUMERIC_FUNCS}; got {func!r}"
+            )
+        if dtype is not None:
+            raise TypeError("dtype= is not supported for non-numeric reductions")
+        if finalize_kwargs:
+            # rejected, not dropped (same stance as the sparse path)
+            raise NotImplementedError(
+                "finalize_kwargs are not supported for non-numeric reductions"
+            )
+        if not utils.x64_enabled() and arr.size >= 2**24:
+            # f32 positions are exact only to 2**24; beyond that the gather
+            # silently returns wrong elements
+            if mesh is not None or method is not None:
+                raise ValueError(
+                    f"non-numeric reductions of {arr.size} elements on the "
+                    "mesh need jax_enable_x64 (positions exceed f32's exact "
+                    "integer range)."
+                )
+            logger.debug("non-numeric proxy with x64 disabled: numpy engine")
+            engine = "numpy"
+        return _reduce_non_numeric(
+            arr, bys, func, fill_value=fill_value,
+            expected_groups=expected_groups, sort=sort, isbin=isbin, axis=axis,
+            min_count=min_count, method=method, engine=engine,
+            mesh=mesh, axis_name=axis_name,
+        )
 
     expected = _normalize_expected(expected_groups, nby)
     isbin_t = _normalize_isbin(isbin, nby)

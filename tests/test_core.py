@@ -588,3 +588,67 @@ def test_datetime_sum_nat_propagates(engine):
     assert result[0] == np.timedelta64(3000, "ns") and np.isnat(result[1])
     result, _ = groupby_reduce(td, labels, func="nansum", engine=engine)
     assert result[1] == np.timedelta64(3000, "ns")
+
+
+class TestNonNumericData:
+    """first/last/count on string/object arrays via the position-proxy path
+    (reference: its numpy engines accept any dtype; strategies.py unicode)."""
+
+    S = np.array(["a", "bb", "ccc", "dd", None, "e"], dtype=object)
+    LABELS = np.array([0, 1, 0, 1, 2, 2])
+
+    @pytest.mark.parametrize(
+        "func,expected",
+        [
+            ("first", ["a", "bb", None]),
+            ("last", ["ccc", "dd", "e"]),
+            ("nanfirst", ["a", "bb", "e"]),
+            ("nanlast", ["ccc", "dd", "e"]),
+            ("count", [2, 2, 1]),
+        ],
+    )
+    def test_object_reductions(self, func, expected):
+        result, groups = groupby_reduce(self.S, self.LABELS, func=func)
+        assert list(np.asarray(result)) == expected
+        np.testing.assert_array_equal(groups, [0, 1, 2])
+
+    def test_unicode_with_empty_group(self):
+        s = np.array(["x", "y", "z", "w"])
+        labels = np.array([0, 0, 2, 2])
+        result, _ = groupby_reduce(
+            s, labels, func="last", expected_groups=np.array([0, 1, 2])
+        )
+        assert list(result) == ["y", None, "w"]
+
+    def test_on_mesh(self):
+        from flox_tpu.parallel import make_mesh
+
+        s = np.tile(np.array(["x", "y", "z", "w"]), 4)
+        labels = np.tile(np.array([0, 0, 2, 2]), 4)
+        result, _ = groupby_reduce(
+            s, labels, func="first", method="map-reduce", mesh=make_mesh(8)
+        )
+        assert list(result) == ["x", "z"]
+
+    def test_2d_strings(self):
+        s = np.array([["a", "b", "c"], ["d", "e", "f"]], dtype=object)
+        labels = np.array([0, 1, 0])
+        result, _ = groupby_reduce(s, labels, func="last")
+        assert np.asarray(result).tolist() == [["c", "b"], ["f", "e"]]
+
+    def test_unsupported_func_raises(self):
+        with pytest.raises(TypeError, match="non-numeric data"):
+            groupby_reduce(self.S, self.LABELS, func="sum")
+
+    def test_count_honors_fill_value(self):
+        s = np.array(["x", "y"], dtype=object)
+        labels = np.array([0, 0])
+        result, _ = groupby_reduce(
+            s, labels, func="count", fill_value=-1,
+            expected_groups=np.array([0, 1]),
+        )
+        assert list(np.asarray(result)) == [2, -1]
+
+    def test_finalize_kwargs_rejected(self):
+        with pytest.raises(NotImplementedError, match="finalize_kwargs"):
+            groupby_reduce(self.S, self.LABELS, func="count", finalize_kwargs={"q": 0.5})
