@@ -131,12 +131,18 @@ def main() -> None:
     month = ((day % 365) // 30.44).astype(np.int32) % 12
     size = 12
 
-    rng = np.random.default_rng(0)
-    data = rng.normal(size=(nlat, nlon, ntime)).astype(np.float32)
-    nbytes = data.nbytes
+    nbytes = nlat * nlon * ntime * 4
 
-    # --- TPU/jax path: data + codes pre-placed on device -------------------
-    dev_data = jax.device_put(data.reshape(nlat * nlon, ntime))
+    # --- TPU/jax path: generate the workload directly on device ------------
+    # Shipping ~7 GB through the axon tunnel takes longer than the entire
+    # measurement and is not part of the metric; synthesize the same
+    # distribution on device instead.
+    import jax.numpy as jnp
+
+    dev_data = jax.jit(
+        lambda k: jax.random.normal(k, (nlat * nlon, ntime), jnp.float32)
+    )(jax.random.PRNGKey(0))
+    dev_data.block_until_ready()
     dev_codes = jax.device_put(month)
 
     # Timing must NOT trust block_until_ready: through the axon tunnel it
@@ -145,15 +151,22 @@ def main() -> None:
     # host fetch of the (tiny) result, and difference against a 1-iteration
     # chain so the constant fetch/dispatch overhead cancels:
     #   t_iter = (t_K - t_1) / (K - 1)
-    # The inter-iteration dependence is a scalar broadcast folded into the
-    # reduction's input read, so per-iteration HBM traffic stays ~one pass
-    # over the data.
+    # The inter-iteration dependence rides the (tiny) codes array — a
+    # data-sized `v + f(out)` temp would double the HBM footprint and OOM
+    # the full workload — so per-iteration HBM traffic stays ~one pass over
+    # the same data buffer. XLA cannot fold the zero (out may be NaN/inf)
+    # nor CSE the iterations (each sees a distinct codes value).
     def chain(iters):
         @jax.jit
         def run(c, v):
+            import jax.numpy as jnp
+
             out = generic_kernel("nanmean", c, v, size=size)
             for _ in range(iters - 1):
-                out = generic_kernel("nanmean", c, v + out[..., :1] * 0.0, size=size)
+                # nan_to_num: an empty group's NaN mean must not reach the
+                # int cast (NaN->int is implementation-defined garbage)
+                c2 = c + jnp.nan_to_num(out.ravel()[:1] * 0.0).astype(c.dtype)
+                out = generic_kernel("nanmean", c2, v, size=size)
             return out
 
         return run
@@ -235,7 +248,8 @@ def main() -> None:
     def npg_equivalent_nanmean(codes, values, size):
         ncols = values.shape[0]
         flat_codes = (
-            np.broadcast_to(codes, values.shape) + (np.arange(ncols)[:, None] * size)
+            np.broadcast_to(codes, values.shape)
+            + (np.arange(ncols, dtype=np.int32)[:, None] * size)
         ).reshape(-1)
         v = values.reshape(-1)
         nanmask = np.isnan(v)
@@ -245,11 +259,16 @@ def main() -> None:
         with np.errstate(invalid="ignore"):
             return (sums / cnts).reshape(ncols, size)
 
-    host_data = data.reshape(nlat * nlon, ntime)
+    # bincount throughput is size-invariant well before this point; a bounded
+    # row subset (~512 MB) keeps the single-core baseline measurement (and
+    # its flat-codes temporary) from dominating the benchmark's wall-clock.
+    host_rows = min(nlat * nlon, max(1, int(512e6) // (ntime * 4)))
+    rng = np.random.default_rng(0)
+    host_data = rng.normal(size=(host_rows, ntime)).astype(np.float32)
     t0 = time.perf_counter()
     npg_equivalent_nanmean(month, host_data, size)
     t_host = time.perf_counter() - t0
-    gbps_host = nbytes / t_host / 1e9
+    gbps_host = host_data.nbytes / t_host / 1e9
 
     backend = jax.default_backend()
     print(

@@ -260,8 +260,6 @@ def _reduce_non_numeric(arr, bys, func: str, *, fill_value, **passthrough):
     Positions are exact to 2**53 elements with x64, 2**24 without (the jax
     engine computes in f32 then) — the caller guards the latter.
     """
-    import pandas as pd
-
     valid = ~pd.isna(arr)
     if func == "count":
         proxy = np.where(valid, 1.0, np.nan)

