@@ -238,63 +238,90 @@ def _seg_matmul_sum(data, codes, size: int, *, skipna: bool = False, return_nan_
 
 _PALLAS_PROBE_RESULT: list = []  # memoized one-time runtime validation
 _PALLAS_COMPILE_PROBE: list = []  # weaker compile-only probe (in-trace calls)
+_PALLAS_MINMAX_PROBE_RESULT: list = []
+_PALLAS_MINMAX_COMPILE_PROBE: list = []
 
 
-def _pallas_runtime_ok() -> bool:
-    """One-time probe: compile+run the Pallas kernel on a tiny input on the
-    real backend. The kernel is tested in interpret mode on CPU, but a real
-    TPU lowering can still fail (tiling constraints, toolchain drift) — and
-    the 'auto' policy must never take down a reduction it could have run on
-    the battle-tested paths. Any failure logs once and disables pallas for
-    the process."""
-    if _PALLAS_PROBE_RESULT:
-        return _PALLAS_PROBE_RESULT[0]
+def _probed_ok(final_memo, compile_memo, exec_probe, compile_probe, label) -> bool:
+    """One-time probe: compile+run a Pallas kernel on a tiny input on the
+    real backend. The kernels are tested in interpret mode on CPU, but a
+    real TPU lowering can still fail (tiling constraints, toolchain drift) —
+    and the 'auto' policy must never take down a reduction it could have run
+    on the battle-tested paths. Any failure logs once and disables the
+    kernel for the process.
+
+    The first resolution may happen while an outer jit is tracing (the
+    policy is consulted at trace time). Under an ambient trace the executing
+    probe's arrays become tracers and np.asarray raises — which would be
+    mis-recorded as "unavailable" — so in-trace calls probe by
+    lowering+compiling against abstract shapes instead. That weaker verdict
+    is memoized separately and NOT promoted to the final result: the next
+    clean call still runs the full execute-and-check probe."""
+    if final_memo:
+        return final_memo[0]
+    import logging
+
+    log = logging.getLogger("flox_tpu")
     try:
-        # The first resolution may happen while an outer jit is tracing (the
-        # policy is consulted at trace time). Under an ambient trace the
-        # executing probe's arrays become tracers and np.asarray raises —
-        # which the except below would mis-record as "pallas unavailable" —
-        # so in-trace calls probe by lowering+compiling against abstract
-        # shapes instead (catches Mosaic/tiling/toolchain failures without
-        # executing). That weaker verdict is memoized separately and NOT
-        # promoted to the final result: the next clean call still runs the
-        # full execute-and-check probe.
         from jax._src import core as _jcore  # jax.core stopped re-exporting it
 
         clean = getattr(_jcore, "trace_state_clean", lambda: True)()
-        if not clean:
-            if not _PALLAS_COMPILE_PROBE:
-                from .pallas_kernels import probe_compile
+    except ImportError:
+        # private API drift must degrade to the fallback paths, never crash
+        # the reduction; without the trace-state signal assume the worst
+        # (tracing) and take the compile-only leg below.
+        clean = False
+    if not clean:
+        if not compile_memo:
+            try:
+                compile_probe()
+                compile_memo.append(True)
+            except Exception as exc:  # noqa: BLE001
+                log.warning(
+                    "pallas %s failed to compile on this backend (%s); "
+                    "falling back to the XLA paths", label, exc,
+                )
+                compile_memo.append(False)
+        return compile_memo[0]
+    try:
+        ok = bool(exec_probe())
+    except Exception as exc:  # noqa: BLE001 — any lowering failure disables it
+        log.warning(
+            "pallas %s unavailable on this backend (%s); "
+            "falling back to the XLA paths", label, exc,
+        )
+        ok = False
+    final_memo.append(ok)
+    return ok
 
-                try:
-                    probe_compile()
-                    _PALLAS_COMPILE_PROBE.append(True)
-                except Exception as exc:  # noqa: BLE001
-                    import logging
 
-                    logging.getLogger("flox_tpu").warning(
-                        "pallas segment-sum failed to compile on this backend "
-                        "(%s); falling back to the XLA paths", exc,
-                    )
-                    _PALLAS_COMPILE_PROBE.append(False)
-            return _PALLAS_COMPILE_PROBE[0]
+def _pallas_runtime_ok() -> bool:
+    from .pallas_kernels import probe_compile, segment_sum_pallas
 
-        from .pallas_kernels import segment_sum_pallas
-
+    def _exec():
         probe = segment_sum_pallas(
             jnp.ones((8, 128), jnp.float32), jnp.zeros(8, jnp.int32), 2
         )
-        ok = bool(np.asarray(probe)[0, 0] == 8.0)
-    except Exception as exc:  # noqa: BLE001 — any lowering failure disables it
-        import logging
+        return np.asarray(probe)[0, 0] == 8.0
 
-        logging.getLogger("flox_tpu").warning(
-            "pallas segment-sum unavailable on this backend (%s); "
-            "falling back to the XLA paths", exc,
-        )
-        ok = False
-    _PALLAS_PROBE_RESULT.append(ok)
-    return ok
+    return _probed_ok(
+        _PALLAS_PROBE_RESULT, _PALLAS_COMPILE_PROBE, _exec, probe_compile,
+        "segment-sum",
+    )
+
+
+def _pallas_minmax_runtime_ok() -> bool:
+    from .pallas_kernels import probe_compile_minmax, segment_minmax_pallas
+
+    def _exec():
+        data = jnp.arange(8 * 128, dtype=jnp.float32).reshape(8, 128)
+        probe = segment_minmax_pallas(data, jnp.zeros(8, jnp.int32), 2, "max")
+        return np.asarray(probe)[0, 0] == 7 * 128.0
+
+    return _probed_ok(
+        _PALLAS_MINMAX_PROBE_RESULT, _PALLAS_MINMAX_COMPILE_PROBE, _exec,
+        probe_compile_minmax, "segment-min/max",
+    )
 
 
 def _segment_sum_impl(data, size: int) -> str:
@@ -324,6 +351,32 @@ def _segment_sum_impl(data, size: int) -> str:
     return "scatter"
 
 
+def _segment_minmax_impl(data, size: int) -> str:
+    """Pick the segment-min/max implementation per the policy + constraints.
+
+    Min/max cannot ride the MXU (no (max, ·) semiring), so the choice is
+    scatter vs the VPU select-reduce Pallas kernel, whose cost grows with
+    the group count — hence the ``pallas_minmax_num_groups_max`` gate.
+    """
+    from .options import OPTIONS
+
+    policy = OPTIONS["segment_minmax_impl"]
+    ok = (
+        str(data.dtype) in ("float32", "bfloat16", "int32")
+        and size <= OPTIONS["pallas_minmax_num_groups_max"]
+        and data.shape[0] >= 8
+    )
+    if policy == "scatter" or not ok:
+        return "scatter"
+    on_tpu = _on_tpu()
+    if policy == "pallas":
+        return "pallas" if (not on_tpu or _pallas_minmax_runtime_ok()) else "scatter"
+    # auto: scatter is competitive on CPU; on TPU it serializes on the VPU
+    if on_tpu and _pallas_minmax_runtime_ok():
+        return "pallas"
+    return "scatter"
+
+
 def _seg(op: str, data, codes, size: int):
     """Segment-reduce ``data`` (N, ...) by ``codes`` (N,) into (size, ...).
 
@@ -336,6 +389,10 @@ def _seg(op: str, data, codes, size: int):
     Additive ops on sub-f32 floats accumulate — and return — f32 (see
     ``_acc_dtype``); callers that want the input dtype back cast at the end.
     """
+    if op in ("max", "min") and _segment_minmax_impl(data, size) == "pallas":
+        from .pallas_kernels import segment_minmax_pallas
+
+        return segment_minmax_pallas(data, codes, size, op, interpret=not _on_tpu())
     if op == "sum":
         impl = _segment_sum_impl(data, size)
         if impl == "pallas":

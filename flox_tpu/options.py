@@ -35,6 +35,14 @@ OPTIONS: dict[str, Any] = {
     # the whole stacking (256 MB default: big enough to keep the MXU fed,
     # small next to HBM)
     "matmul_block_bytes": 2**28,
+    # segment-min/max implementation: "auto" on TPU uses the Pallas VPU
+    # select-reduce kernel (after runtime validation) instead of scatter,
+    # which serializes; off-TPU auto is scatter. Explicit override as above.
+    "segment_minmax_impl": "auto",
+    # the min/max kernel's VPU work grows linearly with the group count
+    # (one select+reduce pass per group per tile); past this many groups the
+    # kernel is no longer clearly ahead of scatter
+    "pallas_minmax_num_groups_max": 128,
 }
 
 _VALIDATORS = {
@@ -46,6 +54,8 @@ _VALIDATORS = {
     "pallas_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
     "pallas_compensated": lambda x: isinstance(x, bool),
     "matmul_block_bytes": lambda x: isinstance(x, int) and x >= 2**20,
+    "segment_minmax_impl": lambda x: x in ("auto", "scatter", "pallas"),
+    "pallas_minmax_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
 }
 
 
@@ -61,6 +71,8 @@ def trace_fingerprint() -> tuple:
         OPTIONS["pallas_num_groups_max"],
         OPTIONS["pallas_compensated"],
         OPTIONS["matmul_block_bytes"],
+        OPTIONS["segment_minmax_impl"],
+        OPTIONS["pallas_minmax_num_groups_max"],
     )
 
 

@@ -37,7 +37,7 @@ import functools
 
 import numpy as np
 
-__all__ = ["segment_sum_pallas", "pallas_available"]
+__all__ = ["segment_sum_pallas", "segment_minmax_pallas", "pallas_available"]
 
 
 def pallas_available() -> bool:
@@ -156,6 +156,126 @@ def _build(
     return jax.jit(fn)
 
 
+def _tiles(n: int, k: int, size: int):
+    """Shared tiling: lane-axis tiles are multiples of 128 (n for the data
+    blocks, k for the output blocks), sublane rows multiples of 8."""
+    n_tile = 512 if n >= 512 else max(128, -(-n // 128) * 128)
+    k_tile = 512 if k >= 512 else max(128, -(-k // 128) * 128)
+    n_pad = -(-n // n_tile) * n_tile
+    k_pad = -(-k // k_tile) * k_tile
+    size_p = max(8, ((size + 7) // 8) * 8)
+    return n_tile, k_tile, n_pad, k_pad, size_p
+
+
+def _minmax_identity(op: str, dtype):
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        return float("-inf") if op == "max" else float("inf")
+    info = np.iinfo(np.dtype(str(dtype)))
+    return info.min if op == "max" else info.max
+
+
+def _minmax_kernel(codes_ref, data_ref, out_ref, *, size, size_p, op):
+    """Per-tile grouped min/max on the VPU: one select + lane-reduce per
+    group (MXU cannot do the (max, ·) tropical semiring). VPU work scales
+    with ``size``, which is why the policy gates on a group-count cap —
+    below it the kernel stays HBM-bound where scatter serializes."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    ident = jnp.asarray(_minmax_identity(op, out_ref.dtype), out_ref.dtype)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.full_like(out_ref, ident)
+
+    codes = codes_ref[0, :]  # (n_tile,)
+    data = data_ref[:]  # (k_tile, n_tile)
+    combine = jnp.maximum if op == "max" else jnp.minimum
+    reduce_ = jnp.max if op == "max" else jnp.min
+
+    rows = []
+    for g in range(size):  # static unroll (size is gated small)
+        # edge-block garbage lanes carry the sentinel code -> identity
+        masked = jnp.where((codes == g)[None, :], data, ident)
+        rows.append(reduce_(masked, axis=1))  # (k_tile,)
+    tile_red = jnp.stack(rows)  # (size, k_tile)
+    if size_p > size:
+        tile_red = jnp.concatenate(
+            [tile_red, jnp.full((size_p - size, data.shape[0]), ident, out_ref.dtype)]
+        )
+    out_ref[:] = combine(out_ref[:], tile_red)
+
+
+@functools.lru_cache(maxsize=128)
+def _build_minmax(
+    k_pad: int, n_pad: int, size: int, size_p: int, dtype_str: str, n_tile: int,
+    k_tile: int, interpret: bool, op: str,
+):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    kern = functools.partial(_minmax_kernel, size=size, size_p=size_p, op=op)
+    fn = pl.pallas_call(
+        kern,
+        grid=(k_pad // k_tile, n_pad // n_tile),
+        in_specs=[
+            pl.BlockSpec((1, n_tile), lambda i, j: (0, j)),  # codes
+            pl.BlockSpec((k_tile, n_tile), lambda i, j: (i, j)),  # data (K, N)
+        ],
+        out_specs=pl.BlockSpec((size_p, k_tile), lambda i, j: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((size_p, k_pad), jnp.dtype(dtype_str)),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def segment_minmax_pallas(data, codes, size: int, op: str, *, interpret: bool = False):
+    """Segment-min/max ``data`` (N, K...) by ``codes`` (N,) -> (size, K...).
+
+    Missing labels drop out; empty groups return the op's identity (the
+    caller's ``_fill_empty`` handles presentation, exactly as for scatter).
+    Callers pre-map NaN/NaT to absorbing elements (kernels._make_minmax), so
+    no NaN ever reaches this kernel. Same in-place (K, N) consumption as
+    ``segment_sum_pallas``.
+    """
+    import jax.numpy as jnp
+
+    data = jnp.asarray(data)
+    orig_shape = data.shape
+    n = data.shape[0]
+    flat = data.reshape(n, -1)
+    k = flat.shape[1]
+    flat_t = flat.T  # (K, N) — cancels the caller's moveaxis; no copy
+
+    n_tile, k_tile, n_pad, k_pad, size_p = _tiles(n, k, size)
+
+    codes = jnp.asarray(codes).astype(jnp.int32).reshape(-1)
+    codes = jnp.where((codes < 0) | (codes >= size), size_p, codes)
+    codes_p = jnp.pad(codes, (0, n_pad - n), constant_values=size_p).reshape(1, n_pad)
+
+    fn = _build_minmax(
+        k_pad, n_pad, size, size_p, str(flat.dtype), n_tile, k_tile, interpret, op
+    )
+    out = fn(codes_p, flat_t)
+    return out[:size, :k].reshape((size,) + orig_shape[1:])
+
+
+def probe_compile_minmax() -> None:
+    """Compile-only probe for the min/max kernel (see probe_compile)."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = _build_minmax(128, 128, 2, 8, "float32", 128, 128, False, "max")
+    fn.lower(
+        jax.ShapeDtypeStruct((1, 128), jnp.int32),
+        jax.ShapeDtypeStruct((128, 128), jnp.float32),
+    ).compile()
+
+
 def probe_compile() -> None:
     """Lower + compile a tiny instance of the kernel on the real backend
     WITHOUT executing it — safe to call while an outer jit is tracing
@@ -205,12 +325,7 @@ def segment_sum_pallas(
     k = flat.shape[1]
     flat_t = flat.T  # (K, N) — cancels the caller's moveaxis; no copy
 
-    # n_tile is the lane axis of the codes/data blocks (multiple of 128);
-    # k_tile is the lane axis of the output blocks (multiple of 128).
-    n_tile = 512 if n >= 512 else max(128, -(-n // 128) * 128)
-    k_tile = 512 if k >= 512 else max(128, -(-k // 128) * 128)
-    n_pad = -(-n // n_tile) * n_tile
-    size_p = max(8, ((size + 7) // 8) * 8)
+    n_tile, k_tile, n_pad, k_pad, size_p = _tiles(n, k, size)
 
     codes = jnp.asarray(codes).astype(jnp.int32).reshape(-1)
     # out-of-range codes (missing labels, padding) match no one-hot column
@@ -219,9 +334,8 @@ def segment_sum_pallas(
 
     from .kernels import _acc_dtype
 
-    k_pad = -(-k // k_tile) * k_tile  # cache key: the program depends only
-    # on the tile grid, not the exact trailing size (that enters via the
-    # final [:k] slice below)
+    # cache key uses k_pad: the program depends only on the tile grid, not
+    # the exact trailing size (that enters via the final [:k] slice below)
     fn = _build(
         k_pad, n_pad, size_p, str(flat.dtype), str(jnp.dtype(_acc_dtype(flat.dtype))),
         n_tile, k_tile, interpret, bool(compensated),

@@ -48,13 +48,17 @@ _RESPONSIVE = None
 
 def pytest_collection_modifyitems(config, items):
     global _RESPONSIVE
-    if not items:
+    # this hook sees the whole session's items; only gate OUR directory, or
+    # a combined `pytest tests tests_tpu` run would skip the CPU suite too
+    here = os.path.dirname(os.path.abspath(__file__))
+    ours = [i for i in items if str(getattr(i, "path", "")).startswith(here)]
+    if not ours:
         return
     if _RESPONSIVE is None:
         _RESPONSIVE = _accelerator_responsive()
     if not _RESPONSIVE:
         marker = pytest.mark.skip(reason="no responsive accelerator (TPU tunnel down)")
-        for item in items:
+        for item in ours:
             item.add_marker(marker)
 
 
