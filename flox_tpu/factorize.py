@@ -57,6 +57,13 @@ def factorize_single(
         codes, groups = pd.factorize(flat.reshape(-1), sort=sort)
         return codes.astype(np.int64, copy=False), pd.Index(groups)
 
+    # sort=True factorizes against the SORTED expected index — the groups
+    # axis of the result is ordered, whatever order the user supplied
+    # (parity: core.py:616-637 sort_values + test_core.py:1465-1508).
+    # IntervalIndex binning requires monotonic edges anyway.
+    if sort and not expect.is_monotonic_increasing:
+        expect = expect.sort_values()
+
     flat = flat.reshape(-1)
     if isinstance(expect, pd.RangeIndex) and expect.start == 0 and expect.step == 1:
         # Labels are already integer codes. Copy (the reference found a

@@ -72,6 +72,18 @@ def _safe_codes(group_idx, size: int):
     return jnp.where(codes < 0, size, codes)
 
 
+def minmax_identity(op: str, dtype):
+    """Identity element of grouped min/max for ``dtype``: -inf (floats) /
+    iinfo.min (ints) for max, +inf / iinfo.max for min. The ABSORBING
+    element — what NaN/NaT maps to so it wins the reduction — is the
+    opposite op's identity. Single source of truth for the scatter and
+    Pallas paths and the argreductions."""
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        return float("-inf") if op == "max" else float("inf")
+    info = np.iinfo(np.dtype(str(dtype)))
+    return info.min if op == "max" else info.max
+
+
 def _acc_dtype(dt):
     """Accumulation dtype for additive segment reductions.
 
@@ -266,10 +278,10 @@ def _probed_ok(final_memo, compile_memo, exec_probe, compile_probe, label) -> bo
         from jax._src import core as _jcore  # jax.core stopped re-exporting it
 
         clean = getattr(_jcore, "trace_state_clean", lambda: True)()
-    except ImportError:
-        # private API drift must degrade to the fallback paths, never crash
-        # the reduction; without the trace-state signal assume the worst
-        # (tracing) and take the compile-only leg below.
+    except Exception:  # noqa: BLE001
+        # private API drift (removal OR behavior change) must degrade to the
+        # fallback paths, never crash the reduction; without the trace-state
+        # signal assume the worst (tracing) and take the compile-only leg.
         clean = False
     if not clean:
         if not compile_memo:
@@ -531,23 +543,20 @@ def _make_minmax(op: str, skipna: bool):
         mask = _nan_mask(data, nat)
         isint = not jnp.issubdtype(data.dtype, jnp.floating)
         if skipna and mask is not None:
-            if isint:
-                info = np.iinfo(np.dtype(str(data.dtype)))
-                ident = jnp.asarray(info.min if op == "max" else info.max, dtype=data.dtype)
-            else:
-                ident = jnp.asarray(-jnp.inf if op == "max" else jnp.inf, dtype=data.dtype)
+            ident = jnp.asarray(minmax_identity(op, data.dtype), dtype=data.dtype)
             data = jnp.where(mask, data, ident)
         elif not skipna and mask is not None:
             # NaN/NaT propagates through min/max in numpy; segment_min/max on
             # TPU would otherwise drop it. Force-propagate by mapping the
-            # missing marker to the absorbing element.
-            if isint:
-                info = np.iinfo(np.dtype(str(data.dtype)))
-                absorb = jnp.asarray(info.max if op == "max" else info.min, dtype=data.dtype)
-                missing_marker = jnp.asarray(_NAT_INT, dtype=data.dtype)
-            else:
-                absorb = jnp.asarray(jnp.inf if op == "max" else -jnp.inf, dtype=data.dtype)
-                missing_marker = jnp.asarray(jnp.nan, dtype=data.dtype)
+            # missing marker to the absorbing element (the opposite op's
+            # identity).
+            absorb = jnp.asarray(
+                minmax_identity("min" if op == "max" else "max", data.dtype),
+                dtype=data.dtype,
+            )
+            missing_marker = jnp.asarray(
+                _NAT_INT if isint else jnp.nan, dtype=data.dtype
+            )
             has_nan = _seg("max", (~mask).astype(jnp.int8), codes, size) > 0
             data = jnp.where(mask, data, absorb)
             out = _seg(op, data, codes, size)
@@ -817,24 +826,19 @@ def _arg_impl(group_idx, array, *, size, fill_value, skipna, arg_of_max, nat=Fal
     mask = _nan_mask(data, nat)
     key = data
     if mask is not None:
-        isint = not jnp.issubdtype(data.dtype, jnp.floating)
+        op = "max" if arg_of_max else "min"
         if skipna:
-            if isint:
-                info = np.iinfo(np.dtype(str(data.dtype)))
-                ident = jnp.asarray(info.min if arg_of_max else info.max, dtype=data.dtype)
-            else:
-                ident = jnp.asarray(-jnp.inf if arg_of_max else jnp.inf, dtype=data.dtype)
+            ident = jnp.asarray(minmax_identity(op, data.dtype), dtype=data.dtype)
             key = jnp.where(mask, data, ident)
         else:
             # NaN propagates: map NaN to the absorbing element so a NaN-bearing
             # group resolves to a NaN position. (Known divergence from numpy:
             # if a group contains both inf and NaN, the earlier of the two wins
             # the tie rather than strictly the first NaN.)
-            if isint:
-                info = np.iinfo(np.dtype(str(data.dtype)))
-                absorb = jnp.asarray(info.max if arg_of_max else info.min, dtype=data.dtype)
-            else:
-                absorb = jnp.asarray(jnp.inf if arg_of_max else -jnp.inf, dtype=data.dtype)
+            absorb = jnp.asarray(
+                minmax_identity("min" if arg_of_max else "max", data.dtype),
+                dtype=data.dtype,
+            )
             key = jnp.where(mask, data, absorb)
     best = _seg("max" if arg_of_max else "min", key, codes, size)
     best_per_elem = jnp.take(

@@ -168,12 +168,9 @@ def _tiles(n: int, k: int, size: int):
 
 
 def _minmax_identity(op: str, dtype):
-    import jax.numpy as jnp
+    from .kernels import minmax_identity  # single source of truth
 
-    if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
-        return float("-inf") if op == "max" else float("inf")
-    info = np.iinfo(np.dtype(str(dtype)))
-    return info.min if op == "max" else info.max
+    return minmax_identity(op, dtype)
 
 
 def _minmax_kernel(codes_ref, data_ref, out_ref, *, size, size_p, op):

@@ -423,6 +423,21 @@ class TestPallasMinMax:
         a, b = self._both("max", codes, values, 4)
         np.testing.assert_array_equal(a, b)
 
+    @pytest.mark.parametrize("func", ["max", "nanmin"])
+    def test_bfloat16(self, func):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(15)
+        codes = rng.integers(0, 4, 150)
+        values = jnp.asarray(rng.normal(size=(2, 150)).astype(np.float32)).astype(
+            jnp.bfloat16
+        )
+        if func == "nanmin":
+            values = values.at[..., ::7].set(jnp.nan)
+        a, b = self._both(func, codes, values, 4)
+        assert a.dtype == np.asarray(values).dtype
+        np.testing.assert_array_equal(a, b)
+
     def test_ragged_direct_vs_oracle(self):
         # non-divisible shapes through the raw kernel against a numpy loop
         from flox_tpu.pallas_kernels import segment_minmax_pallas
