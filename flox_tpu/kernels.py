@@ -322,6 +322,50 @@ def _pallas_runtime_ok() -> bool:
     )
 
 
+_PALLAS_SCAN_PROBE_RESULT: list = []
+_PALLAS_SCAN_COMPILE_PROBE: list = []
+
+
+def _pallas_scan_runtime_ok() -> bool:
+    from .pallas_kernels import probe_compile_scan, segment_cumsum_pallas
+
+    def _exec():
+        data = jnp.ones((16, 128), jnp.float32)
+        probe = segment_cumsum_pallas(
+            data, jnp.zeros(16, jnp.int32), 2, skipna=False
+        )
+        return np.asarray(probe)[15, 0] == 16.0
+
+    return _probed_ok(
+        _PALLAS_SCAN_PROBE_RESULT, _PALLAS_SCAN_COMPILE_PROBE, _exec,
+        probe_compile_scan, "grouped-scan",
+    )
+
+
+def _scan_impl_choice(data, size) -> str:
+    """Pick the grouped-cumsum lowering: the sort+log-depth segmented scan
+    vs the Pallas triangular-matmul kernel (one HBM pass)."""
+    from .options import OPTIONS
+
+    policy = OPTIONS["scan_impl"]
+    ok = (
+        isinstance(size, int)
+        and str(data.dtype) in ("float32", "bfloat16")
+        and size + 1 <= OPTIONS["pallas_scan_num_groups_max"]
+        and data.shape[0] >= 8
+    )
+    if policy == "segmented" or not ok:
+        return "segmented"
+    on_tpu = _on_tpu()
+    if policy == "pallas":
+        return "pallas" if (not on_tpu or _pallas_scan_runtime_ok()) else "segmented"
+    # auto: interpret-mode pallas is slow on CPU; on TPU the sort-based path
+    # pays an argsort plus a log-depth scan through HBM
+    if on_tpu and _pallas_scan_runtime_ok():
+        return "pallas"
+    return "segmented"
+
+
 def _pallas_minmax_runtime_ok() -> bool:
     from .pallas_kernels import probe_compile_minmax, segment_minmax_pallas
 
@@ -1136,6 +1180,17 @@ def _grouped_scan_setup(group_idx, array):
 
 
 def _cumsum_impl(group_idx, array, *, size, dtype, skipna, nat=False):
+    if not nat:
+        data = _to_leading(array)
+        cast = _maybe_cast(data, dtype)
+        if _scan_impl_choice(cast, size) == "pallas":
+            from .pallas_kernels import segment_cumsum_pallas
+
+            codes = jnp.asarray(group_idx).astype(jnp.int32).reshape(-1)
+            out = segment_cumsum_pallas(
+                cast, codes, size, skipna=skipna, interpret=not _on_tpu()
+            )
+            return _from_leading(out)
     _, sorted_data, flags, inv = _grouped_scan_setup(group_idx, array)
     # nat: int64-viewed datetimes/timedeltas, missing = INT64_MIN. Unlike
     # floats (where NaN propagates through + arithmetically), the sentinel

@@ -43,6 +43,13 @@ OPTIONS: dict[str, Any] = {
     # (one select+reduce pass per group per tile); past this many groups the
     # kernel is no longer clearly ahead of scatter
     "pallas_minmax_num_groups_max": 128,
+    # grouped cumulative scans: "auto" on TPU uses the Pallas triangular-
+    # matmul kernel (one HBM pass) instead of the sort + log-depth
+    # segmented scan; off-TPU auto stays on the segmented path.
+    "scan_impl": "auto",
+    # the scan kernel's carry gather/update matmuls scale with the group
+    # count; past ~the lane-tile width they dominate the triangular matmul
+    "pallas_scan_num_groups_max": 128,
 }
 
 _VALIDATORS = {
@@ -56,6 +63,8 @@ _VALIDATORS = {
     "matmul_block_bytes": lambda x: isinstance(x, int) and x >= 2**20,
     "segment_minmax_impl": lambda x: x in ("auto", "scatter", "pallas"),
     "pallas_minmax_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
+    "scan_impl": lambda x: x in ("auto", "segmented", "pallas"),
+    "pallas_scan_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
 }
 
 
@@ -73,6 +82,8 @@ def trace_fingerprint() -> tuple:
         OPTIONS["matmul_block_bytes"],
         OPTIONS["segment_minmax_impl"],
         OPTIONS["pallas_minmax_num_groups_max"],
+        OPTIONS["scan_impl"],
+        OPTIONS["pallas_scan_num_groups_max"],
     )
 
 

@@ -261,6 +261,179 @@ def segment_minmax_pallas(data, codes, size: int, op: str, *, interpret: bool = 
     return out[:size, :k].reshape((size,) + orig_shape[1:])
 
 
+def _scan_kernel(
+    codes_ref, data_ref, out_ref, carry_ref, ncarry_ref, *, size_p, n_tile, skipna,
+):
+    """Grouped cumulative sum, one HBM pass.
+
+    Per tile the grouped prefix is ONE matmul on the MXU:
+    ``out = x @ T`` with ``T[l, m] = [l <= m] · [code_l == code_m]`` — the
+    triangular-masked group-equality matrix, built in VMEM from the codes
+    lane vector (data-independent, shared by every k row). Cross-tile state
+    is a per-group running-sum block revisited along the n grid axis, read
+    into each lane by a one-hot gather matmul and updated by a one-hot
+    contraction — so the cost is independent of the group count (the
+    sort-based XLA path this replaces pays an argsort plus a log-depth
+    scan, each materialized through HBM).
+
+    NaN handling: values are zero-filled before the matmuls (a NaN would
+    poison other groups through the masked zeros); for the non-skipna scan,
+    IEEE "NaN poisons everything after it in its group" is re-applied from
+    a 0/1 seen-NaN prefix computed with the same T (DEFAULT precision —
+    exact on 0/1) and a seen-NaN carry row. The skipna variant (nancumsum)
+    simply keeps the zero-fill.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        carry_ref[:] = jnp.zeros_like(carry_ref)
+        if not skipna:
+            ncarry_ref[:] = jnp.zeros_like(ncarry_ref)
+
+    codes = codes_ref[0, :]  # (n_tile,) — sentinel ``size`` for missing,
+    # ``size_p`` for padding (no one-hot column, no T-equality with real lanes)
+    data = data_ref[:]  # (k_tile, n_tile)
+    acc = carry_ref.dtype
+    x = data.astype(acc)
+    isnan = jnp.isnan(x)
+    x = jnp.where(isnan, jnp.zeros((), acc), x)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (n_tile, n_tile), 0)
+    lane_t = jax.lax.broadcasted_iota(jnp.int32, (n_tile, n_tile), 1)
+    tri_eq = ((codes[:, None] == codes[None, :]) & (lane <= lane_t)).astype(acc)
+    onehot = (
+        codes[:, None] == jax.lax.broadcasted_iota(jnp.int32, (n_tile, size_p), 1)
+    ).astype(acc)  # (n_tile, size_p)
+
+    hi = jax.lax.Precision.HIGHEST
+
+    def mm(a, b, dims, prec):
+        return jax.lax.dot_general(
+            a, b, dimension_numbers=(dims, ((), ())),
+            preferred_element_type=acc, precision=prec,
+        )
+
+    # in-tile grouped prefix + carried-in per-group offset per lane
+    prefix = mm(x, tri_eq, ((1,), (0,)), hi)  # (k_tile, n_tile)
+    carried = mm(carry_ref[:], onehot, ((0,), (1,)), hi)  # (k_tile, n_tile)
+    out = prefix + carried
+    # new running totals: old carry + this tile's per-group sums
+    carry_ref[:] = carry_ref[:] + mm(onehot, x, ((0,), (1,)), hi)
+
+    if not skipna:
+        # 0/1 masks are exact at single-pass precision
+        d = jax.lax.Precision.DEFAULT
+        has_nan = jnp.any(isnan)
+        # NaNs seen by this lane's group in earlier tiles (read BEFORE update)
+        carried_n = mm(ncarry_ref[:], onehot, ((0,), (1,)), d)  # (k_tile, n_tile)
+
+        @pl.when(has_nan)
+        def _poison_new():
+            nanf = isnan.astype(acc)
+            # ...plus NaNs at or before this lane within the tile
+            seen = mm(nanf, tri_eq, ((1,), (0,)), d)
+            ncarry_ref[:] = ncarry_ref[:] + mm(onehot, nanf, ((0,), (1,)), d)
+            out_ref[:] = jnp.where(
+                (seen + carried_n) > 0,
+                jnp.asarray(jnp.nan, out_ref.dtype),
+                out.astype(out_ref.dtype),
+            )
+
+        @pl.when(~has_nan)
+        def _poison_old():
+            out_ref[:] = jnp.where(
+                carried_n > 0, jnp.asarray(jnp.nan, out_ref.dtype),
+                out.astype(out_ref.dtype),
+            )
+    else:
+        out_ref[:] = out.astype(out_ref.dtype)
+
+
+@functools.lru_cache(maxsize=128)
+def _build_scan(
+    k: int, n: int, n_pad: int, size_p: int, dtype_str: str, acc_str: str,
+    n_tile: int, k_tile: int, interpret: bool, skipna: bool,
+):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    kern = functools.partial(
+        _scan_kernel, size_p=size_p, n_tile=n_tile, skipna=skipna
+    )
+    k_tiles = -(-k // k_tile)
+    grid = (k_tiles, n_pad // n_tile)
+    acc = jnp.dtype(acc_str)
+    fn = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, n_tile), lambda i, j: (0, j)),  # codes
+            pl.BlockSpec((k_tile, n_tile), lambda i, j: (i, j)),  # data (K, N)
+        ],
+        out_specs=[
+            pl.BlockSpec((k_tile, n_tile), lambda i, j: (i, j)),  # out (K, N)
+            pl.BlockSpec((size_p, k_tile), lambda i, j: (0, i)),  # carry
+            pl.BlockSpec((size_p, k_tile), lambda i, j: (0, i)),  # nan carry
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, n), jnp.dtype(dtype_str)),
+            jax.ShapeDtypeStruct((size_p, k_tiles * k_tile), acc),
+            jax.ShapeDtypeStruct((size_p, k_tiles * k_tile), acc),
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def segment_cumsum_pallas(data, codes, size: int, *, skipna: bool, interpret: bool = False):
+    """Grouped cumulative sum of ``data`` (N, K...) by ``codes`` (N,), same
+    shape out. Missing labels (code outside [0, size)) scan among themselves
+    as one extra group — matching the sort-based kernel. f32/bf16; bf16
+    accumulates in f32 and is cast back per element."""
+    import jax.numpy as jnp
+
+    data = jnp.asarray(data)
+    orig_shape = data.shape
+    n = data.shape[0]
+    flat = data.reshape(n, -1)
+    k = flat.shape[1]
+    flat_t = flat.T  # (K, N) — cancels the caller's moveaxis; no copy
+
+    # one extra carry row for the missing-label group (sentinel == size)
+    n_tile, k_tile, n_pad, _k_pad, size_p = _tiles(n, k, size + 1)
+
+    codes = jnp.asarray(codes).astype(jnp.int32).reshape(-1)
+    codes = jnp.where((codes < 0) | (codes >= size), size, codes)
+    codes_p = jnp.pad(codes, (0, n_pad - n), constant_values=size_p).reshape(1, n_pad)
+
+    from .kernels import _acc_dtype
+
+    fn = _build_scan(
+        k, n, n_pad, size_p, str(flat.dtype), str(jnp.dtype(_acc_dtype(flat.dtype))),
+        n_tile, k_tile, interpret, bool(skipna),
+    )
+    out, _carry, _ncarry = fn(codes_p, flat_t)
+    return out.T.reshape(orig_shape)
+
+
+def probe_compile_scan() -> None:
+    """Compile-only probe for the scan kernel (see probe_compile)."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = _build_scan(128, 128, 128, 8, "float32", "float32", 128, 128, False, False)
+    fn.lower(
+        jax.ShapeDtypeStruct((1, 128), jnp.int32),
+        jax.ShapeDtypeStruct((128, 128), jnp.float32),
+    ).compile()
+
+
 def probe_compile_minmax() -> None:
     """Compile-only probe for the min/max kernel (see probe_compile)."""
     import jax

@@ -469,6 +469,81 @@ class TestPallasMinMax:
             np.testing.assert_allclose(out[g], values[codes == g].max(), rtol=1e-6)
 
 
+class TestPallasScan:
+    """Pallas triangular-matmul grouped cumsum (interpret mode) vs the
+    sort-based segmented path and per-group numpy loops."""
+
+    def _oracle(self, func, values, codes):
+        out = np.empty_like(values, dtype=np.float64)
+        for g in np.unique(codes):
+            m = codes == g
+            grp = values[..., m].astype(np.float64)
+            out[..., m] = np.cumsum(np.nan_to_num(grp, nan=0.0), -1) if func == "nancumsum" else np.cumsum(grp, -1)
+        return out
+
+    @pytest.mark.parametrize("func", ["cumsum", "nancumsum"])
+    @pytest.mark.parametrize("shape", [(257,), (3, 300)])
+    def test_vs_oracle_and_segmented(self, func, shape):
+        import flox_tpu
+
+        rng = np.random.default_rng(21)
+        n = shape[-1]
+        codes = rng.integers(0, 5, n)
+        codes[rng.random(n) < 0.1] = -1  # missing labels scan among themselves
+        values = rng.normal(size=shape).astype(np.float32)
+        values[rng.random(shape) < 0.15] = np.nan
+        with flox_tpu.set_options(scan_impl="pallas"):
+            a = np.asarray(kernels.generic_kernel(func, codes, values, size=5))
+        with flox_tpu.set_options(scan_impl="segmented"):
+            b = np.asarray(kernels.generic_kernel(func, codes, values, size=5))
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6, equal_nan=True)
+        ok = codes >= 0
+        want = self._oracle(func, values, codes)
+        np.testing.assert_allclose(a[..., ok], want[..., ok], rtol=1e-5, atol=1e-5, equal_nan=True)
+
+    def test_nan_poisons_rest_of_group_across_tiles(self):
+        # non-skipna: a NaN early in a group must poison every later element
+        # of that group (including across the 128-lane tile boundary), and
+        # ONLY that group
+        from flox_tpu.pallas_kernels import segment_cumsum_pallas
+
+        n = 400
+        codes = (np.arange(n) % 3).astype(np.int32)
+        values = np.ones(n, dtype=np.float32)
+        values[30] = np.nan  # group 0, first tile
+        got = np.asarray(segment_cumsum_pallas(values, codes, 3, skipna=False, interpret=True))
+        g0 = np.flatnonzero(codes == 0)
+        before = g0[g0 < 30]
+        after = g0[g0 >= 30]
+        assert np.isfinite(got[before]).all()
+        assert np.isnan(got[after]).all()
+        others = codes != 0
+        assert np.isfinite(got[others]).all()
+
+    def test_bf16_accumulates_f32(self):
+        import jax.numpy as jnp
+
+        from flox_tpu.pallas_kernels import segment_cumsum_pallas
+
+        n = 2000
+        vals = jnp.ones(n, jnp.bfloat16)
+        codes = np.zeros(n, dtype=np.int32)
+        got = np.asarray(segment_cumsum_pallas(vals, codes, 1, skipna=False, interpret=True).astype(jnp.float32))
+        # a bf16 running sum would saturate at 256; f32 accumulation keeps
+        # counting (each element individually rounds to its bf16 neighbour)
+        assert got[-1] > 1900
+
+    def test_group_cap_falls_back(self):
+        import flox_tpu
+
+        rng = np.random.default_rng(22)
+        codes = rng.integers(0, 5, 64)
+        values = rng.normal(size=64).astype(np.float32)
+        with flox_tpu.set_options(scan_impl="pallas", pallas_scan_num_groups_max=3):
+            out = np.asarray(kernels.generic_kernel("cumsum", codes, values, size=5))
+        np.testing.assert_allclose(out, self._oracle("cumsum", values, codes), rtol=1e-5, atol=1e-6)
+
+
 def test_pallas_kahan_accuracy():
     # compensated f32 accumulation lands within one output-ulp of the f64
     # oracle; plain accumulation drifts by multiple ulps
