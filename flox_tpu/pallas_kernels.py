@@ -262,7 +262,8 @@ def segment_minmax_pallas(data, codes, size: int, op: str, *, interpret: bool = 
 
 
 def _scan_kernel(
-    codes_ref, data_ref, out_ref, carry_ref, ncarry_ref, *, size_p, n_tile, skipna,
+    codes_ref, data_ref, out_ref, carry_ref, *marker_refs,
+    size_p, n_tile, skipna,
 ):
     """Grouped cumulative sum, one HBM pass.
 
@@ -276,16 +277,38 @@ def _scan_kernel(
     sort-based XLA path this replaces pays an argsort plus a log-depth
     scan, each materialized through HBM).
 
-    NaN handling: values are zero-filled before the matmuls (a NaN would
-    poison other groups through the masked zeros); for the non-skipna scan,
-    IEEE "NaN poisons everything after it in its group" is re-applied from
-    a 0/1 seen-NaN prefix computed with the same T (DEFAULT precision —
-    exact on 0/1) and a seen-NaN carry row. The skipna variant (nancumsum)
-    simply keeps the zero-fill.
+    Nonfinite handling: ALL nonfinite values (NaN and ±inf) are zero-filled
+    before the matmuls — any of them would otherwise poison other groups
+    through the masked zeros (inf × 0 = NaN), and undefined edge-block
+    garbage with an inf bit pattern would corrupt real outputs. IEEE
+    prefix semantics are re-applied from 0/1 seen-marker prefixes computed
+    with the same T (DEFAULT precision — exact on 0/1) plus per-group
+    marker carry rows: a lane is NaN if its group's prefix saw a NaN
+    (non-skipna only) or both +inf and -inf; else ±inf if it saw that
+    inf; else the finite sum. The skipna variant (nancumsum) skips only
+    the NaN poisoning — inf still propagates, as in ``np.nancumsum`` —
+    and carries no NaN-marker row at all. A running group sum that
+    OVERFLOWS is folded into the markers and the carry entry reset to 0,
+    so the overflowing group reports ±inf from then on while the finite
+    carry keeps the gather matmul poison-free.
+
+    Known boundary: overflow detection reflects the MXU contraction's
+    reduction order, not the sequential order. Mixed-sign values within a
+    tile-width factor of float32 max can make a partial sum overflow where
+    the true sequential prefix stays finite (or vice versa) — inherent to
+    every reordered summation (pairwise included), not specific to this
+    kernel. Data living at that scale belongs on the segmented XLA path
+    (``scan_impl="segmented"``) or the x64 CPU engine.
     """
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
+
+    if skipna:
+        pcarry_ref, mcarry_ref = marker_refs
+        ncarry_ref = None
+    else:
+        ncarry_ref, pcarry_ref, mcarry_ref = marker_refs
 
     j = pl.program_id(1)
 
@@ -294,6 +317,8 @@ def _scan_kernel(
         carry_ref[:] = jnp.zeros_like(carry_ref)
         if not skipna:
             ncarry_ref[:] = jnp.zeros_like(ncarry_ref)
+        pcarry_ref[:] = jnp.zeros_like(pcarry_ref)
+        mcarry_ref[:] = jnp.zeros_like(mcarry_ref)
 
     codes = codes_ref[0, :]  # (n_tile,) — sentinel ``size`` for missing,
     # ``size_p`` for padding (no one-hot column, no T-equality with real lanes)
@@ -301,7 +326,10 @@ def _scan_kernel(
     acc = carry_ref.dtype
     x = data.astype(acc)
     isnan = jnp.isnan(x)
-    x = jnp.where(isnan, jnp.zeros((), acc), x)
+    ispos = jnp.isposinf(x)
+    isneg = jnp.isneginf(x)
+    nonfinite = isnan | ispos | isneg
+    x = jnp.where(nonfinite, jnp.zeros((), acc), x)
 
     lane = jax.lax.broadcasted_iota(jnp.int32, (n_tile, n_tile), 0)
     lane_t = jax.lax.broadcasted_iota(jnp.int32, (n_tile, n_tile), 1)
@@ -311,6 +339,8 @@ def _scan_kernel(
     ).astype(acc)  # (n_tile, size_p)
 
     hi = jax.lax.Precision.HIGHEST
+    # 0/1 marker masks are exact at single-pass precision
+    d = jax.lax.Precision.DEFAULT
 
     def mm(a, b, dims, prec):
         return jax.lax.dot_general(
@@ -322,36 +352,130 @@ def _scan_kernel(
     prefix = mm(x, tri_eq, ((1,), (0,)), hi)  # (k_tile, n_tile)
     carried = mm(carry_ref[:], onehot, ((0,), (1,)), hi)  # (k_tile, n_tile)
     out = prefix + carried
-    # new running totals: old carry + this tile's per-group sums
-    carry_ref[:] = carry_ref[:] + mm(onehot, x, ((0,), (1,)), hi)
 
+    def carried_marks():
+        # markers seen by this lane's group in EARLIER tiles — gathered
+        # before any update below, so this tile's own lanes are untouched
+        # by its own value-infs (those enter via tri_eq prefixes below)
+        cn = None
+        if not skipna:
+            cn = mm(ncarry_ref[:], onehot, ((0,), (1,)), d)  # (k_tile, n_tile)
+        cp = mm(pcarry_ref[:], onehot, ((0,), (1,)), d)
+        cm = mm(mcarry_ref[:], onehot, ((0,), (1,)), d)
+        return cn, cp, cm
+
+    def seen_in_tile():
+        # value markers at-or-before each lane, plus the group-carry updates
+        carried_n, carried_p, carried_m = carried_marks()
+        posf = ispos.astype(acc)
+        negf = isneg.astype(acc)
+        sp = mm(posf, tri_eq, ((1,), (0,)), d) + carried_p
+        sm = mm(negf, tri_eq, ((1,), (0,)), d) + carried_m
+        pcarry_ref[:] = pcarry_ref[:] + mm(onehot, posf, ((0,), (1,)), d)
+        mcarry_ref[:] = mcarry_ref[:] + mm(onehot, negf, ((0,), (1,)), d)
+        if skipna:
+            return None, sp, sm
+        nanf = isnan.astype(acc)
+        sn = mm(nanf, tri_eq, ((1,), (0,)), d) + carried_n
+        ncarry_ref[:] = ncarry_ref[:] + mm(onehot, nanf, ((0,), (1,)), d)
+        return sn, sp, sm
+
+    def finish(seen_n, seen_p, seen_m, with_ovf):
+        # IEEE prefix semantics per lane: NaN beats inf; +inf and -inf
+        # together make NaN; a lone inf sign wins over any finite sum.
+        if with_ovf:
+            # Arithmetic OVERFLOW of the zero-filled running sum shows up as
+            # ±inf in `out`. An event is genuine only if no value marker has
+            # reached its lane (after one, the zero-filled arithmetic is
+            # meaningless: a true ±inf running sum absorbs finite addends
+            # and cannot re-overflow) AND no opposite-sign overflow happened
+            # earlier in the tile (first sign wins, same absorb principle —
+            # the cross-tile analogue is `nonfin` in _fold_overflow).
+            # Genuine events feed the group markers so later tiles see them,
+            # and stick to later in-tile lanes via tri_eq.
+            seen_any = seen_p + seen_m
+            if seen_n is not None:
+                seen_any = seen_any + seen_n
+            o_p_raw = ((seen_any == 0) & jnp.isposinf(out)).astype(acc)
+            o_m_raw = ((seen_any == 0) & jnp.isneginf(out)).astype(acc)
+            s_p_raw = mm(o_p_raw, tri_eq, ((1,), (0,)), d)
+            s_m_raw = mm(o_m_raw, tri_eq, ((1,), (0,)), d)
+            o_p = o_p_raw * (s_m_raw == 0).astype(acc)
+            o_m = o_m_raw * (s_p_raw == 0).astype(acc)
+            pcarry_ref[:] = pcarry_ref[:] + mm(onehot, o_p, ((0,), (1,)), d)
+            mcarry_ref[:] = mcarry_ref[:] + mm(onehot, o_m, ((0,), (1,)), d)
+            seen_p = seen_p + mm(o_p, tri_eq, ((1,), (0,)), d)
+            seen_m = seen_m + mm(o_m, tri_eq, ((1,), (0,)), d)
+        nan_mask = (seen_p > 0) & (seen_m > 0)
+        if seen_n is not None:
+            nan_mask = nan_mask | (seen_n > 0)
+        res = jnp.where(seen_p > 0, jnp.asarray(jnp.inf, acc), out)
+        res = jnp.where(seen_m > 0, jnp.asarray(-jnp.inf, acc), res)
+        res = jnp.where(nan_mask, jnp.asarray(jnp.nan, acc), res)
+        out_ref[:] = res.astype(out_ref.dtype)
+
+    # Flattened branch matrix (no nested conds — keeps the Mosaic control
+    # flow at the shape already proven on hardware). The common clean tile
+    # (no nonfinite values, no overflow, no marker ever recorded — checked
+    # by a cheap VPU any-reduce over the tiny carry blocks) writes the sums
+    # directly and pays zero marker matmuls.
+    has_nf = jnp.any(nonfinite)
+    has_oinf = jnp.any(jnp.isposinf(out) | jnp.isneginf(out))
+    has_marks = jnp.any(pcarry_ref[:] > 0) | jnp.any(mcarry_ref[:] > 0)
     if not skipna:
-        # 0/1 masks are exact at single-pass precision
-        d = jax.lax.Precision.DEFAULT
-        has_nan = jnp.any(isnan)
-        # NaNs seen by this lane's group in earlier tiles (read BEFORE update)
-        carried_n = mm(ncarry_ref[:], onehot, ((0,), (1,)), d)  # (k_tile, n_tile)
+        has_marks = has_marks | jnp.any(ncarry_ref[:] > 0)
 
-        @pl.when(has_nan)
-        def _poison_new():
-            nanf = isnan.astype(acc)
-            # ...plus NaNs at or before this lane within the tile
-            seen = mm(nanf, tri_eq, ((1,), (0,)), d)
-            ncarry_ref[:] = ncarry_ref[:] + mm(onehot, nanf, ((0,), (1,)), d)
-            out_ref[:] = jnp.where(
-                (seen + carried_n) > 0,
-                jnp.asarray(jnp.nan, out_ref.dtype),
-                out.astype(out_ref.dtype),
-            )
-
-        @pl.when(~has_nan)
-        def _poison_old():
-            out_ref[:] = jnp.where(
-                carried_n > 0, jnp.asarray(jnp.nan, out_ref.dtype),
-                out.astype(out_ref.dtype),
-            )
-    else:
+    @pl.when(~has_nf & ~has_oinf & ~has_marks)
+    def _clean():
         out_ref[:] = out.astype(out_ref.dtype)
+
+    @pl.when(~has_nf & ~has_oinf & has_marks)
+    def _marked():
+        finish(*carried_marks(), False)
+
+    @pl.when(~has_nf & has_oinf)
+    def _ovf_only():
+        finish(*carried_marks(), True)
+
+    @pl.when(has_nf & ~has_oinf)
+    def _vals_only():
+        finish(*seen_in_tile(), False)
+
+    @pl.when(has_nf & has_oinf)
+    def _vals_ovf():
+        finish(*seen_in_tile(), True)
+
+    # New running totals: old carry + this tile's per-group sums. Both
+    # addends are finite, but the sum can OVERFLOW — to ±inf, or even to
+    # NaN when the matmul's tree reduction forms opposite-sign inf partials
+    # from mixed-sign large values. Any nonfinite carry entry would poison
+    # every group on the next tile's gather (nonfinite × one-hot 0 = NaN).
+    # Keep the carry finite; backstop-record the event as a marker for
+    # groups with no nonfinite state yet (an overflow after any marker —
+    # including a reset-carry re-overflow — is an artifact: the group's
+    # true state is already ±inf/NaN and absorbs finite addends).
+    new_carry = carry_ref[:] + mm(onehot, x, ((0,), (1,)), hi)
+    raw_p = jnp.isposinf(new_carry)
+    raw_m = jnp.isneginf(new_carry)
+    raw_nonfin = ~jnp.isfinite(new_carry)
+    raw_nan = raw_nonfin & ~raw_p & ~raw_m
+
+    @pl.when(jnp.any(raw_nonfin))
+    def _fold_overflow():
+        nonfin = (pcarry_ref[:] > 0) | (mcarry_ref[:] > 0)
+        if not skipna:
+            nonfin = nonfin | (ncarry_ref[:] > 0)
+        pcarry_ref[:] = pcarry_ref[:] + (raw_p & ~nonfin).astype(acc)
+        mcarry_ref[:] = mcarry_ref[:] + (raw_m & ~nonfin).astype(acc)
+        if skipna:
+            # no NaN row to record into: a tree-reduction NaN (order-lost
+            # mixed-sign overflow) degrades to NaN via both inf markers
+            pcarry_ref[:] = pcarry_ref[:] + (raw_nan & ~nonfin).astype(acc)
+            mcarry_ref[:] = mcarry_ref[:] + (raw_nan & ~nonfin).astype(acc)
+        else:
+            ncarry_ref[:] = ncarry_ref[:] + (raw_nan & ~nonfin).astype(acc)
+
+    carry_ref[:] = jnp.where(raw_nonfin, jnp.zeros((), acc), new_carry)
 
 
 @functools.lru_cache(maxsize=128)
@@ -378,14 +502,11 @@ def _build_scan(
         ],
         out_specs=[
             pl.BlockSpec((k_tile, n_tile), lambda i, j: (i, j)),  # out (K, N)
-            pl.BlockSpec((size_p, k_tile), lambda i, j: (0, i)),  # carry
-            pl.BlockSpec((size_p, k_tile), lambda i, j: (0, i)),  # nan carry
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((k, n), jnp.dtype(dtype_str)),
-            jax.ShapeDtypeStruct((size_p, k_tiles * k_tile), acc),
-            jax.ShapeDtypeStruct((size_p, k_tiles * k_tile), acc),
-        ],
+        ]
+        # carry + marker carries: ±inf always, NaN only when it can poison
+        + [pl.BlockSpec((size_p, k_tile), lambda i, j: (0, i))] * (3 if skipna else 4),
+        out_shape=[jax.ShapeDtypeStruct((k, n), jnp.dtype(dtype_str))]
+        + [jax.ShapeDtypeStruct((size_p, k_tiles * k_tile), acc)] * (3 if skipna else 4),
         interpret=interpret,
     )
     return jax.jit(fn)
@@ -418,7 +539,7 @@ def segment_cumsum_pallas(data, codes, size: int, *, skipna: bool, interpret: bo
         k, n, n_pad, size_p, str(flat.dtype), str(jnp.dtype(_acc_dtype(flat.dtype))),
         n_tile, k_tile, interpret, bool(skipna),
     )
-    out, _carry, _ncarry = fn(codes_p, flat_t)
+    out, *_carries = fn(codes_p, flat_t)
     return out.T.reshape(orig_shape)
 
 

@@ -520,6 +520,95 @@ class TestPallasScan:
         others = codes != 0
         assert np.isfinite(got[others]).all()
 
+    @pytest.mark.parametrize("func", ["cumsum", "nancumsum"])
+    def test_inf_semantics(self, func):
+        # r2 advisor (high): ±inf used to survive the zero-fill and poison
+        # every group through inf×0=NaN in the masked matmuls. One inf must
+        # stay inside its own group and follow IEEE prefix semantics —
+        # including across tile boundaries through the marker carries.
+        from flox_tpu.pallas_kernels import segment_cumsum_pallas
+
+        n = 1200  # 3 tiles of 512 — markers must ride the carry rows
+        codes = (np.arange(n) % 3).astype(np.int32)
+        values = np.ones(n, dtype=np.float32)
+        values[30] = np.inf     # group 0: +inf from here on...
+        values[900] = -np.inf   # ...then +inf + -inf = NaN (tile 2)
+        values[61] = -np.inf    # group 1: -inf from here on
+        values[50] = np.nan     # group 2: NaN poisons (cumsum only)
+        got = np.asarray(
+            segment_cumsum_pallas(values, codes, 3, skipna=(func == "nancumsum"), interpret=True)
+        )
+        f = np.nancumsum if func == "nancumsum" else np.cumsum
+        want = np.empty(n, np.float64)
+        for g in range(3):
+            m = codes == g
+            want[m] = f(values[m].astype(np.float64))
+        np.testing.assert_allclose(got, want, rtol=1e-6, equal_nan=True)
+
+    def test_carry_overflow_does_not_poison_other_groups(self):
+        # an all-finite running sum that overflows f32 in the carry must
+        # report +inf for ITS group's later lanes only — not NaN everywhere
+        # through inf×0 in the one-hot gather
+        from flox_tpu.pallas_kernels import segment_cumsum_pallas
+
+        n = 1100
+        codes = (np.arange(n) % 2).astype(np.int32)
+        values = np.ones(n, dtype=np.float32)
+        values[codes == 0] = 3e38  # group 0 overflows within the first tile
+        got = np.asarray(segment_cumsum_pallas(values, codes, 2, skipna=False, interpret=True))
+        g1 = got[codes == 1]
+        np.testing.assert_allclose(g1, np.arange(1, len(g1) + 1), rtol=1e-6)
+        g0 = got[codes == 0]
+        assert np.isposinf(g0[-1])  # overflowed group saturates at +inf
+        assert not np.isnan(g0).any()
+
+    def test_opposite_sign_overflow_keeps_first_inf(self):
+        # +overflow, carry reset, then a would-be -overflow of the reset
+        # carry: IEEE keeps +inf (a true +inf running sum absorbs finite
+        # negatives) — must not turn into NaN via both markers
+        from flox_tpu.pallas_kernels import segment_cumsum_pallas
+
+        n = 1100
+        vals = np.full(n, 3e38, np.float32)
+        vals[400:] = -3e38
+        codes = np.zeros(n, dtype=np.int32)
+        got = np.asarray(segment_cumsum_pallas(vals, codes, 1, skipna=False, interpret=True))
+        assert np.isposinf(got[1])  # overflows at the second element
+        assert np.isposinf(got[-1])
+        assert not np.isnan(got).any()
+
+    def test_overflow_then_opposite_inf_value_is_nan(self):
+        # in-tile arithmetic +overflow followed by a -inf VALUE: the true
+        # sequential sum is +inf + (-inf) = NaN from that element on
+        from flox_tpu.pallas_kernels import segment_cumsum_pallas
+
+        vals = np.full(200, 0.0, np.float32)
+        vals[0] = 3e38
+        vals[1] = 3e38
+        vals[5] = -np.inf
+        codes = np.zeros(200, dtype=np.int32)
+        got = np.asarray(segment_cumsum_pallas(vals, codes, 1, skipna=False, interpret=True))
+        assert np.isposinf(got[1]) and np.isposinf(got[4])
+        assert np.isnan(got[5:]).all()
+        # ...and the reverse order stays -inf (a -inf running sum cannot
+        # re-overflow positive)
+        vals2 = np.full(200, 3e38, np.float32)
+        vals2[0] = -np.inf
+        got2 = np.asarray(segment_cumsum_pallas(vals2, codes, 1, skipna=False, interpret=True))
+        assert np.isneginf(got2).all()
+
+    def test_all_finite_tile_after_inf_tile(self):
+        # the carried-marker-only branch (no local nonfinite in the tile)
+        from flox_tpu.pallas_kernels import segment_cumsum_pallas
+
+        n = 1100
+        codes = np.zeros(n, dtype=np.int32)
+        values = np.ones(n, dtype=np.float32)
+        values[3] = np.inf  # tile 0; tiles 1-2 are all finite
+        got = np.asarray(segment_cumsum_pallas(values, codes, 1, skipna=False, interpret=True))
+        assert np.isfinite(got[:3]).all()
+        assert np.isposinf(got[3:]).all()
+
     def test_bf16_accumulates_f32(self):
         import jax.numpy as jnp
 
