@@ -23,23 +23,86 @@ import time
 
 import numpy as np
 
+_REPO = os.path.dirname(os.path.abspath(__file__))
+TPU_LAST_PATH = os.path.join(_REPO, "BENCH_TPU_LAST.json")
+HISTORY_PATH = os.path.join(_REPO, "BENCH_HISTORY", "bench_runs.jsonl")
 
-def _ensure_responsive_backend(timeout_s: float = 90.0) -> bool:
+
+def _load_last_onchip():
+    """Last successful on-chip sweep, or None. The tunnel to the chip flaps;
+    a capture that lands during an outage must still carry the most recent
+    hardware evidence (explicitly timestamped, never passed off as fresh)."""
+    try:
+        with open(TPU_LAST_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _persist_onchip(record: dict) -> None:
+    try:
+        with open(TPU_LAST_PATH, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+    except OSError as exc:  # pragma: no cover - read-only checkout
+        import sys
+
+        print(f"flox-tpu bench: could not persist on-chip record: {exc}",
+              file=sys.stderr, flush=True)
+
+
+def _append_history(line: dict) -> None:
+    try:
+        os.makedirs(os.path.dirname(HISTORY_PATH), exist_ok=True)
+        with open(HISTORY_PATH, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    except OSError as exc:  # pragma: no cover
+        import sys
+
+        print(f"flox-tpu bench: could not append history: {exc}",
+              file=sys.stderr, flush=True)
+
+
+def _probe_once(code: str, timeout_s: float) -> bool:
+    """Run ``code`` in a subprocess with a hard timeout (a wedged TPU
+    runtime blocks forever in C and cannot be interrupted in-process)."""
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        return proc.wait(timeout=timeout_s) == 0
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            # a child wedged in uninterruptible sleep may never reap; don't
+            # let the guard itself hang — orphan it and move on
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        return False
+
+
+def _ensure_responsive_backend(
+    timeout_s: float = 90.0, attempts: int = 3, spacing_s: float = 75.0
+) -> bool:
     """Fall back to CPU if the accelerator runtime hangs at device init.
 
-    The TPU tunnel in this environment can wedge; jax.devices() then blocks
-    forever in C. Probe it in a subprocess with a timeout and force the CPU
-    backend on failure, so the benchmark always produces its JSON line.
-    Probing only happens when an accelerator platform is configured (a CPU
-    run has nothing to probe), and the diagnostic goes to stderr — stdout
-    stays exactly one JSON line.
+    The TPU tunnel in this environment flaps; jax.devices() then blocks
+    forever in C. Probe it in a subprocess with a timeout — and because an
+    outage is often transient, retry with spaced backoff (default: 3
+    attempts over ~6 min) before giving up on the round's hardware
+    evidence. Diagnostics go to stderr — stdout stays one JSON line.
 
     Returns whether the Pallas lowering is safe to use in THIS process: a
     wedged pallas compile cannot be caught in-process (it hangs, not
     raises), so the impl sweep must exclude pallas when the subprocess
     probe failed.
     """
-    import subprocess
     import sys
 
     import jax
@@ -50,59 +113,52 @@ def _ensure_responsive_backend(timeout_s: float = 90.0) -> bool:
     platform = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
     if platform and not any(t in platform for t in ("tpu", "axon")):
         return True  # CPU run: pallas runs in interpret mode, cannot wedge
-    probe_code = (
+    pallas_code = (
         "import jax, jax.numpy as jnp; jax.devices(); "
         "import sys; sys.path.insert(0, %r); "
         "from flox_tpu.pallas_kernels import segment_sum_pallas; "
         "out = segment_sum_pallas(jnp.ones((8, 128), jnp.float32), "
         "jnp.zeros(8, jnp.int32), 2); "
         "assert float(out[0, 0]) == 8.0"
-    ) % os.path.dirname(os.path.abspath(__file__))
-    proc = subprocess.Popen(
-        [sys.executable, "-c", probe_code],
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-    )
-    healthy = False
-    try:
-        healthy = proc.wait(timeout=timeout_s) == 0
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        try:
-            # a child wedged in uninterruptible sleep may never reap; don't
-            # let the guard itself hang — orphan it and move on
-            proc.wait(timeout=5)
-        except subprocess.TimeoutExpired:
-            pass
-    if not healthy:
-        # either the backend is wedged or the pallas lowering misbehaves in a
-        # way an in-process try/except cannot catch; find out which
-        basic = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        )
-        backend_ok = False
-        try:
-            backend_ok = basic.wait(timeout=timeout_s) == 0
-        except subprocess.TimeoutExpired:
-            basic.kill()
-            try:
-                basic.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                pass
-        import jax
+    ) % _REPO
+    basic_code = "import jax; jax.devices()"
 
-        if backend_ok:
-            print("flox-tpu bench: pallas probe failed; using the XLA GEMM path", file=sys.stderr, flush=True)
-            from flox_tpu.options import OPTIONS
+    backend_ok = False
+    pallas_ok = False
+    for attempt in range(attempts):
+        if attempt:
+            print(
+                f"flox-tpu bench: accelerator probe retry {attempt + 1}/"
+                f"{attempts} in {spacing_s:.0f}s", file=sys.stderr, flush=True,
+            )
+            time.sleep(spacing_s)
+        if _probe_once(pallas_code, timeout_s):
+            backend_ok = pallas_ok = True
+            break
+        if _probe_once(basic_code, timeout_s):
+            # backend alive but the pallas probe failed — that could still
+            # be a transient flap mid-compile, not a deterministic lowering
+            # failure; give pallas one more chance before excluding it from
+            # the round's persisted hardware evidence
+            backend_ok = True
+            pallas_ok = _probe_once(pallas_code, timeout_s)
+            break
+    if backend_ok and not pallas_ok:
+        print("flox-tpu bench: pallas probe failed; using the XLA GEMM path",
+              file=sys.stderr, flush=True)
+        from flox_tpu.options import OPTIONS
 
-            OPTIONS["segment_sum_impl"] = "matmul"
-        else:
-            print("flox-tpu bench: accelerator unreachable; benchmarking on CPU", file=sys.stderr, flush=True)
-            jax.config.update("jax_platforms", "cpu")
-        # broken-pallas-on-accelerator is the unsafe case; the CPU fallback
-        # runs pallas in interpret mode, which cannot wedge
-        return not backend_ok
+        OPTIONS["segment_sum_impl"] = "matmul"
+        # broken-pallas-on-accelerator is the unsafe case that cannot be
+        # caught in-process
+        return False
+    if not backend_ok:
+        print("flox-tpu bench: accelerator unreachable after "
+              f"{attempts} spaced probes; benchmarking on CPU",
+              file=sys.stderr, flush=True)
+        jax.config.update("jax_platforms", "cpu")
+        # the CPU fallback runs pallas in interpret mode, which cannot wedge
+        return True
     return True
 
 
@@ -156,35 +212,34 @@ def main() -> None:
     # the full workload — so per-iteration HBM traffic stays ~one pass over
     # the same data buffer. XLA cannot fold the zero (out may be NaN/inf)
     # nor CSE the iterations (each sees a distinct codes value).
-    def chain(iters):
+    def chain(iters, func, **kw):
         @jax.jit
         def run(c, v):
-            import jax.numpy as jnp
-
-            out = generic_kernel("nanmean", c, v, size=size)
+            out = generic_kernel(func, c, v, size=size, **kw)
             for _ in range(iters - 1):
                 # nan_to_num: an empty group's NaN mean must not reach the
                 # int cast (NaN->int is implementation-defined garbage)
                 c2 = c + jnp.nan_to_num(out.ravel()[:1] * 0.0).astype(c.dtype)
-                out = generic_kernel("nanmean", c2, v, size=size)
+                out = generic_kernel(func, c2, v, size=size, **kw)
             return out
 
         return run
 
     chain_k = max(2, int(os.environ.get("FLOX_TPU_BENCH_CHAIN", 8)))
 
-    def best_time(fn):
-        np.asarray(fn(dev_codes, dev_data))  # compile + warm
+    def best_time(fn, data):
+        np.asarray(fn(dev_codes, data))  # compile + warm
         times = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            np.asarray(fn(dev_codes, dev_data))
+            np.asarray(fn(dev_codes, data))
             times.append(time.perf_counter() - t0)
         return min(times)
 
-    def measure_impl():
-        t_1 = best_time(chain(1))
-        t_k = best_time(chain(chain_k))
+    def measure_impl(func="nanmean", data=None, **kw):
+        data = dev_data if data is None else data
+        t_1 = best_time(chain(1, func, **kw), data)
+        t_k = best_time(chain(chain_k, func, **kw), data)
         t = (t_k - t_1) / (chain_k - 1)
         # noise floor: fall back to the single-shot fetch time
         return t_1 if t <= 0 else t
@@ -270,27 +325,62 @@ def main() -> None:
     t_host = time.perf_counter() - t0
     gbps_host = host_data.nbytes / t_host / 1e9
 
+    # --- order statistics on chip (VERDICT r2 #3): grouped quantile -------
+    # The two-key lax.sort path is the open perf question; record its
+    # throughput next to the mean's so the gap is a measured artifact, not
+    # a guess. Bounded rows: the sort materializes ~3 data-sized arrays
+    # (codes/data/iota), so the full ~7 GB workload would not fit HBM.
     backend = jax.default_backend()
-    print(
-        json.dumps(
+    on_accel = backend != "cpu"
+    quantile_gbps = None
+    if on_accel or os.environ.get("FLOX_TPU_BENCH_FORCE_SWEEP"):
+        q_rows = min(nlat * nlon, max(1, int(1.0e9) // (ntime * 4)))
+        try:
+            tq = measure_impl("nanquantile", dev_data[:q_rows], q=0.9)
+            quantile_gbps = round(q_rows * ntime * 4 / tq / 1e9, 2)
+        except Exception as exc:  # noqa: BLE001 — keep the headline alive
+            print(f"flox-tpu bench: quantile measurement failed: {exc}",
+                  file=sys.stderr, flush=True)
+    # one shared field set: the persisted hardware record and the stdout
+    # line must never drift apart about what was measured
+    core = {
+        "metric": "ERA5 groupby(time.month).mean() GB/s/chip",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / gbps_host, 2),
+        "baseline": "single-host bincount nanmean (numpy_groupies equivalent)",
+        "platform": backend,
+        "segment_sum_impl": winner,
+        "impl_sweep_gbps": sweep_gbps,
+        "quantile_gbps": quantile_gbps,
+    }
+    if on_accel:
+        # the round's hardware evidence: persist it so a later capture that
+        # lands during a tunnel outage still carries a timestamped record
+        _persist_onchip(
             {
-                "metric": "ERA5 groupby(time.month).mean() GB/s/chip",
-                "value": round(gbps, 2),
-                "unit": "GB/s",
-                "vs_baseline": round(gbps / gbps_host, 2),
-                "baseline": "single-host bincount nanmean (numpy_groupies equivalent)",
-                "platform": backend,
-                "segment_sum_impl": winner,
-                "impl_sweep_gbps": sweep_gbps,
-                "note": (
-                    "CPU FALLBACK — accelerator unreachable; value is a liveness "
-                    "signal, NOT a TPU measurement"
-                )
-                if backend == "cpu"
-                else "measured on accelerator; winner of the impl sweep",
+                "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                **core,
+                "workload": {"nlat": nlat, "nlon": nlon, "ntime": ntime,
+                             "nbytes": nbytes, "ngroups": size},
             }
         )
-    )
+    line = {
+        **core,
+        "note": (
+            "CPU FALLBACK — accelerator unreachable; value is a liveness "
+            "signal, NOT a TPU measurement (see last_onchip for the most "
+            "recent hardware sweep)"
+        )
+        if not on_accel
+        else "measured on accelerator; winner of the impl sweep",
+    }
+    if not on_accel:
+        last = _load_last_onchip()
+        if last is not None:
+            line["last_onchip"] = last
+    _append_history({"wall_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **line})
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":
