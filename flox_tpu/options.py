@@ -27,9 +27,15 @@ OPTIONS: dict[str, Any] = {
     # group-count ceiling for the Pallas path (VMEM-bounded; independent of
     # the matmul knob so disabling one path does not disable the other)
     "pallas_num_groups_max": 512,
-    # Kahan-compensated accumulation across Pallas tiles (f32 accuracy on
-    # hardware without float64)
-    "pallas_compensated": True,
+    # Cross-tile accumulation discipline for the Pallas segment-sum, on
+    # hardware without float64:
+    #   "plain" — a bare f32 running sum (fastest, drifts over many tiles)
+    #   "kahan" — compensated summation across tiles (default; recovers
+    #             most of the bits a plain running sum loses)
+    #   "dd"    — double-double (2×f32 hi/lo carry) with Dekker-split
+    #             contractions, for strict-parity users chasing the
+    #             float64 oracle (BASELINE "bit-exact float64 means")
+    "pallas_accum": "kahan",
     # per-block budget for the GEMM path's (N, 4*kb) marker stacking; wide-K
     # inputs loop column blocks of this many bytes instead of materializing
     # the whole stacking (256 MB default: big enough to keep the MXU fed,
@@ -59,7 +65,7 @@ _VALIDATORS = {
     "matmul_num_groups_max": lambda x: isinstance(x, int) and x >= 0,
     "segment_sum_impl": lambda x: x in ("auto", "scatter", "matmul", "pallas"),
     "pallas_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
-    "pallas_compensated": lambda x: isinstance(x, bool),
+    "pallas_accum": lambda x: x in ("plain", "kahan", "dd"),
     "matmul_block_bytes": lambda x: isinstance(x, int) and x >= 2**20,
     "segment_minmax_impl": lambda x: x in ("auto", "scatter", "pallas"),
     "pallas_minmax_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
@@ -78,7 +84,7 @@ def trace_fingerprint() -> tuple:
         OPTIONS["segment_sum_impl"],
         OPTIONS["matmul_num_groups_max"],
         OPTIONS["pallas_num_groups_max"],
-        OPTIONS["pallas_compensated"],
+        OPTIONS["pallas_accum"],
         OPTIONS["matmul_block_bytes"],
         OPTIONS["segment_minmax_impl"],
         OPTIONS["pallas_minmax_num_groups_max"],

@@ -49,9 +49,19 @@ def pallas_available() -> bool:
         return False
 
 
+def _two_sum(a, b):
+    """Error-free transformation (Knuth): s + err == a + b exactly, with s
+    the rounded f32 sum. Branch-free, 6 VPU flops; relies on XLA not
+    reassociating floating-point (it does not, absent fast-math)."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
 def _kernel(
     codes_ref, data_ref, out_ref, nan_ref, pos_ref, neg_ref, comp_ref=None,
-    *, size_p, n_tile, compensated,
+    *, size_p, n_tile, accum,
 ):
     import jax
     import jax.numpy as jnp
@@ -65,7 +75,7 @@ def _kernel(
         nan_ref[:] = jnp.zeros_like(nan_ref)
         pos_ref[:] = jnp.zeros_like(pos_ref)
         neg_ref[:] = jnp.zeros_like(neg_ref)
-        if compensated:
+        if accum != "plain":
             comp_ref[:] = jnp.zeros_like(comp_ref)
 
     codes = codes_ref[0, :]  # (n_tile,)
@@ -92,7 +102,7 @@ def _kernel(
             precision=precision,
         )
 
-    if compensated:
+    if accum == "kahan":
         # Kahan summation across the sequential n-grid: recovers most of the
         # bits a plain f32 running sum loses over many tiles — the accuracy
         # story on TPUs, where float64 hardware does not exist (the eager
@@ -101,6 +111,46 @@ def _kernel(
         t = out_ref[:] + y
         comp_ref[:] = (t - out_ref[:]) - y
         out_ref[:] = t
+    elif accum == "dd":
+        # Double-double: the running sum is an unevaluated (hi, lo) f32
+        # pair (out_ref, comp_ref), ~49 effective mantissa bits. Two error
+        # sources are attacked separately:
+        #  * intra-tile — each value is Dekker-split into a 12-bit-mantissa
+        #    high part and an exact low remainder; the one-hot products are
+        #    exact (x·1), so each contraction accumulates far fewer
+        #    significant bits per addend and the two partial sums together
+        #    carry (nearly) the full per-tile sum;
+        #  * cross-tile — the partial sums merge into the (hi, lo) carry
+        #    through error-free two_sum transforms, never dropping a
+        #    rounding remainder on the floor.
+        acc = out_ref.dtype
+        z = zeroed.astype(acc)
+        c = z * jnp.asarray(4097.0, acc)  # 2**12 + 1: split 24 -> 12 + 12
+        z_hi = c - (c - z)
+        z_lo = z - z_hi
+        # the split constant overflows for |x| > f32max/4097 ≈ 8.3e34; such
+        # values keep their low bits in the high part (intra-tile rounding
+        # at that magnitude is the documented reordered-summation boundary)
+        huge = jnp.abs(z) > jnp.asarray(8e34, acc)
+        z_hi = jnp.where(huge, z, z_hi)
+        z_lo = jnp.where(huge, jnp.zeros((), acc), z_lo)
+        onehot_a = onehot.astype(acc)
+
+        def contract_a(tile):
+            return jax.lax.dot_general(
+                onehot_a, tile,
+                dimension_numbers=(((0,), (1,)), ((), ())),
+                preferred_element_type=acc,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+
+        s, e1 = _two_sum(contract_a(z_hi), contract_a(z_lo))
+        hi, e2 = _two_sum(out_ref[:], s)
+        lo = comp_ref[:] + (e1 + e2)
+        # renormalize so hi is the best single-f32 representation
+        hi2 = hi + lo
+        out_ref[:] = hi2
+        comp_ref[:] = lo - (hi2 - hi)
     else:
         out_ref[:] += contract(zeroed, jax.lax.Precision.HIGHEST)
 
@@ -121,23 +171,24 @@ def _kernel(
 @functools.lru_cache(maxsize=128)
 def _build(
     k_pad: int, n_pad: int, size_p: int, dtype_str: str, acc_str: str, n_tile: int,
-    k_tile: int, interpret: bool, compensated: bool,
+    k_tile: int, interpret: bool, accum: str,
 ):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    kern = functools.partial(_kernel, size_p=size_p, n_tile=n_tile, compensated=compensated)
+    kern = functools.partial(_kernel, size_p=size_p, n_tile=n_tile, accum=accum)
     k_tiles = k_pad // k_tile
     grid = (k_tiles, n_pad // n_tile)
     # Accumulator blocks are ``acc_str`` (f32 for bf16 data): the data tile
     # streams HBM→VMEM at its narrow width and the MXU contracts bf16×bf16
     # into f32 natively — a bf16 running sum would saturate at 256.
     acc = jnp.dtype(acc_str)
-    # the Kahan compensation term rides as a 5th output block (revisited per
-    # k-tile like the sums); pallas scratch does not persist across the k
-    # grid axis, an output block does. Uncompensated builds skip it entirely.
-    n_out = 5 if compensated else 4
+    # the Kahan compensation / double-double lo term rides as a 5th output
+    # block (revisited per k-tile like the sums); pallas scratch does not
+    # persist across the k grid axis, an output block does. Plain builds
+    # skip it entirely.
+    n_out = 4 if accum == "plain" else 5
     # outputs are padded to the block grid (they are tiny — size_p rows);
     # the data input is not (see module docstring).
     out_shape = [jax.ShapeDtypeStruct((size_p, k_pad), acc)] * n_out
@@ -578,7 +629,7 @@ def probe_compile() -> None:
 
     fn = _build(
         128, 128, 8, "float32", "float32", 128, 128, False,
-        bool(OPTIONS["pallas_compensated"]),
+        str(OPTIONS["pallas_accum"]),
     )
     fn.lower(
         jax.ShapeDtypeStruct((1, 128), jnp.int32),
@@ -587,7 +638,7 @@ def probe_compile() -> None:
 
 
 def segment_sum_pallas(
-    data, codes, size: int, *, interpret: bool = False, compensated: bool | None = None,
+    data, codes, size: int, *, interpret: bool = False, accum: str | None = None,
     skipna: bool = False, return_nan_counts: bool = False,
 ):
     """Segment-sum ``data`` (N, K...) by ``codes`` (N,) -> (size, K...).
@@ -595,8 +646,10 @@ def segment_sum_pallas(
     Exact IEEE semantics (NaN/±inf propagate per group+column); missing
     labels (code outside [0, size)) drop out. f32/bf16 only. bf16 data
     accumulates — and returns — f32 (the MXU's native accumulate mode;
-    see kernels._acc_dtype). ``compensated`` (default: the
-    ``pallas_compensated`` option) applies Kahan summation across tiles.
+    see kernels._acc_dtype). ``accum`` (default: the ``pallas_accum``
+    option) selects the cross-tile accumulation discipline: "plain",
+    "kahan" (compensated), or "dd" (double-double with Dekker-split
+    contractions — the strict-accuracy mode chasing the f64 oracle).
 
     The (N, K) logical view is consumed through its (K, N) transpose so a
     caller-side ``moveaxis(-1, 0)`` cancels and the kernel streams the
@@ -604,10 +657,10 @@ def segment_sum_pallas(
     """
     import jax.numpy as jnp
 
-    if compensated is None:
+    if accum is None:
         from .options import OPTIONS
 
-        compensated = OPTIONS["pallas_compensated"]
+        accum = OPTIONS["pallas_accum"]
 
     data = jnp.asarray(data)
     orig_shape = data.shape
@@ -629,7 +682,7 @@ def segment_sum_pallas(
     # the exact trailing size (that enters via the final [:k] slice below)
     fn = _build(
         k_pad, n_pad, size_p, str(flat.dtype), str(jnp.dtype(_acc_dtype(flat.dtype))),
-        n_tile, k_tile, interpret, bool(compensated),
+        n_tile, k_tile, interpret, str(accum),
     )
     sums, nan_c, pos_c, neg_c, *_comp = fn(codes_p, flat_t)
 

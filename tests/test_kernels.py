@@ -643,11 +643,94 @@ def test_pallas_kahan_accuracy():
     data = rng.normal(1e4, 1, size=(n, 1)).astype(np.float32)
     codes = np.zeros(n, dtype=np.int32)
     oracle = data.astype(np.float64).sum()
-    plain = float(np.asarray(segment_sum_pallas(data, codes, 1, interpret=True, compensated=False))[0, 0])
-    kahan = float(np.asarray(segment_sum_pallas(data, codes, 1, interpret=True, compensated=True))[0, 0])
+    plain = float(np.asarray(segment_sum_pallas(data, codes, 1, interpret=True, accum="plain"))[0, 0])
+    kahan = float(np.asarray(segment_sum_pallas(data, codes, 1, interpret=True, accum="kahan"))[0, 0])
     ulp = np.spacing(np.float32(oracle)).astype(np.float64)
     assert abs(kahan - oracle) <= ulp
     assert abs(kahan - oracle) <= abs(plain - oracle)
+
+
+class TestPallasDoubleDouble:
+    """The dd (2×f32) accumulation mode: the strict-parity answer to the
+    'bit-exact float64 means' north star on hardware without f64."""
+
+    def test_dd_is_correctly_rounded_f64(self):
+        # dd must land on the f32-rounding of the exact f64 sum — not just
+        # within an ulp — on a workload where plain f32 visibly drifts
+        from flox_tpu.pallas_kernels import segment_sum_pallas
+
+        rng = np.random.default_rng(1)
+        n = 200_000
+        data = rng.normal(1e4, 1, size=(n, 1)).astype(np.float32)
+        codes = (np.arange(n) % 3).astype(np.int32)
+        got = np.asarray(segment_sum_pallas(data, codes, 3, interpret=True, accum="dd"))
+        for g in range(3):
+            oracle = data[codes == g].astype(np.float64).sum()
+            assert got[g, 0] == np.float32(oracle), (g, got[g, 0], oracle)
+
+    def test_dd_cancellation(self):
+        # catastrophic cancellation across tiles: pairs (x, -x) plus a tiny
+        # residual — the lo word must carry the residual that plain/Kahan
+        # f32 sums round away when the running sum is large
+        from flox_tpu.pallas_kernels import segment_sum_pallas
+
+        n = 4096
+        data = np.zeros((n, 1), np.float32)
+        data[: n // 2, 0] = 3e7
+        data[n // 2 :, 0] = -3e7
+        data[0, 0] += 1.0  # exact in f32 at 3e7 scale
+        codes = np.zeros(n, dtype=np.int32)
+        oracle = data.astype(np.float64).sum()  # == 1.0
+        got = float(np.asarray(segment_sum_pallas(data, codes, 1, interpret=True, accum="dd"))[0, 0])
+        assert got == np.float32(oracle), (got, oracle)
+
+    def test_dd_matches_options_knob(self):
+        import flox_tpu
+        from flox_tpu.pallas_kernels import segment_sum_pallas
+
+        rng = np.random.default_rng(2)
+        data = rng.normal(size=(1000, 2)).astype(np.float32)
+        codes = (np.arange(1000) % 4).astype(np.int32)
+        with flox_tpu.set_options(pallas_accum="dd"):
+            via_opt = np.asarray(segment_sum_pallas(data, codes, 4, interpret=True))
+        explicit = np.asarray(segment_sum_pallas(data, codes, 4, interpret=True, accum="dd"))
+        np.testing.assert_array_equal(via_opt, explicit)
+
+    def test_dd_nonfinite_semantics_preserved(self):
+        # the marker machinery is orthogonal to the accumulation discipline
+        from flox_tpu.pallas_kernels import segment_sum_pallas
+
+        data = np.ones((600, 1), np.float32)
+        data[10, 0] = np.inf
+        data[20, 0] = np.nan
+        codes = (np.arange(600) % 3).astype(np.int32)
+        got = np.asarray(segment_sum_pallas(data, codes, 3, interpret=True, accum="dd"))
+        assert np.isposinf(got[1, 0])  # 10 % 3 == 1
+        assert np.isnan(got[2, 0])  # 20 % 3 == 2
+        assert np.isfinite(got[0, 0])
+
+    def test_dd_large_values_still_split_exactly(self):
+        # 2e34 is below the split-overflow bound (f32max/4097 ≈ 8.3e34), so
+        # the Dekker split still applies and the sum is exact
+        from flox_tpu.pallas_kernels import segment_sum_pallas
+
+        data = np.full((256, 1), 2e34, np.float32)
+        codes = np.zeros(256, dtype=np.int32)
+        oracle = data.astype(np.float64).sum()
+        got = float(np.asarray(segment_sum_pallas(data, codes, 1, interpret=True, accum="dd"))[0, 0])
+        assert got == np.float32(oracle)
+
+    def test_dd_huge_values_skip_split(self):
+        # above the bound the guard keeps values whole: no overflow garbage,
+        # f32-grade accuracy (the documented reordered-summation boundary)
+        from flox_tpu.pallas_kernels import segment_sum_pallas
+
+        data = np.full((256, 1), 1e35, np.float32)
+        codes = np.zeros(256, dtype=np.int32)
+        oracle = data.astype(np.float64).sum()
+        got = float(np.asarray(segment_sum_pallas(data, codes, 1, interpret=True, accum="dd"))[0, 0])
+        assert np.isfinite(got)
+        np.testing.assert_allclose(got, oracle, rtol=1e-5)
 
 
 @pytest.mark.parametrize(
