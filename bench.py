@@ -138,10 +138,24 @@ def _ensure_responsive_backend(
         if _probe_once(basic_code, timeout_s):
             # backend alive but the pallas probe failed — that could still
             # be a transient flap mid-compile, not a deterministic lowering
-            # failure; give pallas one more chance before excluding it from
-            # the round's persisted hardware evidence
+            # failure; re-probe pallas with the same spaced backoff as the
+            # backend before excluding it from the round's persisted
+            # hardware evidence (ADVICE r3: one unspaced retry loses pallas
+            # to a flap that the next minute would have survived)
             backend_ok = True
-            pallas_ok = _probe_once(pallas_code, timeout_s)
+            # the pallas probe failed SECONDS ago, so every re-probe is
+            # spaced (sleep first, including the first), with at least two
+            # tries even when the backend only recovered on the last outer
+            # attempt
+            for p_attempt in range(max(2, attempts - attempt)):
+                print(
+                    f"flox-tpu bench: pallas probe retry {p_attempt + 1} "
+                    f"in {spacing_s:.0f}s", file=sys.stderr, flush=True,
+                )
+                time.sleep(spacing_s)
+                pallas_ok = _probe_once(pallas_code, timeout_s)
+                if pallas_ok:
+                    break
             break
     if backend_ok and not pallas_ok:
         print("flox-tpu bench: pallas probe failed; using the XLA GEMM path",

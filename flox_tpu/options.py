@@ -58,6 +58,10 @@ OPTIONS: dict[str, Any] = {
     "pallas_scan_num_groups_max": 128,
 }
 
+# single source of truth for the accumulation disciplines — referenced by
+# both the set_options validator and segment_sum_pallas's argument check
+VALID_ACCUMS = ("plain", "kahan", "dd")
+
 _VALIDATORS = {
     "rechunk_blockwise_num_chunks_threshold": lambda x: 0 < x <= 1,
     "rechunk_blockwise_chunk_size_threshold": lambda x: x >= 1,
@@ -65,7 +69,7 @@ _VALIDATORS = {
     "matmul_num_groups_max": lambda x: isinstance(x, int) and x >= 0,
     "segment_sum_impl": lambda x: x in ("auto", "scatter", "matmul", "pallas"),
     "pallas_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
-    "pallas_accum": lambda x: x in ("plain", "kahan", "dd"),
+    "pallas_accum": lambda x: x in VALID_ACCUMS,
     "matmul_block_bytes": lambda x: isinstance(x, int) and x >= 2**20,
     "segment_minmax_impl": lambda x: x in ("auto", "scatter", "pallas"),
     "pallas_minmax_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,

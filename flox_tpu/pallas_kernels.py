@@ -147,10 +147,13 @@ def _kernel(
         s, e1 = _two_sum(contract_a(z_hi), contract_a(z_lo))
         hi, e2 = _two_sum(out_ref[:], s)
         lo = comp_ref[:] + (e1 + e2)
-        # renormalize so hi is the best single-f32 representation
-        hi2 = hi + lo
+        # renormalize so hi is the best single-f32 representation; Knuth
+        # two_sum, not Fast2Sum — after catastrophic cross-tile
+        # cancellation |lo| can exceed |hi| and Fast2Sum would drop the
+        # carry's low-order bits exactly where they matter most
+        hi2, lo2 = _two_sum(hi, lo)
         out_ref[:] = hi2
-        comp_ref[:] = lo - (hi2 - hi)
+        comp_ref[:] = lo2
     else:
         out_ref[:] += contract(zeroed, jax.lax.Precision.HIGHEST)
 
@@ -447,16 +450,36 @@ def _scan_kernel(
             seen_any = seen_p + seen_m
             if seen_n is not None:
                 seen_any = seen_any + seen_n
-            o_p_raw = ((seen_any == 0) & jnp.isposinf(out)).astype(acc)
-            o_m_raw = ((seen_any == 0) & jnp.isneginf(out)).astype(acc)
+            fresh = seen_any == 0
+            o_p_raw = (fresh & jnp.isposinf(out)).astype(acc)
+            o_m_raw = (fresh & jnp.isneginf(out)).astype(acc)
+            # the prefix matmul's tree reduction can emit NaN directly
+            # (opposite-sign inf partials from mixed-sign values near f32
+            # max, with no inf lane): a first-class overflow event — else
+            # the lane shows a transient NaN that later tiles silently
+            # revert, breaking the sticky-group-state model (ADVICE r3)
+            o_n_raw = (fresh & jnp.isnan(out)).astype(acc)
             s_p_raw = mm(o_p_raw, tri_eq, ((1,), (0,)), d)
             s_m_raw = mm(o_m_raw, tri_eq, ((1,), (0,)), d)
-            o_p = o_p_raw * (s_m_raw == 0).astype(acc)
-            o_m = o_m_raw * (s_p_raw == 0).astype(acc)
+            s_n_raw = mm(o_n_raw, tri_eq, ((1,), (0,)), d)
+            # first event wins per group (absorb principle); a lane never
+            # suppresses itself because each lane is exactly one of
+            # +inf / -inf / NaN
+            o_p = o_p_raw * ((s_m_raw == 0) & (s_n_raw == 0)).astype(acc)
+            o_m = o_m_raw * ((s_p_raw == 0) & (s_n_raw == 0)).astype(acc)
+            o_n = o_n_raw * ((s_p_raw == 0) & (s_m_raw == 0)).astype(acc)
+            if seen_n is None:
+                # skipna carries no NaN row: degrade the NaN event to a
+                # both-sign marker, mirroring raw_nan in _fold_overflow
+                o_p = o_p + o_n
+                o_m = o_m + o_n
             pcarry_ref[:] = pcarry_ref[:] + mm(onehot, o_p, ((0,), (1,)), d)
             mcarry_ref[:] = mcarry_ref[:] + mm(onehot, o_m, ((0,), (1,)), d)
             seen_p = seen_p + mm(o_p, tri_eq, ((1,), (0,)), d)
             seen_m = seen_m + mm(o_m, tri_eq, ((1,), (0,)), d)
+            if seen_n is not None:
+                ncarry_ref[:] = ncarry_ref[:] + mm(onehot, o_n, ((0,), (1,)), d)
+                seen_n = seen_n + mm(o_n, tri_eq, ((1,), (0,)), d)
         nan_mask = (seen_p > 0) & (seen_m > 0)
         if seen_n is not None:
             nan_mask = nan_mask | (seen_n > 0)
@@ -471,7 +494,10 @@ def _scan_kernel(
     # by a cheap VPU any-reduce over the tiny carry blocks) writes the sums
     # directly and pays zero marker matmuls.
     has_nf = jnp.any(nonfinite)
-    has_oinf = jnp.any(jnp.isposinf(out) | jnp.isneginf(out))
+    # ~isfinite, not isinf: the prefix matmul's tree reduction can produce
+    # NaN with no inf lane; such a tile must take an overflow branch so
+    # finish() records the event instead of _clean emitting a transient NaN
+    has_oinf = jnp.any(~jnp.isfinite(out))
     has_marks = jnp.any(pcarry_ref[:] > 0) | jnp.any(mcarry_ref[:] > 0)
     if not skipna:
         has_marks = has_marks | jnp.any(ncarry_ref[:] > 0)
@@ -657,10 +683,14 @@ def segment_sum_pallas(
     """
     import jax.numpy as jnp
 
-    if accum is None:
-        from .options import OPTIONS
+    from .options import OPTIONS, VALID_ACCUMS
 
+    if accum is None:
         accum = OPTIONS["pallas_accum"]
+    if accum not in VALID_ACCUMS:
+        # same whitelist as the set_options validator: a typo like "khan"
+        # must not silently select plain accumulation at lower accuracy
+        raise ValueError(f"accum must be one of {VALID_ACCUMS}; got {accum!r}")
 
     data = jnp.asarray(data)
     orig_shape = data.shape

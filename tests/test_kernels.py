@@ -632,6 +632,32 @@ class TestPallasScan:
             out = np.asarray(kernels.generic_kernel("cumsum", codes, values, size=5))
         np.testing.assert_allclose(out, self._oracle("cumsum", values, codes), rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.parametrize("skipna", [False, True])
+    def test_nonfinite_state_is_sticky_under_adversarial_magnitudes(self, skipna):
+        # mixed-sign values within a tile-width factor of f32 max: the MXU
+        # (or interpret-mode) tree reduction may overflow to ±inf or form
+        # NaN from opposite-sign inf partials in ANY order. Whatever event
+        # fires, the group-state model requires it to be sticky — a lane
+        # that reports nonfinite must never be followed by a finite lane of
+        # the same group (ADVICE r3: a tree-reduction NaN with no inf lane
+        # previously slipped the _clean branch and silently reverted).
+        from flox_tpu.pallas_kernels import segment_cumsum_pallas
+
+        rng = np.random.default_rng(99)
+        n = 1600
+        codes = (np.arange(n) % 3).astype(np.int32)
+        values = (rng.choice([-1.0, 1.0], n) * rng.uniform(1e38, 3e38, n)).astype(
+            np.float32
+        )
+        got = np.asarray(
+            segment_cumsum_pallas(values, codes, 3, skipna=skipna, interpret=True)
+        )
+        for g in range(3):
+            lane_ok = np.isfinite(got[codes == g])
+            first_bad = np.argmax(~lane_ok) if (~lane_ok).any() else len(lane_ok)
+            assert lane_ok[:first_bad].all()
+            assert not lane_ok[first_bad:].any()
+
 
 def test_pallas_kahan_accuracy():
     # compensated f32 accumulation lands within one output-ulp of the f64
@@ -683,6 +709,16 @@ class TestPallasDoubleDouble:
         oracle = data.astype(np.float64).sum()  # == 1.0
         got = float(np.asarray(segment_sum_pallas(data, codes, 1, interpret=True, accum="dd"))[0, 0])
         assert got == np.float32(oracle), (got, oracle)
+
+    def test_unknown_accum_rejected(self):
+        # a typo like "khan" must raise, not silently select plain
+        # accumulation at lower-than-requested accuracy (ADVICE r3)
+        from flox_tpu.pallas_kernels import segment_sum_pallas
+
+        data = np.ones((8, 1), np.float32)
+        codes = np.zeros(8, np.int32)
+        with pytest.raises(ValueError, match="accum"):
+            segment_sum_pallas(data, codes, 1, interpret=True, accum="khan")
 
     def test_dd_matches_options_knob(self):
         import flox_tpu

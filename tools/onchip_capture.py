@@ -1,0 +1,213 @@
+"""Opportunistic on-chip evidence capture (VERDICT r3 #1).
+
+The TPU behind the axon tunnel flaps; rounds 1-3 only probed at capture
+time and never caught it up, so no hardware artifact was ever committed.
+This tool inverts that: run it in the background for the WHOLE session
+(``--loop``); every cycle it probes device init in a subprocess (a wedged
+tunnel blocks forever in C), and the moment the chip answers it
+
+  1. runs the full ``bench.py`` sweep — which persists
+     ``BENCH_TPU_LAST.json`` (impl_sweep_gbps, quantile_gbps) by itself;
+  2. runs ``tests_tpu/`` on the hardware and writes
+     ``TESTS_TPU_LAST.json`` {commit, timestamp_utc, passed, failed,
+     skipped, duration_s};
+  3. runs the on-chip accuracy certification (``bench_accuracy.py
+     --json``) and writes ``ACCURACY_TPU_LAST.json``;
+
+then exits 0 so the driver/operator can commit the artifacts. Exits 1
+only if the deadline passes with the chip never reachable.
+
+Usage:
+    python tools/onchip_capture.py --loop [--interval 300] [--deadline-h 11]
+    python tools/onchip_capture.py          # single probe+capture attempt
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, ".onchip_capture.log")
+
+
+def log(msg: str) -> None:
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    line = f"[{stamp}] {msg}"
+    print(line, flush=True)
+    try:
+        with open(LOG, "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+
+
+def probe(timeout_s: float = 75.0) -> bool:
+    """True iff a non-CPU jax device initializes within the timeout."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; assert jax.devices()[0].platform != 'cpu'"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, cwd=REPO,
+    )
+    try:
+        return proc.wait(timeout=timeout_s) == 0
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        return False
+
+
+def _head_sha() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=REPO, capture_output=True,
+            text=True, timeout=10,
+        ).stdout.strip()
+    except Exception:
+        return "unknown"
+
+
+def run_bench(timeout_s: float = 3600.0) -> bool:
+    """Full sweep; bench.py persists BENCH_TPU_LAST.json itself on accel."""
+    log("bench: starting full on-chip sweep")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")], cwd=REPO,
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        log("bench: TIMED OUT")
+        return False
+    tail = (proc.stderr or "").strip().splitlines()[-3:]
+    log(f"bench: rc={proc.returncode} stderr_tail={tail}")
+    if proc.stdout.strip():
+        log(f"bench: stdout={proc.stdout.strip().splitlines()[-1]}")
+    # success = the persisted record is fresh (bench may have fallen back
+    # to CPU if the tunnel dropped between probe and run)
+    try:
+        with open(os.path.join(REPO, "BENCH_TPU_LAST.json")) as f:
+            rec = json.load(f)
+        fresh = time.time() - time.mktime(
+            time.strptime(rec["timestamp_utc"], "%Y-%m-%dT%H:%M:%SZ")
+        ) < timeout_s + 600
+        log(f"bench: BENCH_TPU_LAST.json platform={rec.get('platform')} "
+            f"fresh={fresh}")
+        return fresh
+    except (OSError, ValueError, KeyError):
+        log("bench: no BENCH_TPU_LAST.json written — run was not on-chip")
+        return False
+
+
+def run_tests_tpu(timeout_s: float = 3600.0) -> bool:
+    log("tests_tpu: starting hardware run")
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests_tpu/", "-q",
+             "--tb=line", "-p", "no:cacheprovider"],
+            cwd=REPO, capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        log("tests_tpu: TIMED OUT")
+        return False
+    out = proc.stdout + proc.stderr
+    counts = {k: 0 for k in ("passed", "failed", "skipped", "error")}
+    for n, word in re.findall(r"(\d+) (passed|failed|skipped|error)", out):
+        counts[word] = int(n)
+    summary_tail = out.strip().splitlines()[-5:]
+    log(f"tests_tpu: rc={proc.returncode} counts={counts}")
+    if counts["passed"] == 0:
+        # all-skipped means the probe raced a tunnel drop — not evidence
+        log(f"tests_tpu: no tests ran on hardware; tail={summary_tail}")
+        return False
+    record = {
+        "commit": _head_sha(),
+        "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "duration_s": round(time.time() - t0, 1),
+        "returncode": proc.returncode,
+        **counts,
+        "tail": summary_tail,
+    }
+    with open(os.path.join(REPO, "TESTS_TPU_LAST.json"), "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    log(f"tests_tpu: wrote TESTS_TPU_LAST.json ({counts['passed']} passed, "
+        f"{counts['failed']} failed)")
+    return proc.returncode == 0 and counts["failed"] == 0
+
+
+def run_accuracy(timeout_s: float = 1800.0) -> bool:
+    script = os.path.join(REPO, "bench_accuracy.py")
+    if not os.path.exists(script):
+        log("accuracy: bench_accuracy.py not present yet; skipping")
+        return True
+    log("accuracy: starting on-chip error certification")
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, "--json"], cwd=REPO,
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        log("accuracy: TIMED OUT")
+        return False
+    if proc.returncode != 0:
+        log(f"accuracy: rc={proc.returncode} "
+            f"tail={(proc.stderr or '').strip().splitlines()[-3:]}")
+        return False
+    try:
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        log("accuracy: unparseable output")
+        return False
+    rec["commit"] = _head_sha()
+    with open(os.path.join(REPO, "ACCURACY_TPU_LAST.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    log("accuracy: wrote ACCURACY_TPU_LAST.json")
+    return True
+
+
+def capture_once() -> bool:
+    """One full capture attempt. True iff bench AND tests evidence landed."""
+    ok_bench = run_bench()
+    ok_tests = run_tests_tpu()
+    run_accuracy()  # best-effort extra evidence
+    return ok_bench and ok_tests
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--loop", action="store_true")
+    ap.add_argument("--interval", type=float, default=300.0)
+    ap.add_argument("--deadline-h", type=float, default=11.0)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.deadline_h * 3600
+    attempt = 0
+    while True:
+        attempt += 1
+        if probe():
+            log(f"probe #{attempt}: accelerator UP — capturing")
+            if capture_once():
+                log("capture complete: on-chip artifacts written; exiting")
+                return 0
+            log("capture incomplete; will retry next cycle")
+        else:
+            log(f"probe #{attempt}: accelerator unreachable")
+        if not args.loop or time.time() > deadline:
+            break
+        time.sleep(args.interval)
+    log("deadline passed with no complete capture")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
