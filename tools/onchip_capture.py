@@ -25,6 +25,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import calendar
 import json
 import os
 import re
@@ -34,6 +35,9 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, ".onchip_capture.log")
+sys.path.insert(0, REPO)
+
+from bench import _probe_once  # noqa: E402 — single probe implementation
 
 
 def log(msg: str) -> None:
@@ -49,20 +53,9 @@ def log(msg: str) -> None:
 
 def probe(timeout_s: float = 75.0) -> bool:
     """True iff a non-CPU jax device initializes within the timeout."""
-    proc = subprocess.Popen(
-        [sys.executable, "-c",
-         "import jax; assert jax.devices()[0].platform != 'cpu'"],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, cwd=REPO,
+    return _probe_once(
+        "import jax; assert jax.devices()[0].platform != 'cpu'", timeout_s
     )
-    try:
-        return proc.wait(timeout=timeout_s) == 0
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        try:
-            proc.wait(timeout=5)
-        except subprocess.TimeoutExpired:
-            pass
-        return False
 
 
 def _head_sha() -> str:
@@ -95,7 +88,7 @@ def run_bench(timeout_s: float = 3600.0) -> bool:
     try:
         with open(os.path.join(REPO, "BENCH_TPU_LAST.json")) as f:
             rec = json.load(f)
-        fresh = time.time() - time.mktime(
+        fresh = time.time() - calendar.timegm(
             time.strptime(rec["timestamp_utc"], "%Y-%m-%dT%H:%M:%SZ")
         ) < timeout_s + 600
         log(f"bench: BENCH_TPU_LAST.json platform={rec.get('platform')} "
@@ -166,6 +159,12 @@ def run_accuracy(timeout_s: float = 1800.0) -> bool:
         rec = json.loads(proc.stdout.strip().splitlines()[-1])
     except (ValueError, IndexError):
         log("accuracy: unparseable output")
+        return False
+    if rec.get("platform") == "cpu":
+        # bench_accuracy's own probe lost the tunnel and fell back — an
+        # interpret-mode run must never be persisted as the hardware
+        # certificate
+        log("accuracy: run fell back to CPU; not persisting as on-chip")
         return False
     rec["commit"] = _head_sha()
     with open(os.path.join(REPO, "ACCURACY_TPU_LAST.json"), "w") as f:
