@@ -139,31 +139,48 @@ def _build_scan_program(scan: Scan, *, size, axis_name, nat=False):
 
     from ..kernels import generic_kernel
 
-    if nat and scan.mode == "apply_binary_op":
-        # NaT-aware cumsum needs the block summaries themselves to carry a
-        # "had NaT" channel through the carry fold; ffill/bfill (the real
-        # datetime use) and the blockwise method are supported
-        raise NotImplementedError(
-            "distributed blelloch cumsum over datetime/timedelta is not "
-            "supported; use method='blockwise' (after reshard_for_blockwise) "
-            "or run without a mesh."
-        )
-
     def program(arr_sh, codes_sh):
         # 1. within-shard segmented scan
         local = generic_kernel(scan.scan, codes_sh, arr_sh, size=size, nat=nat)
 
         if scan.mode == "apply_binary_op":
+            if nat:
+                # int64-viewed datetimes: NaT is a sentinel, not an IEEE
+                # value, so — unlike float NaN, which rides the carry sum
+                # arithmetically — the block summaries need an explicit
+                # had-NaT channel (parity: the reference's scan binop
+                # handles datetime uniformly, aggregations.py:792-846).
+                # Block sums are NaT-as-zero; the non-skipna poison is
+                # re-applied from the channel after the fold.
+                from ..kernels import _NAT_INT
+
+                is_nat = arr_sh == jnp.asarray(_NAT_INT, arr_sh.dtype)
+                summed = jnp.where(is_nat, jnp.zeros((), arr_sh.dtype), arr_sh)
+            else:
+                summed = arr_sh
             # 2. block summary: per-group sum of this shard
             block = generic_kernel(
-                scan.reduction, codes_sh, arr_sh, size=size, fill_value=0
+                scan.reduction, codes_sh, summed, size=size, fill_value=0
             )
             block = block.astype(local.dtype)
             # 3. exclusive prefix across shards: gather (ndev, ..., size) and
             # fold devices strictly before mine. A select-then-sum, not a
             # masked multiply: NaN blocks (cumsum propagation) would poison
-            # every carry through NaN * 0.
-            gathered = jax.lax.all_gather(block, axis_name)  # (ndev, ..., size)
+            # every carry through NaN * 0. The had-NaT channel (non-skipna
+            # datetime poisoning) rides the SAME gather as an extra leading
+            # slot — the carry exchange stays ONE collective.
+            poison_channel = nat and scan.scan == "cumsum"
+            if poison_channel:
+                had = generic_kernel(
+                    "sum", codes_sh, is_nat.astype(jnp.int32), size=size,
+                    fill_value=0,
+                ).astype(block.dtype)
+                payload = jnp.stack([block, had])  # (2, ..., size)
+                g = jax.lax.all_gather(payload, axis_name)  # (ndev, 2, ..., size)
+                gathered = g[:, 0]
+                g_had = g[:, 1] > 0
+            else:
+                gathered = jax.lax.all_gather(block, axis_name)  # (ndev, ..., size)
             ndev = gathered.shape[0]
             me = _flat_axis_index(axis_name)
             mask = (jnp.arange(ndev) < me).reshape((ndev,) + (1,) * (gathered.ndim - 1))
@@ -176,7 +193,23 @@ def _build_scan_program(scan: Scan, *, size, axis_name, nat=False):
                 [carry, jnp.zeros(carry.shape[:-1] + (1,), carry.dtype)], axis=-1
             )
             per_elem = jnp.take(carry_pad, safe, axis=-1)
-            return local + per_elem
+            out = local + per_elem
+            if poison_channel:
+                # non-skipna: a NaT anywhere earlier in the group poisons
+                # every later element. In-shard poisoning is already in
+                # ``local`` (== NaT sentinel); cross-shard comes from the
+                # had-NaT channel folded over shards strictly before me.
+                poison = jnp.any(mask & g_had, axis=0)  # (..., size)
+                poison_pad = jnp.concatenate(
+                    [poison, jnp.zeros(poison.shape[:-1] + (1,), bool)], axis=-1
+                )
+                poison_e = jnp.take(poison_pad, safe, axis=-1)
+                nat_val = jnp.asarray(_NAT_INT, out.dtype)
+                out = jnp.where(poison_e | (local == nat_val), nat_val, out)
+            # skipna (nancumsum): NaT counts as zero on the eager path, so
+            # the plain carry add is already exact — no sentinel survives
+            # the within-shard scan
+            return out
 
         # ffill/bfill: carry = last (first) valid value per group in shards
         # strictly before (after) me

