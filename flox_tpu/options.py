@@ -56,6 +56,13 @@ OPTIONS: dict[str, Any] = {
     # the scan kernel's carry gather/update matmuls scale with the group
     # count; past ~the lane-tile width they dominate the triangular matmul
     "pallas_scan_num_groups_max": 128,
+    # HBM ceiling for dense (..., size) device intermediates (VERDICT r3 #6:
+    # a ~10^6-label run used to OOM with no guard). Estimated footprint
+    # above this either auto-routes map-reduce/cohorts to the blocked
+    # psum-per-owner-block program (additive combines: intermediates are
+    # (..., size/ndev) from the start) or raises with the alternatives.
+    # Default 8 GiB: half a v5e chip's HBM, leaving room for the data.
+    "dense_intermediate_bytes_max": 8 * 2**30,
 }
 
 # single source of truth for the accumulation disciplines — referenced by
@@ -75,6 +82,7 @@ _VALIDATORS = {
     "pallas_minmax_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
     "scan_impl": lambda x: x in ("auto", "segmented", "pallas"),
     "pallas_scan_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
+    "dense_intermediate_bytes_max": lambda x: isinstance(x, int) and x >= 2**20,
 }
 
 

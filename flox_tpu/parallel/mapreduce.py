@@ -218,6 +218,48 @@ def _cached_mesh_default():
     return make_mesh()
 
 
+def _is_additive(agg: Aggregation) -> bool:
+    """Combines expressible as psum / psum_scatter (the ops the cohorts and
+    blocked programs can distribute by group ownership)."""
+    return agg.reduction_type != "argreduce" and bool(agg.combine) and all(
+        op in ("sum", "var") for op in agg.combine
+    )
+
+
+def _est_itemsize(dtype) -> int:
+    """Accumulator width for the footprint estimate: intermediates travel in
+    >= f32 accumulators; complex dtypes keep their full 2x width."""
+    return max(4, np.dtype(str(dtype)).itemsize)
+
+
+def dense_intermediate_bytes(
+    lead_elems: int, size: int, dtype, agg: Aggregation, ndev: int
+) -> int:
+    """Per-device HBM estimate for the dense (..., size) intermediates a
+    map-reduce program materializes (VERDICT r3 #6). Counts one buffer per
+    chunk leg plus the counts leg; legs whose combine all_gathers (callable
+    folds, prod, first/last) cost ndev x their dense size."""
+    itemsize = _est_itemsize(dtype)
+    per_leg = lead_elems * size * itemsize
+    legs = 1  # counts
+    # blockwise-only aggs (order statistics) have no chunk/combine legs:
+    # one result buffer next to the counts
+    ops = agg.combine or ("sum",) * max(1, len(agg.chunk or ()) or 1)
+    if agg.combine in (("first",), ("last",)) or agg.reduction_type == "argreduce":
+        legs += 2  # (value, position) pair, pmax/pmin combine
+        if agg.combine in (("first",), ("last",)):
+            legs += 2 * (ndev - 1)  # the pair is all_gathered
+        return per_leg * legs
+    for op in ops:
+        if op == "var":
+            legs += 3  # the Chan triple psums leaf-wise
+        elif op == "sum" or op in ("max", "min"):
+            legs += 1
+        else:  # callable user folds and prod travel via all_gather
+            legs += ndev
+    return per_leg * legs
+
+
 def sharded_groupby_reduce(
     array,
     codes,
@@ -272,10 +314,61 @@ def sharded_groupby_reduce(
             for fv in agg.fill_value.get("intermediate", ())
         )
 
+    # -- huge-label-space routing (VERDICT r3 #6) --------------------------
+    # Estimate the dense per-device intermediate footprint; above the
+    # ceiling, additive aggs run the blocked program (every intermediate is
+    # (..., size/ndev) from the start, one psum per owner block) and
+    # non-additive ones fail actionably instead of OOMing HBM.
+    from ..options import OPTIONS
+
+    arr_probe = array if hasattr(array, "shape") else np.asarray(array)
+    lead_elems = int(np.prod(arr_probe.shape[:-1])) if arr_probe.ndim > 1 else 1
+    est = dense_intermediate_bytes(lead_elems, size, arr_probe.dtype, agg, ndev)
+    ceiling = OPTIONS["dense_intermediate_bytes_max"]
+    blocked = False
+    if est > ceiling and method in ("map-reduce", "cohorts"):
+        # blocked peak per device: the replicated dense result (irreducible
+        # — the output contract is a full (..., size) array) plus the
+        # per-owner-block intermediates, est/ndev. If even that exceeds the
+        # ceiling (ndev too small, or the result alone is too big), blocking
+        # would proceed straight into the OOM it exists to prevent — raise.
+        result_bytes = lead_elems * size * _est_itemsize(arr_probe.dtype)
+        blocked_est = result_bytes + est // ndev
+        if _is_additive(agg) and blocked_est <= ceiling:
+            blocked = True
+            method = "cohorts"  # blocked execution lives in the cohorts program
+            import logging
+
+            logging.getLogger("flox_tpu").debug(
+                "dense intermediates ~%.1f GiB exceed dense_intermediate_bytes_max"
+                " (%.1f GiB): using the blocked owner-by-owner program",
+                est / 2**30, ceiling / 2**30,
+            )
+        else:
+            how = (
+                "its combine cannot be distributed by group ownership"
+                if not _is_additive(agg)
+                else f"even the blocked owner-by-owner program needs "
+                f"~{blocked_est / 2**30:.1f} GiB/device over {ndev} device(s)"
+            )
+            raise ValueError(
+                f"{agg.name!r} over {size} groups needs ~{est / 2**30:.1f} GiB of "
+                f"dense (..., size) intermediates per device, above the "
+                f"{ceiling / 2**30:.1f} GiB dense_intermediate_bytes_max ceiling, "
+                f"and {how}. Options: reduce expected_groups; shard over more "
+                "devices; use method='blockwise' after "
+                "rechunk.reshard_for_blockwise (whole groups per shard, no dense "
+                "combine); or raise set_options(dense_intermediate_bytes_max=...) "
+                "if the device really has the headroom."
+            )
+
     cohort_perm = None
-    if method == "cohorts":
+    if method == "cohorts" and not blocked:
         # align psum_scatter ownership tiles with detected cohorts (memoized
-        # detection — the auto-method path already ran it on these codes)
+        # detection — the auto-method path already ran it on these codes).
+        # Blocked runs skip detection: at the group counts that trigger
+        # blocking, the host-side bitmask/containment analysis costs more
+        # than the locality it buys, and block ownership is already uniform.
         from ..cohorts import chunks_from_shards, find_group_cohorts, ownership_permutation
 
         codes_np = np.asarray(codes).reshape(-1)
@@ -309,7 +402,7 @@ def sharded_groupby_reduce(
 
     cache_key = (
         _agg_cache_key(agg), size, size_pad, method, axes, shard_len, nat,
-        mesh, arr.ndim, trace_fingerprint(),
+        mesh, arr.ndim, blocked, trace_fingerprint(),
         None if cohort_perm is None else cohort_perm.tobytes(),
     )
     fn = _PROGRAM_CACHE.get(cache_key)
@@ -317,6 +410,7 @@ def sharded_groupby_reduce(
         program = _build_program(
             agg, size=size, size_pad=size_pad, method=method, axis_name=axes,
             shard_len=shard_len, nat=nat, cohort_perm=cohort_perm,
+            blocked=blocked, ndev=ndev,
         )
         # check_vma=False: outputs are replicated by construction (psum /
         # all_gather), but the static checker cannot infer that through
@@ -400,7 +494,10 @@ def _apply_final_fill(result, counts, agg: Aggregation):
     return jnp.where(empty_b, fv.astype(result.dtype), result)
 
 
-def _build_program(agg, *, size, size_pad, method, axis_name, shard_len, nat, cohort_perm=None):
+def _build_program(
+    agg, *, size, size_pad, method, axis_name, shard_len, nat,
+    cohort_perm=None, blocked=False, ndev=1,
+):
     import jax
     import jax.numpy as jnp
 
@@ -485,14 +582,57 @@ def _build_program(agg, *, size, size_pad, method, axis_name, shard_len, nat, co
                 )
         return finalize(combined, counts)
 
+    def blocked_cohorts_program(arr_sh, codes_sh):
+        """Huge-label-space variant (VERDICT r3 #6): no dense (..., size)
+        buffer ever materializes. A fori_loop walks the ndev owner blocks;
+        each iteration chunk-reduces only that block's groups into a
+        (..., size/ndev) buffer, psums it (replicated), and the owner
+        mask-keeps its slice. Communication totals one psum of (..., size)
+        — the same bytes as plain map-reduce — but peak HBM is
+        (..., size/ndev) x O(1) buffers. The data makes ndev passes, the
+        price of the memory ceiling."""
+        me = _flat_axis_index(axis_name)
+        b = size_pad // ndev
+
+        def block(d):
+            in_block = (codes_sh >= d * b) & (codes_sh < (d + 1) * b)
+            bc = jnp.where(in_block, codes_sh - d * b, -1)
+            counts = jax.lax.psum(
+                _local_counts(bc, arr_sh, b, count_skipna, nat), axis_name
+            )
+            outs = []
+            for inter, op in zip(_local_chunk(agg, bc, arr_sh, b, nat), agg.combine):
+                outs.append(
+                    _combine_var(inter, axis_name)
+                    if op == "var"
+                    else _combine_simple(op, inter, axis_name, nat=nat and not skipna)
+                )
+            return counts, outs
+
+        c0, o0 = block(0)
+        keep0 = me == 0
+        carry0 = jax.tree.map(lambda x: jnp.where(keep0, x, jnp.zeros_like(x)), (c0, o0))
+
+        def body(d, carry):
+            c, o = block(d)
+            keep = me == d
+            return jax.tree.map(lambda new, acc: jnp.where(keep, new, acc), (c, o), carry)
+
+        counts_own, owned = jax.lax.fori_loop(1, ndev, body, carry0)
+        result_own = finalize(owned, counts_own)
+        full = jax.lax.all_gather(
+            jnp.moveaxis(result_own, -1, 0), axis_name, tiled=True
+        )
+        return _crop(jnp.moveaxis(full, 0, -1), size)
+
     def cohorts_program(arr_sh, codes_sh):
         # psum_scatter needs every intermediate to be additive; route others
         # through map-reduce (matching how the reference falls back to
         # map-reduce when cohort detection finds nothing to exploit)
-        if agg.reduction_type == "argreduce" or not all(
-            op in ("sum", "var") for op in (agg.combine or ())
-        ):
+        if not _is_additive(agg):
             return mapreduce_program(arr_sh, codes_sh)
+        if blocked:
+            return blocked_cohorts_program(arr_sh, codes_sh)
 
         from ..kernels import generic_kernel
 
