@@ -48,18 +48,26 @@ def streaming_groupby_reduce(
     expected_groups=None,
     isbin=False,
     sort: bool = True,
+    axis=None,
     fill_value=None,
     dtype=None,
     min_count: int | None = None,
     finalize_kwargs: dict | None = None,
 ):
-    """Grouped reduction over the trailing axis, streaming slabs to device.
+    """Grouped reduction streaming slabs to device.
 
-    ``array``: a host array ``(..., N)`` **or** a loader
+    ``array``: a host array ``(..., *by.shape)`` **or** a loader
     ``callable(start, stop) -> np.ndarray`` returning ``(..., stop-start)``
-    slabs (zarr/memmap-style); with a loader, pass the full-axis labels in
+    slabs (zarr/memmap-style); with a loader, pass 1-D full-axis labels in
     ``by`` — its length defines ``N``. Returns ``(result, groups)`` exactly
     like :func:`flox_tpu.groupby_reduce`.
+
+    nD ``by`` and partial-axis reductions (``axis=`` a subset of the
+    by-span, exactly as in ``groupby_reduce``) are supported for host
+    arrays: kept dims fold into disjoint per-row code ranges (the same
+    flatten ``core.groupby_reduce`` uses), so the stream still walks one
+    flat trailing axis. Loaders define a 1-D axis contract, so they keep
+    1-D ``by`` / ``axis=None``.
 
     Supported: every aggregation with a chunk stage (blockwise-only order
     statistics — median/quantile/mode — need all of a group at once and
@@ -68,35 +76,67 @@ def streaming_groupby_reduce(
     import jax
     import jax.numpy as jnp
 
+    from . import dtypes as dtps
+
     labels = utils.asarray_host(by)
-    if labels.ndim != 1:
-        raise NotImplementedError("streaming supports 1-D labels over the last axis")
-    n = labels.shape[0]
+    keep_by_shape: tuple = ()
 
     loader: Callable[[int, int], Any]
     if callable(array):
+        if labels.ndim != 1:
+            raise NotImplementedError(
+                "loader inputs define a 1-D (start, stop) axis contract: "
+                "pass 1-D labels (pre-flatten nD layouts host-side)"
+            )
+        if axis is not None:
+            raise NotImplementedError("axis= needs a host array, not a loader")
         loader = array
         lead_shape = None  # discovered from the first slab
+        bys = [labels]
+        red_axes = (0,)
     else:
         arr = np.asarray(array) if not utils.is_jax_array(array) else array
-        if arr.shape[-1] != n:
-            raise ValueError(f"array trailing axis {arr.shape[-1]} != len(by) {n}")
-        loader = lambda s, e: arr[..., s:e]
-        lead_shape = arr.shape[:-1]
+        bndim = labels.ndim
+        if arr.shape[arr.ndim - bndim:] != labels.shape:
+            raise ValueError(
+                f"array trailing dims {arr.shape[arr.ndim - bndim:]} != "
+                f"by shape {labels.shape}"
+            )
+        # -- axis normalization: reduced by-dims must trail — the SAME
+        # helper core.groupby_reduce uses, so the flatten contracts cannot
+        # drift apart (kept dims fold into disjoint per-row code ranges and
+        # the stream walks ONE flat axis)
+        from .core import _normalize_reduce_axes
 
-    # -- host factorize over the full label axis (cheap: labels only) ------
+        arr, (labels,), n_keep, bndim = _normalize_reduce_axes(arr, [labels], axis)
+        keep_by_shape = labels.shape[:n_keep]
+        lead_shape = arr.shape[: arr.ndim - bndim]
+        span = int(np.prod(labels.shape)) if labels.size else 0
+        arr = arr.reshape(lead_shape + (span,))
+        loader = lambda s, e: arr[..., s:e]
+        bys = [labels]
+        red_axes = tuple(range(n_keep, bndim))
+    n = int(np.prod(bys[0].shape))
+
+    # -- host factorize over the full label span (cheap: labels only) ------
     from .core import _convert_expected_groups_to_index, _normalize_expected, _normalize_isbin
 
     expected = _normalize_expected(expected_groups, 1)
     expected_idx = _convert_expected_groups_to_index(expected, _normalize_isbin(isbin, 1), sort)
     codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
-        [labels], axes=(0,), expected_groups=expected_idx, sort=sort
+        bys, axes=red_axes, expected_groups=expected_idx, sort=sort
     )
     codes = np.asarray(codes).reshape(-1)
     if size == 0:
         raise ValueError("No groups to reduce over (empty expected_groups?)")
 
     probe = np.asarray(loader(0, 1))  # one probe: dtype AND lead shape
+    if dtps.is_datetime_like(probe.dtype):
+        raise NotImplementedError(
+            "datetime64/timedelta64 streaming is not supported (the slab "
+            "merges carry no NaT channel); use groupby_reduce — the eager "
+            "and mesh paths handle NaT natively."
+        )
     agg = _initialize_aggregation(
         func, dtype, probe.dtype, fill_value,
         0 if min_count is None else min_count, finalize_kwargs,
@@ -159,6 +199,11 @@ def streaming_groupby_reduce(
     from .core import _astype_final, _index_values
 
     result = _astype_final(result, agg, None)
+    # (..., size) -> (..., *keep_by, *groups): kept by-dims ride the group
+    # axis as disjoint code ranges (factorize_ offsetting) and unfold here
+    out_shape = tuple(lead_shape) + tuple(keep_by_shape) + grp_shape
+    if result.shape != out_shape:
+        result = result.reshape(out_shape)
     return (result,) + tuple(_index_values(g) for g in found_groups)
 
 
