@@ -108,14 +108,61 @@ def chunks_from_shards(n: int, n_shards: int) -> tuple[int, ...]:
     return tuple(chunks)
 
 
-def _label_chunk_bitmask(labels: np.ndarray, chunks: Sequence[int], nlabels: int):
+def _is_nested_chunks(chunks) -> bool:
+    """Multi-axis chunk grids are sequences of per-axis chunk tuples."""
+    return bool(len(chunks)) and isinstance(chunks[0], (tuple, list, np.ndarray))
+
+
+def _chunk_ids(shape: tuple[int, ...], chunks) -> np.ndarray:
+    """Flattened chunk index per element of an nD label array chunked by a
+    per-axis grid (row-major over the block grid, matching dask's
+    block_id ordering in the reference's bitmask, cohorts.py:34-105)."""
+    cid: np.ndarray | None = None
+    ndim = len(shape)
+    for ax, ch in enumerate(chunks):
+        ch = tuple(int(c) for c in ch)
+        if sum(ch) != shape[ax]:
+            raise ValueError(
+                f"chunks for axis {ax} sum to {sum(ch)}, label axis is {shape[ax]}"
+            )
+        bounds = np.cumsum(ch)
+        block = np.searchsorted(bounds, np.arange(shape[ax]), side="right").astype(np.int64)
+        bshape = [1] * ndim
+        bshape[ax] = shape[ax]
+        block = block.reshape(bshape)
+        cid = block if cid is None else cid * len(ch) + block
+    return np.broadcast_to(cid, shape)
+
+
+def _label_chunk_bitmask(labels: np.ndarray, chunks, nlabels: int):
     """Sparse boolean S[chunk, label]: does chunk i contain label j?
 
-    Parity: _compute_label_chunk_bitmask (cohorts.py:34-105). The reference's
-    write-True-uniques trick becomes a per-chunk ``np.unique`` here — the
-    chunk count is small (shards), so this stays cheap.
+    Parity: _compute_label_chunk_bitmask (cohorts.py:34-105). ``chunks`` is
+    a chunk-length sequence over the flattened labels, or — for nD label
+    arrays chunked on every axis (the reference's NWM county case) — a
+    sequence of per-axis chunk tuples. The reference's write-True-uniques
+    trick becomes a per-chunk ``np.unique`` / a coo-dedup here — the chunk
+    count is small (shards), so this stays cheap.
     """
     import scipy.sparse
+
+    if _is_nested_chunks(chunks):
+        labels = np.asarray(labels)
+        if labels.ndim != len(chunks):
+            raise ValueError(
+                f"nested chunks describe {len(chunks)} axes but labels have "
+                f"{labels.ndim} dims"
+            )
+        cid = _chunk_ids(labels.shape, chunks).reshape(-1)
+        flat = labels.reshape(-1)
+        keep = flat >= 0
+        nchunks = int(np.prod([len(c) for c in chunks]))
+        mat = scipy.sparse.csc_array(
+            (np.ones(int(keep.sum()), dtype=np.int64), (cid[keep], flat[keep])),
+            shape=(nchunks, nlabels), dtype=np.int64,
+        )
+        mat.data = np.ones_like(mat.data)  # construction summed duplicates
+        return mat
 
     labels = np.asarray(labels).reshape(-1)
     rows: list[np.ndarray] = []
@@ -161,10 +208,12 @@ def find_group_cohorts(
     """
     import hashlib
 
-    labels = np.asarray(labels).reshape(-1)
+    nested = _is_nested_chunks(chunks)
+    labels = np.asarray(labels) if nested else np.asarray(labels).reshape(-1)
     key = (
         hashlib.sha1(np.ascontiguousarray(labels)).hexdigest(),
-        tuple(chunks),
+        labels.shape,
+        tuple(tuple(int(x) for x in c) for c in chunks) if nested else tuple(chunks),
         None if expected_groups is None else len(expected_groups),
         merge,
     )
@@ -188,7 +237,11 @@ def _find_group_cohorts(
         nlabels = int(labels.max()) + 1 if labels.size and labels.max() >= 0 else 0
     else:
         nlabels = len(expected_groups)
-    nchunks = len(chunks)
+    nchunks = (
+        int(np.prod([len(c) for c in chunks]))
+        if _is_nested_chunks(chunks)
+        else len(chunks)
+    )
 
     if nlabels == 0:
         return "map-reduce", {}

@@ -193,3 +193,51 @@ def test_era5_monthhour():
     assert method == "cohorts"
     labels = sorted(lab for labs in mapping.values() for lab in labs)
     assert labels == list(range(288))
+
+
+# --- full-output snapshot pinning (VERDICT r3 #9; parity: the reference's
+# syrupy snapshots, tests/test_cohorts.py:10-29 + __snapshots__/*.ambr).
+# Any change in detection output fails loudly; regenerate intentionally with
+# FLOX_UPDATE_SNAPSHOTS=1 python -m pytest tests/test_cohorts.py -k snapshot
+
+
+def _snapshot_path(name):
+    import os
+
+    return os.path.join(
+        os.path.dirname(__file__), "__snapshots__", "cohorts", f"{name}.json"
+    )
+
+
+def _canonical(method, mapping):
+    return {
+        "method": method,
+        "cohorts": {
+            ",".join(map(str, k)): sorted(int(x) for x in v)
+            for k, v in sorted(mapping.items())
+        },
+    }
+
+
+from cohort_scenarios import SCENARIOS
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_snapshot_cohorts(name):
+    import json
+    import os
+
+    labels, chunks, size = SCENARIOS[name]()
+    method, mapping = find_group_cohorts(labels, chunks, expected_groups=range(size))
+    got = _canonical(method, mapping)
+    path = _snapshot_path(name)
+    if os.environ.get("FLOX_UPDATE_SNAPSHOTS"):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(got, f, indent=0, sort_keys=True)
+            f.write("\n")
+        pytest.skip(f"snapshot for {name} regenerated")
+    with open(path) as f:
+        want = json.load(f)
+    assert got["method"] == want["method"], name
+    assert got["cohorts"] == want["cohorts"], name
