@@ -188,7 +188,9 @@ def main() -> None:
     # workload: a full-size ERA5 pass takes ~15 min on one host core and the
     # CPU number is only a liveness signal. Env vars still override.
     on_cpu = jax.default_backend() == "cpu"
-    default_ntime = (24 * 365) if on_cpu else (24 * 365 * 3)
+    # 3 calendar years of hourly steps INCLUDING the 2016 leap day = 26304,
+    # the headline shape (BASELINE.md: array (721, 1440, 26304))
+    default_ntime = (24 * 365) if on_cpu else (24 * (365 * 3 + 1))
     default_nlat = 60 if on_cpu else 181
     nlat = int(os.environ.get("FLOX_TPU_BENCH_NLAT", default_nlat))
     nlon = int(os.environ.get("FLOX_TPU_BENCH_NLON", 360))

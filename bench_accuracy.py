@@ -183,9 +183,10 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     on_accel = jax.default_backend() != "cpu"
-    # full reduction length always; cells bounded off-chip (interpret mode)
+    # full reduction length always (26304 = 3 calendar years of hourly steps
+    # incl. the leap day, the headline shape); cells bounded off-chip
     cells = int(os.environ.get("FLOX_ACC_CELLS", 4096 if on_accel else 128))
-    ntime = int(os.environ.get("FLOX_ACC_NTIME", 24 * 365 * 3))
+    ntime = int(os.environ.get("FLOX_ACC_NTIME", 24 * (365 * 3 + 1)))
     seed = int(os.environ.get("FLOX_ACC_SEED", 0))
 
     rec = run(cells, ntime, seed)

@@ -338,6 +338,11 @@ def _arg_impl(group_idx, array, *, size, fill_value, skipna, arg_of_max, nat=Fal
     if skipna and mask is not None:
         cand = np.where(mask, cand, n)
     pos = _scatter(np.minimum, codes, cand, valid, size, n)
+    if not skipna and mask is not None:
+        # numpy parity: any NaN (NaT) in the group short-circuits the value
+        # race — the first missing position is the answer (even over ±inf)
+        first_nan = _scatter(np.minimum, codes, np.where(mask, n, iota), valid, size, n)
+        pos = np.where(first_nan < n, first_nan, pos)
     if skipna and mask is not None:
         cnt = np.zeros((size,) + data.shape[1:], dtype=np.intp)
         np.add.at(cnt, codes[valid], mask[valid].astype(np.intp))
@@ -476,9 +481,9 @@ def _mode_impl(group_idx, array, *, size, fill_value, skipna):
             if c.size == 0:
                 res.append(np.nan)
                 continue
-            if not skipna and np.issubdtype(c.dtype, np.floating) and np.isnan(c).any():
-                res.append(np.nan)
-                continue
+            # scipy.stats.mode "propagate" (scipy >= 1.11): NaNs count as ONE
+            # candidate value with their multiplicity — np.unique's equal_nan
+            # collapse delivers exactly that; skipna dropped them above
             vals, cnts = np.unique(c, return_counts=True)
             res.append(vals[np.argmax(cnts)])
         return np.array(res).reshape(grp.shape[1:])

@@ -875,10 +875,10 @@ def _arg_impl(group_idx, array, *, size, fill_value, skipna, arg_of_max, nat=Fal
             ident = jnp.asarray(minmax_identity(op, data.dtype), dtype=data.dtype)
             key = jnp.where(mask, data, ident)
         else:
-            # NaN propagates: map NaN to the absorbing element so a NaN-bearing
-            # group resolves to a NaN position. (Known divergence from numpy:
-            # if a group contains both inf and NaN, the earlier of the two wins
-            # the tie rather than strictly the first NaN.)
+            # NaN propagates with numpy's exact semantics: the FIRST NaN
+            # position wins outright, even when the group also holds ±inf
+            # (np.argmax([inf, nan]) == 1). NaNs are excluded from the
+            # value race here and re-applied as a position override below.
             absorb = jnp.asarray(
                 minmax_identity("min" if arg_of_max else "max", data.dtype),
                 dtype=data.dtype,
@@ -893,6 +893,11 @@ def _arg_impl(group_idx, array, *, size, fill_value, skipna, arg_of_max, nat=Fal
     if skipna and mask is not None:
         cand = jnp.where(mask, cand, _BIG)
     out = _seg("min", cand, codes, size)
+    if not skipna and mask is not None:
+        # numpy parity: any NaN (NaT) in the group short-circuits the value
+        # race — the first missing position is the answer
+        first_nan = _seg("min", jnp.where(mask, _BIG, iota), codes, size)
+        out = jnp.where(first_nan < _BIG, first_nan, out)
     valid_counts = _counts(codes, size, mask=mask if skipna else None)
     present = _bcast_present(valid_counts, out) > 0
     fv = -1 if fill_value is None else fill_value
@@ -1093,10 +1098,17 @@ def _mode_impl(group_idx, array, *, size, fill_value, skipna):
         smask = ~jnp.isnan(sorted_data)
     n = sorted_data.shape[0]
     iota = _iota_like(sorted_data)
+    val_same = sorted_data[1:] == sorted_data[:-1]
+    if smask is not None and not skipna:
+        # scipy.stats.mode "propagate" (scipy >= 1.11, via np.unique's
+        # equal_nan): NaNs count as ONE candidate value with their full
+        # multiplicity. The sort parks NaNs last within each group, so
+        # merging adjacent NaN lanes makes them a single run.
+        val_same = val_same | (~smask[1:] & ~smask[:-1])
     prev_same = jnp.concatenate(
         [
             jnp.zeros((1,) + sorted_data.shape[1:], bool),
-            (sorted_data[1:] == sorted_data[:-1]) & (sorted_codes[1:] == sorted_codes[:-1]),
+            val_same & (sorted_codes[1:] == sorted_codes[:-1]),
         ]
     )
     # run start index per position: cumulative max of start markers
@@ -1110,9 +1122,6 @@ def _mode_impl(group_idx, array, *, size, fill_value, skipna):
     run_len = run_end - run_start + 1
     if smask is not None and skipna:
         run_len = jnp.where(smask, run_len, -1)
-    elif smask is not None:
-        # Non-skipping mode with NaN present: scipy.stats.mode propagates NaN.
-        pass
     # codes are identical across trailing columns; segment ids must be 1-D
     codes1d = sorted_codes if sorted_codes.ndim == 1 else sorted_codes[(slice(None),) + (0,) * (sorted_codes.ndim - 1)]
     best_len = _seg("max", run_len, codes1d, size)
@@ -1125,9 +1134,6 @@ def _mode_impl(group_idx, array, *, size, fill_value, skipna):
     pos = _seg("min", cand, codes1d, size)
     valid = pos < _BIG
     out = jnp.take_along_axis(sorted_data, jnp.clip(pos, 0, n - 1), axis=0)
-    if smask is not None and not skipna:
-        has_nan = _seg("max", (~smask).astype(jnp.int8), codes1d, size) > 0
-        out = jnp.where(_bcast_present(has_nan, out), jnp.asarray(jnp.nan, out.dtype), out)
     fv = fill_value if fill_value is not None else (jnp.nan if jnp.issubdtype(out.dtype, jnp.floating) else 0)
     out = _promote_for_nan_fill(out, fv)
     out = jnp.where(valid, out, jnp.asarray(fv).astype(out.dtype))

@@ -136,10 +136,8 @@ def test_groupby_reduce_all(engine, func, shape, add_nan):
     values = np.round(RNG.normal(size=(3, n) if shape == "2d" else (n,)), 1)
     if add_nan:
         values[..., RNG.random(n) < 0.25] = np.nan
-    if add_nan and func in ("argmax", "argmin"):
-        pytest.skip("NaN-propagating argreductions: inf/NaN tie edge documented")
-    if add_nan and func in ("mode",):
-        pytest.skip("scipy mode propagate with partial NaN differs per version")
+    # no skips: the argmax/argmin NaN semantics and partial-NaN mode are
+    # pinned to numpy / scipy>=1.11 behavior (VERDICT r3 #10)
 
     fkw = {}
     if func in ("var", "nanvar", "std", "nanstd"):
@@ -652,3 +650,45 @@ class TestNonNumericData:
     def test_finalize_kwargs_rejected(self):
         with pytest.raises(NotImplementedError, match="finalize_kwargs"):
             groupby_reduce(self.S, self.LABELS, func="count", finalize_kwargs={"q": 0.5})
+
+
+@pytest.mark.parametrize("engine", ["jax", "numpy"])
+class TestPinnedEdgeSemantics:
+    """VERDICT r3 #10: inf/NaN argreduction ties and partial-NaN mode are
+    pinned, not skipped. Oracles: numpy argmax/argmin; scipy>=1.11
+    stats.mode(nan_policy="propagate")."""
+
+    def test_argmax_first_nan_beats_inf(self, engine):
+        vals = np.array([np.inf, np.nan, 3.0, np.nan, -np.inf, 2.0])
+        codes = np.array([0, 0, 0, 1, 1, 1])
+        got, _ = groupby_reduce(vals, codes, func="argmax", engine=engine)
+        np.testing.assert_array_equal(np.asarray(got), [1, 3])  # first NaN wins
+        got, _ = groupby_reduce(vals, codes, func="argmin", engine=engine)
+        np.testing.assert_array_equal(np.asarray(got), [1, 3])
+        # and without NaN, inf wins normally
+        clean = np.array([1.0, np.inf, -np.inf, 5.0])
+        ccodes = np.array([0, 0, 0, 0])
+        got, _ = groupby_reduce(clean, ccodes, func="argmax", engine=engine)
+        assert int(np.asarray(got)[0]) == 1
+
+    def test_mode_partial_nan_counts_as_one_value(self, engine):
+        import scipy.stats
+
+        vals = np.array([1.0, 1.0, 2.0, np.nan,  # g0: mode 1.0 (NaN minority)
+                         5.0, np.nan, np.nan, 7.0])  # g1: mode NaN (majority)
+        codes = np.array([0, 0, 0, 0, 1, 1, 1, 1])
+        got, _ = groupby_reduce(vals, codes, func="mode", engine=engine)
+        got = np.asarray(got)
+        for g in range(2):
+            want = scipy.stats.mode(
+                vals[codes == g], nan_policy="propagate", keepdims=False
+            ).mode
+            np.testing.assert_array_equal(got[g], want)
+        assert got[0] == 1.0 and np.isnan(got[1])
+
+    def test_mode_nan_tie_prefers_value(self, engine):
+        # 2x NaN vs 2x 3.0: scipy's unique order puts NaN last -> 3.0 wins
+        vals = np.array([3.0, 3.0, np.nan, np.nan, 9.0])
+        codes = np.zeros(5, dtype=np.int64)
+        got, _ = groupby_reduce(vals, codes, func="mode", engine=engine)
+        assert float(np.asarray(got)[0]) == 3.0
