@@ -1,0 +1,221 @@
+"""Contract tests for the adapter's real-xarray branches (VERDICT r3 #4).
+
+xarray cannot be installed in this environment (pip has no network; the
+attempt fails resolving pypi.org), so the ``HAS_XARRAY`` branches of
+``flox_tpu.xarray`` would otherwise never execute. This module installs a
+mock ``xarray`` package implementing the EXACT API subset those branches
+touch — method-delegate reductions with real-xarray signatures
+(``obj.mean(dim=..., skipna=..., keep_attrs=...)``),
+``Coordinates.from_pandas_multiindex``, and ``apply_ufunc``'s keyword
+contract — forces ``HAS_XARRAY`` True, and runs the adapter end-to-end.
+Every assertion here is a call-shape real xarray would enforce with a
+TypeError, so a drifted kwarg or a dict-returning argmax surfaces as a
+test failure instead of sailing through the xrlite binding.
+
+Reference parity: xarray.py:303-322 (delegate reductions), 416-446
+(apply_ufunc dispatch), 468-479 (MultiIndex coords).
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import flox_tpu.xarray as fxr
+from flox_tpu import xrlite
+
+CALLS: dict[str, list] = {}
+
+
+def _to_mock(da):
+    if isinstance(da, xrlite.DataArray) and not isinstance(da, MockDataArray):
+        m = MockDataArray.__new__(MockDataArray)
+        # xrlite.DataArray is slotted; copy every slot up the MRO
+        for cls in type(da).__mro__:
+            for s in getattr(cls, "__slots__", ()):
+                if hasattr(da, s):
+                    object.__setattr__(m, s, getattr(da, s))
+        return m
+    return da
+
+
+class MockCoordinates:
+    """xr.Coordinates stand-in: only the classmethod the adapter calls."""
+
+    def __init__(self, mapping):
+        self.mapping = mapping
+
+    @classmethod
+    def from_pandas_multiindex(cls, midx, dim):
+        assert isinstance(midx, pd.MultiIndex), (
+            "real xarray's Coordinates.from_pandas_multiindex requires a "
+            f"pandas.MultiIndex, got {type(midx)}"
+        )
+        CALLS.setdefault("from_pandas_multiindex", []).append(dim)
+        return cls({dim: midx})
+
+
+class MockDataArray(xrlite.DataArray):
+    """xrlite array wearing real xarray's reduction-method surface."""
+
+    def assign_coords(self, coords):
+        if isinstance(coords, MockCoordinates):
+            coords = coords.mapping
+        return _to_mock(super().assign_coords(coords))
+
+    def _delegate(self, base, dim, skipna, keep_attrs, **kw):
+        CALLS.setdefault(base, []).append(
+            {"dim": dim, "skipna": skipna, "keep_attrs": keep_attrs, **kw}
+        )
+        dims = [dim] if not isinstance(dim, (list, tuple)) else list(dim)
+        axes = tuple(list(self.dims).index(d) for d in dims)
+        data = np.asarray(self.data)
+        if base in ("argmax", "argmin"):
+            # real xarray returns a DICT for a sequence dim= — the adapter
+            # must pass a scalar or the result type changes under it
+            assert not isinstance(dim, (list, tuple)), (
+                "argmax/argmin with a list dim returns a dict in real "
+                "xarray; the adapter must pass a scalar dim"
+            )
+            fn = getattr(np, ("nan" + base) if skipna else base)
+            out = fn(data, axis=axes[0])
+        elif base == "quantile":
+            q = kw.pop("q")
+            out = (np.nanquantile if skipna else np.quantile)(data, q, axis=axes, **kw)
+        elif base == "count":
+            out = np.sum(~np.isnan(data), axis=axes)
+        else:
+            fn = getattr(np, ("nan" + base) if skipna else base)
+            out = fn(data, axis=axes, **kw)
+        out_dims = tuple(d for d in self.dims if d not in dims)
+        return MockDataArray(
+            out, dims=out_dims, name=self.name,
+            attrs=dict(self.attrs) if keep_attrs else {},
+        )
+
+
+def _add_delegates():
+    for base in ("sum", "mean", "max", "min", "prod", "var", "std", "median",
+                 "quantile", "argmax", "argmin", "count"):
+        def method(self, dim=None, *, skipna=None, keep_attrs=None,
+                   _base=base, **kw):
+            return self._delegate(_base, dim, skipna, keep_attrs, **kw)
+        setattr(MockDataArray, base, method)
+
+
+_add_delegates()
+
+
+def _mock_apply_ufunc(func, *args, **kwargs):
+    # pin the exact keyword contract the adapter relies on: real xarray
+    # would TypeError on an unknown kwarg and behave differently without
+    # join/dask set — drift here is what this test exists to catch
+    CALLS.setdefault("apply_ufunc", []).append(set(kwargs))
+    expected = {"input_core_dims", "output_core_dims", "dask", "keep_attrs",
+                "vectorize", "join", "dataset_fill_value"}
+    assert set(kwargs) == expected, (
+        f"apply_ufunc called with {set(kwargs)} != real-xarray contract {expected}"
+    )
+    assert kwargs["dask"] == "forbidden"
+    assert kwargs["join"] == "exact"
+    assert kwargs["vectorize"] is False
+    assert len(kwargs["input_core_dims"]) == len(args)
+    out = xrlite.apply_ufunc(func, *args, **kwargs)
+    return _to_mock(out)
+
+
+def _build_mock_xarray():
+    mod = types.ModuleType("xarray")
+    mod.DataArray = MockDataArray
+    mod.Dataset = xrlite.Dataset
+    mod.broadcast = xrlite.broadcast
+    mod.apply_ufunc = _mock_apply_ufunc
+    mod.Coordinates = MockCoordinates
+    return mod
+
+
+@pytest.fixture()
+def real_xr(monkeypatch):
+    import flox_tpu.utils
+
+    mod = _build_mock_xarray()
+    monkeypatch.setitem(sys.modules, "xarray", mod)
+    monkeypatch.setattr(flox_tpu.utils, "HAS_XARRAY", True)
+    monkeypatch.setattr(fxr, "HAS_XARRAY", True)
+    CALLS.clear()
+    return mod
+
+
+def test_get_xr_binds_to_installed_xarray(real_xr):
+    assert fxr._get_xr() is real_xr
+
+
+def test_plain_reduce_delegates_to_obj_method(real_xr):
+    # reducing over a dim the groupers don't span: the adapter must call
+    # obj.mean(dim=..., skipna=True, keep_attrs=...) — xarray.py:102-109
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(4, 10))
+    data[0, 0] = np.nan
+    obj = MockDataArray(data, dims=("x", "t"), name="v", attrs={"units": "K"})
+    by = MockDataArray(np.array([0, 0, 1, 1]), dims=("x",), name="g")
+    out = fxr.xarray_reduce(obj, by, func="nanmean", dim="t")
+    assert CALLS["mean"] == [{"dim": ["t"], "skipna": True, "keep_attrs": True}]
+    assert isinstance(out, MockDataArray)
+    np.testing.assert_allclose(np.asarray(out.data), np.nanmean(data, axis=1))
+    assert out.attrs == {"units": "K"}
+    # skipna=False spelling: plain variant, no skipna kwarg injected
+    fxr.xarray_reduce(obj, by, func="mean", dim="t", keep_attrs=False)
+    assert CALLS["mean"][-1] == {"dim": ["t"], "skipna": None, "keep_attrs": False}
+
+
+def test_plain_reduce_var_forwards_finalize_kwargs(real_xr):
+    rng = np.random.default_rng(1)
+    data = rng.normal(size=(4, 10))
+    obj = MockDataArray(data, dims=("x", "t"))
+    by = MockDataArray(np.array([0, 0, 1, 1]), dims=("x",), name="g")
+    out = fxr.xarray_reduce(obj, by, func="var", dim="t", ddof=1)
+    assert CALLS["var"] == [{"dim": ["t"], "skipna": None, "keep_attrs": True, "ddof": 1}]
+    np.testing.assert_allclose(np.asarray(out.data), data.var(axis=1, ddof=1))
+
+
+def test_plain_reduce_argmax_passes_scalar_dim(real_xr):
+    rng = np.random.default_rng(2)
+    data = rng.normal(size=(4, 10))
+    obj = MockDataArray(data, dims=("x", "t"))
+    by = MockDataArray(np.array([0, 0, 1, 1]), dims=("x",), name="g")
+    out = fxr.xarray_reduce(obj, by, func="argmax", dim="t")
+    assert CALLS["argmax"] == [{"dim": "t", "skipna": None, "keep_attrs": True}]
+    np.testing.assert_array_equal(np.asarray(out.data), np.argmax(data, axis=1))
+
+
+def test_grouped_path_uses_apply_ufunc_contract(real_xr):
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(3, 12))
+    obj = MockDataArray(data, dims=("x", "t"), name="v")
+    by = MockDataArray(np.arange(12) % 4, dims=("t",), name="g")
+    out = fxr.xarray_reduce(obj, by, func="sum")
+    assert len(CALLS["apply_ufunc"]) == 1
+    oracle = np.stack([data[:, np.arange(12) % 4 == g].sum(-1) for g in range(4)], -1)
+    np.testing.assert_allclose(np.asarray(out.data), oracle, rtol=1e-12)
+    np.testing.assert_array_equal(np.asarray(out["g"].data), np.arange(4))
+
+
+def test_multiindex_groups_use_coordinates_api(real_xr):
+    # grouping by a MultiIndex-backed coord: the adapter must build the
+    # coordinate via Coordinates.from_pandas_multiindex on real xarray
+    # (modern xarray rejects a raw MultiIndex in assign_coords)
+    mi = pd.MultiIndex.from_product([["a", "b"], [0, 1]], names=("letter", "num"))
+    labels = mi.take(np.array([0, 1, 2, 3, 0, 1, 2, 3]))
+    da = MockDataArray(
+        np.arange(8.0), dims=("sample",), coords={"stacked": ("sample", labels)}
+    )
+    out = fxr.xarray_reduce(da, "stacked", func="sum")
+    assert CALLS["from_pandas_multiindex"] == ["stacked"]
+    groups = out["stacked"].data
+    assert isinstance(groups, pd.MultiIndex)
+    assert list(groups.names) == ["letter", "num"]
+    np.testing.assert_allclose(np.asarray(out.data), [4.0, 6.0, 8.0, 10.0])
