@@ -1,0 +1,130 @@
+"""Per-round performance regression gate (VERDICT r3 #7).
+
+The reference gates every PR with ``asv continuous --factor 1.5``
+(reference .github/workflows/benchmarks.yml:35-58). Here the recorded
+round benchmarks are the asv history: each round appends
+``BENCH_HISTORY/r{N}_{platform}.jsonl`` (see BENCH_HISTORY/README.md),
+and this test compares the latest file against the previous one, per
+benchmark FAMILY (the name before ``[``), on the geometric mean of the
+common-row ratios — a real code regression slows a family's rows
+together and moves the geomean, while single-row timer noise is diluted.
+
+Two tiers, because asv-continuous reruns both commits back-to-back on
+one quiet host and a driver round comparing records from different
+sessions cannot (observed cross-round swings on this shared host reach
+2-3x on code that did not change):
+
+* absolute — latest vs previous wall-clock, threshold
+  ``FLOX_BENCH_REGRESSION_THRESHOLD`` (default 2.0): the gross-regression
+  backstop.
+* normalized — the jax-engine row divided by the SAME round's numpy-engine
+  row for the same workload, compared across rounds, threshold
+  ``FLOX_BENCH_REGRESSION_THRESHOLD_NORM`` (default 1.5, the reference's
+  ASV_FACTOR): host speed cancels in the quotient, so this is the
+  sensitive instrument for regressions in the jax compute path.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+from collections import defaultdict
+
+import pytest
+
+HISTORY = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "BENCH_HISTORY")
+
+
+def _round_files(platform: str) -> list[str]:
+    if not os.path.isdir(HISTORY):
+        return []
+    pat = re.compile(rf"^r(\d+)_{platform}\.jsonl$")
+    found = []
+    for f in os.listdir(HISTORY):
+        m = pat.match(f)
+        if m:
+            found.append((int(m.group(1)), os.path.join(HISTORY, f)))
+    return [p for _, p in sorted(found)]
+
+
+def _load(path: str) -> dict[str, tuple[float, str]]:
+    rows = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if isinstance(rec.get("value"), (int, float)):
+                rows[rec["bench"]] = (float(rec["value"]), rec.get("unit", ""))
+    return rows
+
+
+def _family(bench: str) -> str:
+    return bench.split("[", 1)[0]
+
+
+def _ratio(latest, prev, bench) -> float | None:
+    """latest/prev regression ratio for one bench; >1 means slower."""
+    if bench not in latest or bench not in prev:
+        return None
+    (val, unit), (pval, punit) = latest[bench], prev[bench]
+    if unit != punit or val <= 0 or pval <= 0:
+        return None
+    if unit == "ms":
+        return val / pval  # lower is better
+    if unit == "GB/s":
+        return pval / val  # higher is better
+    return None
+
+
+def _gate(ratios: dict[str, list[tuple[str, float]]], threshold: float, label: str):
+    failures = []
+    for family, rows in sorted(ratios.items()):
+        geomean = math.exp(sum(math.log(r) for _, r in rows) / len(rows))
+        if geomean > threshold:
+            worst = max(rows, key=lambda t: t[1])
+            failures.append(
+                f"{family}: {label} geomean {geomean:.2f}x over {len(rows)} "
+                f"rows (worst {worst[0]} at {worst[1]:.2f}x)"
+            )
+    return failures
+
+
+@pytest.mark.parametrize("platform", ["cpu", "tpu"])
+def test_no_regression_vs_previous_round(platform):
+    files = _round_files(platform)
+    if len(files) < 2:
+        pytest.skip(f"fewer than two {platform} rounds recorded")
+    prev, latest = _load(files[-2]), _load(files[-1])
+    thr_abs = float(os.environ.get("FLOX_BENCH_REGRESSION_THRESHOLD", "2.0"))
+    thr_norm = float(os.environ.get("FLOX_BENCH_REGRESSION_THRESHOLD_NORM", "1.5"))
+
+    absolute: dict[str, list[tuple[str, float]]] = defaultdict(list)
+    normalized: dict[str, list[tuple[str, float]]] = defaultdict(list)
+    for bench in latest:
+        r = _ratio(latest, prev, bench)
+        if r is None:
+            continue
+        absolute[_family(bench)].append((bench, r))
+        # host-invariant: jax row / the same round's numpy row
+        if "jax]" in bench:
+            sibling = bench.replace("-jax]", "-numpy]").replace("[jax]", "[numpy]")
+            rs = _ratio(latest, prev, sibling)
+            if rs is not None:
+                normalized[_family(bench)].append((bench, r / rs))
+
+    assert absolute, (
+        f"no comparable rows between {files[-2]} and {files[-1]} — "
+        "did the bench names change?"
+    )
+    failures = _gate(absolute, thr_abs, "absolute") + _gate(
+        normalized, thr_norm, "jax-vs-numpy normalized"
+    )
+    assert not failures, (
+        f"performance regressed vs {os.path.basename(files[-2])}:\n  "
+        + "\n  ".join(failures)
+    )
