@@ -350,13 +350,24 @@ def main() -> None:
     on_accel = backend != "cpu"
     quantile_gbps = None
     if on_accel or os.environ.get("FLOX_TPU_BENCH_FORCE_SWEEP"):
+        # sweep BOTH order-statistics lowerings (VERDICT r3 #3): the two-key
+        # lax.sort path vs the sort-free radix-select (nbits segment-sum
+        # counting passes on the MXU). The recorded dict is the measurement
+        # that decides the "auto" policy.
+        import flox_tpu
+
         q_rows = min(nlat * nlon, max(1, int(1.0e9) // (ntime * 4)))
-        try:
-            tq = measure_impl("nanquantile", dev_data[:q_rows], q=0.9)
-            quantile_gbps = round(q_rows * ntime * 4 / tq / 1e9, 2)
-        except Exception as exc:  # noqa: BLE001 — keep the headline alive
-            print(f"flox-tpu bench: quantile measurement failed: {exc}",
-                  file=sys.stderr, flush=True)
+        quantile_gbps = {}
+        for qimpl in ("sort", "select"):
+            try:
+                with flox_tpu.set_options(quantile_impl=qimpl):
+                    tq = measure_impl("nanquantile", dev_data[:q_rows], q=0.9)
+                quantile_gbps[qimpl] = round(q_rows * ntime * 4 / tq / 1e9, 2)
+            except Exception as exc:  # noqa: BLE001 — keep the headline alive
+                print(f"flox-tpu bench: quantile[{qimpl}] failed: {exc}",
+                      file=sys.stderr, flush=True)
+                quantile_gbps[qimpl] = None
+            jax.clear_caches()
     # one shared field set: the persisted hardware record and the stdout
     # line must never drift apart about what was measured
     core = {

@@ -56,6 +56,12 @@ OPTIONS: dict[str, Any] = {
     # the scan kernel's carry gather/update matmuls scale with the group
     # count; past ~the lane-tile width they dominate the triangular matmul
     "pallas_scan_num_groups_max": 128,
+    # grouped order statistics: "sort" = two-key lexicographic lax.sort;
+    # "select" = sort-free MSB radix bisection — nbits counting passes,
+    # each a segment-sum riding the MXU one-hot GEMM / Pallas path. "auto"
+    # currently resolves to sort; the bench sweep measures both on chip
+    # (VERDICT r3 #3) and auto flips when hardware numbers justify it.
+    "quantile_impl": "auto",
     # HBM ceiling for dense (..., size) device intermediates (VERDICT r3 #6:
     # a ~10^6-label run used to OOM with no guard). Estimated footprint
     # above this either auto-routes map-reduce/cohorts to the blocked
@@ -83,6 +89,7 @@ _VALIDATORS = {
     "scan_impl": lambda x: x in ("auto", "segmented", "pallas"),
     "pallas_scan_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
     "dense_intermediate_bytes_max": lambda x: isinstance(x, int) and x >= 2**20,
+    "quantile_impl": lambda x: x in ("auto", "sort", "select"),
 }
 
 
@@ -102,6 +109,7 @@ def trace_fingerprint() -> tuple:
         OPTIONS["pallas_minmax_num_groups_max"],
         OPTIONS["scan_impl"],
         OPTIONS["pallas_scan_num_groups_max"],
+        OPTIONS["quantile_impl"],
     )
 
 

@@ -1056,3 +1056,88 @@ def test_var_int_dtype_request_keeps_nan_mask():
         size=1, dtype=np.int32, skipna=True,
     )
     assert float(np.asarray(ch.arrays[2])[0]) == 2.0
+
+
+class TestRadixSelectQuantile:
+    """quantile_impl="select": sort-free MSB radix bisection must be
+    BIT-IDENTICAL to the two-key-sort path (both produce exact order
+    statistics, then share the interpolation code)."""
+
+    METHODS = ("linear", "lower", "higher", "nearest", "midpoint",
+               "hazen", "weibull", "interpolated_inverted_cdf",
+               "median_unbiased", "normal_unbiased")
+
+    def _both(self, func, codes, data, size, **kw):
+        import flox_tpu
+
+        a = np.asarray(kernels.generic_kernel(func, codes, data, size=size, **kw))
+        with flox_tpu.set_options(quantile_impl="select"):
+            b = np.asarray(kernels.generic_kernel(func, codes, data, size=size, **kw))
+        return a, b
+
+    @pytest.mark.parametrize("method", METHODS)
+    @pytest.mark.parametrize("func", ["quantile", "nanquantile"])
+    def test_all_methods_bit_exact(self, func, method):
+        rng = np.random.default_rng(11)
+        n = 3000
+        codes = rng.integers(0, 6, n)
+        data = np.round(rng.normal(size=n), 2)  # heavy duplicates
+        # NaNs confined to groups 0-1: propagate mode ("quantile") must
+        # still select REAL values in groups 2-5 — NaN everywhere would
+        # make the skipna=False leg vacuously pass on all-NaN outputs
+        nan_rows = (rng.random(n) < 0.4) & (codes <= 1)
+        data[nan_rows] = np.nan
+        data[3], data[9] = np.inf, -np.inf
+        a, b = self._both(func, codes, data, 6, q=0.7, method=method)
+        np.testing.assert_array_equal(a, b)
+        # groups 2-5 hold no NaN values, so even propagate mode must have
+        # selected real values there (the comparison is not all-NaN-vs-all-NaN)
+        assert not np.isnan(a[2:]).any()
+
+    def test_vector_q_2d_f32(self):
+        rng = np.random.default_rng(12)
+        codes = rng.integers(0, 5, 700)
+        data = rng.normal(size=(3, 700)).astype(np.float32)
+        data[:, rng.random(700) < 0.2] = np.nan
+        a, b = self._both("nanquantile", codes, data, 5, q=[0.0, 0.25, 0.9, 1.0])
+        np.testing.assert_array_equal(a, b)
+
+    def test_empty_and_allnan_groups(self):
+        codes = np.array([0, 0, 2, 2, 3])
+        data = np.array([1.0, 2.0, np.nan, np.nan, 5.0])
+        a, b = self._both("nanquantile", codes, data, 5, q=0.5)
+        np.testing.assert_array_equal(a, b)
+        assert np.isnan(b[[1, 2, 4]]).all()  # empty g1/g4, all-NaN g2
+        np.testing.assert_allclose(b[[0, 3]], [1.5, 5.0])
+
+    def test_bf16_sixteen_bit_radix(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(13)
+        codes = rng.integers(0, 4, 400)
+        data = jnp.asarray(rng.normal(size=400), jnp.bfloat16)
+        a, b = self._both("nanquantile", codes, data, 4, q=0.5)
+        np.testing.assert_array_equal(
+            np.asarray(a, np.float32), np.asarray(b, np.float32)
+        )
+
+    def test_median_and_missing_labels(self):
+        rng = np.random.default_rng(14)
+        codes = rng.integers(-1, 4, 900)  # -1 = missing, must drop out
+        data = rng.normal(size=900)
+        a, b = self._both("nanmedian", codes, data, 4)
+        np.testing.assert_array_equal(a, b)
+
+    def test_oracle_linear(self):
+        # independent anchor: select matches np.nanquantile directly
+        import flox_tpu
+
+        rng = np.random.default_rng(15)
+        codes = rng.integers(0, 3, 500)
+        data = rng.normal(size=500)
+        with flox_tpu.set_options(quantile_impl="select"):
+            got = np.asarray(
+                kernels.generic_kernel("nanquantile", codes, data, size=3, q=0.3)
+            )
+        want = np.array([np.nanquantile(data[codes == g], 0.3) for g in range(3)])
+        np.testing.assert_allclose(got, want, rtol=1e-12)

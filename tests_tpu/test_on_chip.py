@@ -246,3 +246,23 @@ def test_groupby_reduce_binned(tpu):
     cut = pd.cut(by, bins.left.tolist() + [bins.right[-1]])
     want = pd.Series(vals.astype(np.float64)).groupby(cut, observed=False).sum()
     np.testing.assert_allclose(np.asarray(result), want.to_numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_radix_select_quantile_matches_sort_on_chip(tpu):
+    # the sort-free order-statistics lowering (radix bisection over MXU
+    # segment-sum counts) must agree with the two-key lax.sort path ON THE
+    # REAL CHIP — interpret-mode equality does not cover Mosaic/XLA-TPU
+    # lowering differences in the counting passes
+    import jax.numpy as jnp
+
+    import flox_tpu
+    from flox_tpu.kernels import generic_kernel
+
+    n = 26304
+    codes = ((np.arange(n) // 24) % 365).astype(np.int32) % 12
+    vals = jnp.asarray(RNG.normal(280.0, 10.0, size=(16, n)).astype(np.float32))
+    with flox_tpu.set_options(quantile_impl="sort"):
+        a = np.asarray(generic_kernel("nanquantile", codes, vals, size=12, q=0.9))
+    with flox_tpu.set_options(quantile_impl="select"):
+        b = np.asarray(generic_kernel("nanquantile", codes, vals, size=12, q=0.9))
+    np.testing.assert_array_equal(a, b)
