@@ -107,6 +107,22 @@ def bench_era5_dayofyear(engine: str, scale: str):
     return [{"bench": f"era5_dayofyear[{engine}]", "value": round(gbps, 2), "unit": "GB/s"}]
 
 
+def bench_era5_resampling(engine: str, scale: str):
+    """ERA5 hourly->daily resampling (reference cohorts.py:119-132): many
+    output groups (365/y), each spanning exactly 24 consecutive steps."""
+    from flox_tpu import groupby_reduce
+
+    nyears = 5 if scale == "full" else 1
+    nt = nyears * 365 * 24
+    nspace = 37 * 72 if scale == "full" else 24 * 24
+    day = (np.arange(nt) // 24).astype(np.int64)
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(nspace, nt)).astype(np.float32)
+    t = _timeit(lambda: _block(groupby_reduce(vals, day, func="mean", engine=engine)[0]))
+    gbps = vals.nbytes / t / 1e9
+    return [{"bench": f"era5_resampling[{engine}]", "value": round(gbps, 2), "unit": "GB/s"}]
+
+
 def bench_nwm_zonal(engine: str, scale: str):
     """NWM county zonal stats: 2-D labels, ~900 groups (cohorts.py:84-97)."""
     from flox_tpu import groupby_reduce
@@ -249,6 +265,7 @@ def main() -> None:
         results += bench_reduce_bare(engine)
         results += bench_quantile(engine, args.scale)
         results += bench_era5_dayofyear(engine, args.scale)
+        results += bench_era5_resampling(engine, args.scale)
         results += bench_nwm_zonal(engine, args.scale)
         results += bench_random_big(engine, args.scale)
         results += bench_scan(engine, args.scale)

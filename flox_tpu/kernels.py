@@ -980,26 +980,39 @@ def _group_sort(codes, data):
 
 
 def _uint_type(dtype):
-    return {2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}[jnp.dtype(dtype).itemsize]
+    return {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}[
+        jnp.dtype(dtype).itemsize
+    ]
 
 
 def _monotonic_uint(data):
-    """Order-preserving unsigned-integer view of float data: negative
-    floats bit-invert, non-negatives set the sign bit — unsigned compare
-    then matches IEEE total order (NaN above +inf)."""
+    """Order-preserving unsigned-integer view: floats use the IEEE sign
+    trick (negatives bit-invert, non-negatives set the sign bit — unsigned
+    compare then matches total order, NaN above +inf); signed ints flip
+    the sign bit (two's complement is already ordered below it); unsigned
+    ints pass through."""
     ut = _uint_type(data.dtype)
     nbits = jnp.dtype(ut).itemsize * 8
     bits = jax.lax.bitcast_convert_type(data, ut)
     sign = jnp.asarray(1, ut) << (nbits - 1)
-    return jnp.where((bits & sign) != 0, ~bits, bits | sign)
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        return jnp.where((bits & sign) != 0, ~bits, bits | sign)
+    if jnp.issubdtype(data.dtype, jnp.signedinteger):
+        return bits ^ sign
+    return bits
 
 
-def _uint_to_float(key, dtype):
+def _uint_to_value(key, dtype):
     ut = _uint_type(dtype)
     nbits = jnp.dtype(ut).itemsize * 8
     sign = jnp.asarray(1, ut) << (nbits - 1)
-    bits = jnp.where((key & sign) != 0, key ^ sign, ~key)
-    return jax.lax.bitcast_convert_type(bits, dtype)
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        bits = jnp.where((key & sign) != 0, key ^ sign, ~key)
+    elif jnp.issubdtype(jnp.dtype(dtype), jnp.signedinteger):
+        bits = key ^ sign
+    else:
+        bits = key
+    return jax.lax.bitcast_convert_type(bits.astype(ut), dtype)
 
 
 def _radix_select(data, codes, size, ranks, valid_mask):
@@ -1059,7 +1072,7 @@ def _radix_select(data, codes, size, ranks, valid_mask):
         )
 
     prefix, _ = jax.lax.fori_loop(0, nbits, body, state0)
-    return _uint_to_float(prefix, data.dtype)
+    return _uint_to_value(prefix, data.dtype)
 
 
 def _quantile_impl_choice() -> str:

@@ -1141,3 +1141,17 @@ class TestRadixSelectQuantile:
             )
         want = np.array([np.nanquantile(data[codes == g], 0.3) for g in range(3)])
         np.testing.assert_allclose(got, want, rtol=1e-12)
+
+    @pytest.mark.parametrize("dt", [np.int32, np.int64, np.int8, np.uint16])
+    def test_integer_dtype_request_bit_exact(self, dt):
+        # an explicit integer dtype skips the float cast: the monotonic key
+        # must order two's-complement negatives correctly (review finding)
+        rng = np.random.default_rng(21)
+        codes = rng.integers(0, 4, 600)
+        lo = -120 if np.issubdtype(dt, np.signedinteger) else 0
+        data = rng.integers(lo, 120, 600).astype(dt)
+        for method in ("lower", "linear"):
+            a, b = self._both(
+                "quantile", codes, data, 4, q=0.4, method=method, dtype=dt
+            )
+            np.testing.assert_array_equal(a, b)
