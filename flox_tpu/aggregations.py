@@ -288,6 +288,31 @@ def is_supported_aggregation(func: str) -> bool:
 # ---------------------------------------------------------------------------
 
 
+def set_nat_final_fill(agg: "Aggregation", fill_value) -> None:
+    """Dtype-preserving datetime reductions: the missing marker is NaT
+    (INT64_MIN on the int64 view), never float NaN — float would corrupt
+    ns-resolution timestamps; an explicit datetime/NaT fill is viewed to
+    its int64 representation. ONE implementation shared by the eager core
+    and the streaming runtime so the NaT discipline cannot drift."""
+    if fill_value is None:
+        agg.final_fill_value = np.iinfo(np.int64).min
+    elif isinstance(agg.final_fill_value, (np.datetime64, np.timedelta64)):
+        agg.final_fill_value = int(agg.final_fill_value.astype("int64"))
+    agg.final_dtype = np.dtype("int64")
+
+
+def shift_nat_identity_fills(agg: "Aggregation") -> None:
+    """The NINF-resolved empty fill (iinfo.min) is byte-identical to the
+    NaT marker; shift it so groups absent from a shard/slab are not
+    mistaken for NaT-containing ones by marker re-injection. Shared by the
+    mesh programs and the streaming runtime."""
+    nat = np.iinfo(np.int64).min
+    agg.fill_value["intermediate"] = tuple(
+        (fv + 1 if isinstance(fv, (int, np.integer)) and fv == nat else fv)
+        for fv in agg.fill_value.get("intermediate", ())
+    )
+
+
 def _initialize_aggregation(
     func: str | Aggregation,
     dtype,

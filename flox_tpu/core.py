@@ -516,14 +516,9 @@ def groupby_reduce(
         fill_value, min_count_, finalize_kwargs
     )
     if datetime_dtype is not None and agg.preserves_dtype:
-        # missing marker for datetimes is NaT (INT64_MIN), never float NaN:
-        # going through float would corrupt ns-resolution timestamps; an
-        # explicit datetime/NaT fill is viewed to its int64 representation
-        if fill_value is None:
-            agg.final_fill_value = _NAT_INT
-        elif isinstance(agg.final_fill_value, (np.datetime64, np.timedelta64)):
-            agg.final_fill_value = int(agg.final_fill_value.astype("int64"))
-        agg.final_dtype = np.dtype("int64")
+        from .aggregations import set_nat_final_fill
+
+        set_nat_final_fill(agg, fill_value)
     elif (
         datetime_dtype is not None
         and agg.reduction_type != "argreduce"

@@ -131,16 +131,46 @@ def streaming_groupby_reduce(
         raise ValueError("No groups to reduce over (empty expected_groups?)")
 
     probe = np.asarray(loader(0, 1))  # one probe: dtype AND lead shape
-    if dtps.is_datetime_like(probe.dtype):
-        raise NotImplementedError(
-            "datetime64/timedelta64 streaming is not supported (the slab "
-            "merges carry no NaT channel); use groupby_reduce — the eager "
-            "and mesh paths handle NaT natively."
+    datetime_dtype = probe.dtype if dtps.is_datetime_like(probe.dtype) else None
+    nat = False
+    if datetime_dtype is not None and not utils.x64_enabled():
+        raise ValueError(
+            "datetime/timedelta streaming needs jax_enable_x64 (int64 NaT "
+            "sentinels do not survive the int32 downcast)."
         )
     agg = _initialize_aggregation(
-        func, dtype, probe.dtype, fill_value,
-        0 if min_count is None else min_count, finalize_kwargs,
+        func, dtype,
+        probe.dtype if datetime_dtype is None else np.dtype("int64"),
+        fill_value, 0 if min_count is None else min_count, finalize_kwargs,
     )
+    if datetime_dtype is not None:
+        # same dtype round-trips as core.groupby_reduce (core.py:495-541),
+        # applied PER SLAB so the conversion streams with the data
+        from .core import _NAT_INT
+
+        base_loader = loader
+        if agg.preserves_dtype:
+            # min/max/first/last: exact int64 view, NaT as the sentinel
+            from .aggregations import set_nat_final_fill
+
+            nat = True
+            set_nat_final_fill(agg, fill_value)
+            loader = lambda s, e: np.asarray(base_loader(s, e)).view("int64")
+        elif agg.reduction_type == "argreduce" or agg.name in (
+            "count", "len", "any", "all"
+        ):
+            nat = True
+            loader = lambda s, e: np.asarray(base_loader(s, e)).view("int64")
+        else:
+            # float-returning reductions (mean/var/std/sum): f64 epoch
+            # values with NaT -> NaN, rounded back in _astype_final
+            def loader(s, e):
+                sl = np.asarray(base_loader(s, e)).view("int64")
+                f = sl.astype(np.float64)
+                f[sl == _NAT_INT] = np.nan
+                return f
+
+        probe = np.asarray(loader(0, 1))
     if agg.blockwise_only:
         raise NotImplementedError(
             f"{agg.name!r} needs whole groups at once and cannot stream; "
@@ -169,7 +199,14 @@ def streaming_groupby_reduce(
     skipna = agg.name.startswith("nan") or agg.name == "count"
     count_skipna = skipna or agg.min_count > 0
 
-    step = _build_step(agg, size=size, batch_len=batch_len, count_skipna=count_skipna)
+    if nat:
+        from .aggregations import shift_nat_identity_fills
+
+        shift_nat_identity_fills(agg)
+
+    step = _build_step(
+        agg, size=size, batch_len=batch_len, count_skipna=count_skipna, nat=nat
+    )
 
     state = None
     for i in range(nbatches):
@@ -198,7 +235,7 @@ def streaming_groupby_reduce(
     result = _apply_final_fill(result, counts, agg)
     from .core import _astype_final, _index_values
 
-    result = _astype_final(result, agg, None)
+    result = _astype_final(result, agg, datetime_dtype)
     # (..., size) -> (..., *keep_by, *groups): kept by-dims ride the group
     # axis as disjoint code ranges (factorize_ offsetting) and unfold here
     out_shape = tuple(lead_shape) + tuple(keep_by_shape) + grp_shape
@@ -207,7 +244,8 @@ def streaming_groupby_reduce(
     return (result,) + tuple(_index_values(g) for g in found_groups)
 
 
-def _build_step(agg: Aggregation, *, size: int, batch_len: int, count_skipna: bool):
+def _build_step(agg: Aggregation, *, size: int, batch_len: int, count_skipna: bool,
+                nat: bool = False):
     """One jitted step: slab -> chunk intermediates -> merge into state."""
     import jax
     import jax.numpy as jnp
@@ -218,27 +256,33 @@ def _build_step(agg: Aggregation, *, size: int, batch_len: int, count_skipna: bo
     arg_of_max = agg.reduction_type == "argreduce" and "max" in str(agg.chunk[1])
     is_last = agg.combine == ("last",)
     is_first = agg.combine == ("first",)
+    skipna = agg.name.startswith("nan")
+    kw = {"nat": True} if nat else {}
 
     def slab_stats(slab, ccodes, offset):
-        counts = _local_counts(ccodes, slab, size, count_skipna, False)
+        counts = _local_counts(ccodes, slab, size, count_skipna, nat)
         if agg.reduction_type == "argreduce":
             val_f, arg_f = agg.chunk
             val = generic_kernel(
                 val_f, ccodes, slab, size=size,
-                fill_value=agg.fill_value["intermediate"][0],
+                fill_value=agg.fill_value["intermediate"][0], **kw,
             )
-            local_arg = generic_kernel(arg_f, ccodes, slab, size=size, fill_value=-1)
+            local_arg = generic_kernel(arg_f, ccodes, slab, size=size, fill_value=-1, **kw)
             gidx = jnp.where(local_arg >= 0, local_arg + offset, -1)
             return [val, gidx], counts
         if is_first or is_last:
             from .parallel.mapreduce import _local_firstlast
 
             val, pos = _local_firstlast(
-                ccodes, slab, size, skipna=agg.name.startswith("nan"),
-                last=is_last, nat=False, offset=offset,
+                ccodes, slab, size, skipna=skipna,
+                last=is_last, nat=nat, offset=offset,
             )
             return [val, pos], counts
-        return _local_chunk(agg, ccodes, slab, size, False), counts
+        return _local_chunk(agg, ccodes, slab, size, nat), counts
+
+    # NaT marker re-injection applies only to propagating (non-skipna)
+    # merges — skipna identity fills were shifted off the sentinel above
+    nat_markers = nat and not skipna
 
     def merge(state, inters, counts):
         acc_inters, acc_counts = state
@@ -250,6 +294,13 @@ def _build_step(agg: Aggregation, *, size: int, batch_len: int, count_skipna: bo
             tie = vb == va
             if jnp.issubdtype(va.dtype, jnp.floating):
                 tie = tie | (jnp.isnan(va) & jnp.isnan(vb))
+            if nat_markers:
+                # NaT-propagating: a NaT extreme wins over any value (its
+                # position is the group's first NaT); both-NaT is already a
+                # tie through integer equality
+                marker = jnp.asarray(np.iinfo(np.int64).min, va.dtype)
+                na_, nb_ = va == marker, vb == marker
+                better = (better & ~na_ & ~nb_) | (nb_ & ~na_)
             ia_safe = jnp.where(ia >= 0, ia, _BIG)
             ib_safe = jnp.where(ib >= 0, ib, _BIG)
             idx = jnp.where(better, ib_safe, jnp.where(tie, jnp.minimum(ia_safe, ib_safe), ia_safe))
@@ -264,7 +315,7 @@ def _build_step(agg: Aggregation, *, size: int, batch_len: int, count_skipna: bo
             out = [jnp.where(take_b, vb, va), jnp.where(take_b, pb, pa)]
         else:
             for a, b, op in zip(acc_inters, inters, agg.combine):
-                out.append(_pair_merge(op, a, b))
+                out.append(_pair_merge(op, a, b, nat=nat_markers))
         return out, acc_counts + counts
 
     def step(state, slab, ccodes, offset):
@@ -292,12 +343,21 @@ def _argmerge_better(va, vb, arg_of_max: bool):
     return better
 
 
-def _pair_merge(op, a, b):
+def _pair_merge(op, a, b, nat: bool = False):
     """Sequential form of the mesh collectives (parallel/mapreduce.py):
     psum -> add, pmax -> maximum, the var triple -> the Chan update
-    (reference _var_combine, aggregations.py:392-451)."""
+    (reference _var_combine, aggregations.py:392-451). ``nat`` re-injects
+    the NaT marker through min/max exactly as _combine_simple does."""
     import jax.numpy as jnp
 
+    if op in ("max", "min") and nat and jnp.issubdtype(a.dtype, jnp.signedinteger):
+        # the signedinteger guard matches _combine_simple
+        # (parallel/mapreduce.py): bool intermediates (the 'all'/'any'
+        # combines) must NOT compare against the int64 marker — the cast
+        # marker is True and would absorb every merge
+        m = jnp.maximum(a, b) if op == "max" else jnp.minimum(a, b)
+        marker = jnp.asarray(np.iinfo(np.int64).min, a.dtype)
+        return jnp.where((a == marker) | (b == marker), marker, m)
     if op == "var":
         m2a, ta, na = a.arrays
         m2b, tb, nb = b.arrays
