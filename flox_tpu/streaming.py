@@ -169,8 +169,10 @@ def streaming_groupby_reduce(
                 f = sl.astype(np.float64)
                 f[sl == _NAT_INT] = np.nan
                 return f
-
-        probe = np.asarray(loader(0, 1))
+        # no re-probe: the wrap changes dtype only between 8-byte types
+        # (datetime64 -> int64/float64), so lead shape and itemsize — the
+        # only things probe feeds — are unchanged, and a zarr/S3 loader
+        # should not pay a second remote chunk read
     if agg.blockwise_only:
         raise NotImplementedError(
             f"{agg.name!r} needs whole groups at once and cannot stream; "

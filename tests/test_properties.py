@@ -103,3 +103,26 @@ def test_cumsum_last_equals_sum(data):
     for i, g in enumerate(groups):
         sel = np.flatnonzero(labels == g)
         np.testing.assert_allclose(scanned[sel[-1]], np.asarray(total)[i], rtol=1e-12)
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    data=array_and_labels(with_nan=True),
+    q=st.floats(min_value=0.0, max_value=1.0),
+    method=st.sampled_from(["linear", "lower", "higher", "nearest", "midpoint"]),
+)
+def test_radix_select_equals_sort(data, q, method):
+    # the sort-free order-statistics lowering is bit-identical to the
+    # two-key-sort path on ARBITRARY data (duplicates, NaN mixes, tiny
+    # groups, extreme q) — both compute exact order statistics
+    import flox_tpu
+
+    vals, labels = data
+    ref, _ = groupby_reduce(
+        vals, labels, func="nanquantile", finalize_kwargs={"q": q, "method": method}
+    )
+    with flox_tpu.set_options(quantile_impl="select"):
+        got, _ = groupby_reduce(
+            vals, labels, func="nanquantile", finalize_kwargs={"q": q, "method": method}
+        )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
