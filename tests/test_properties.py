@@ -118,11 +118,16 @@ def test_radix_select_equals_sort(data, q, method):
     import flox_tpu
 
     vals, labels = data
+    # engine='jax' explicitly: small host arrays would otherwise route to
+    # the numpy engine, which has no quantile_impl knob — the comparison
+    # must exercise the jax select lowering, not compare numpy to itself
     ref, _ = groupby_reduce(
-        vals, labels, func="nanquantile", finalize_kwargs={"q": q, "method": method}
+        vals, labels, func="nanquantile", engine="jax",
+        finalize_kwargs={"q": q, "method": method},
     )
     with flox_tpu.set_options(quantile_impl="select"):
         got, _ = groupby_reduce(
-            vals, labels, func="nanquantile", finalize_kwargs={"q": q, "method": method}
+            vals, labels, func="nanquantile", engine="jax",
+            finalize_kwargs={"q": q, "method": method},
         )
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
