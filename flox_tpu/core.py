@@ -574,10 +574,12 @@ def groupby_reduce(
             est = dense_intermediate_bytes(lead_elems, size, arr_flat.dtype, agg, ndev=1)
             ceiling = OPTIONS["dense_intermediate_bytes_max"]
             if est > ceiling:
+                from .utils import fmt_bytes
+
                 raise ValueError(
-                    f"{agg.name!r} over {size} groups needs ~{est / 2**30:.1f} GiB "
+                    f"{agg.name!r} over {size} groups needs ~{fmt_bytes(est)} "
                     f"of dense (..., size) device intermediates, above the "
-                    f"{ceiling / 2**30:.1f} GiB dense_intermediate_bytes_max "
+                    f"{fmt_bytes(ceiling)} dense_intermediate_bytes_max "
                     "ceiling. Options: pass mesh= (map-reduce auto-routes to the "
                     "blocked owner-by-owner program for additive reductions); "
                     "reduce expected_groups; use engine='numpy' on host data; or "

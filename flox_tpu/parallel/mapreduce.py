@@ -226,6 +226,9 @@ def _is_additive(agg: Aggregation) -> bool:
     )
 
 
+from ..utils import fmt_bytes  # noqa: E402 — guard-message formatting
+
+
 def _est_itemsize(dtype) -> int:
     """Accumulator width for the footprint estimate: intermediates travel in
     >= f32 accumulators; complex dtypes keep their full 2x width."""
@@ -335,21 +338,21 @@ def sharded_groupby_reduce(
             import logging
 
             logging.getLogger("flox_tpu").debug(
-                "dense intermediates ~%.1f GiB exceed dense_intermediate_bytes_max"
-                " (%.1f GiB): using the blocked owner-by-owner program",
-                est / 2**30, ceiling / 2**30,
+                "dense intermediates ~%s exceed dense_intermediate_bytes_max"
+                " (%s): using the blocked owner-by-owner program",
+                fmt_bytes(est), fmt_bytes(ceiling),
             )
         else:
             how = (
                 "its combine cannot be distributed by group ownership"
                 if not _is_additive(agg)
                 else f"even the blocked owner-by-owner program needs "
-                f"~{blocked_est / 2**30:.1f} GiB/device over {ndev} device(s)"
+                f"~{fmt_bytes(blocked_est)}/device over {ndev} device(s)"
             )
             raise ValueError(
-                f"{agg.name!r} over {size} groups needs ~{est / 2**30:.1f} GiB of "
+                f"{agg.name!r} over {size} groups needs ~{fmt_bytes(est)} of "
                 f"dense (..., size) intermediates per device, above the "
-                f"{ceiling / 2**30:.1f} GiB dense_intermediate_bytes_max ceiling, "
+                f"{fmt_bytes(ceiling)} dense_intermediate_bytes_max ceiling, "
                 f"and {how}. Options: reduce expected_groups; shard over more "
                 "devices; use method='blockwise' after "
                 "rechunk.reshard_for_blockwise (whole groups per shard, no dense "

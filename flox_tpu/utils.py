@@ -30,6 +30,11 @@ HAS_XARRAY = module_available("xarray")
 HAS_MATPLOTLIB = module_available("matplotlib")
 
 
+def fmt_bytes(n: float) -> str:
+    """Human size for guard messages: GiB above 1, MiB below."""
+    return f"{n / 2**30:.1f} GiB" if n >= 2**30 else f"{n / 2**20:.1f} MiB"
+
+
 def is_jax_array(x: Any) -> bool:
     import jax
 

@@ -185,24 +185,30 @@ def run_history_sweep(timeout_s: float = 3600.0) -> bool:
         return False
 
 
-def _run_history_sweep(timeout_s: float) -> bool:
+def _current_round() -> int:
+    """The driver commits BENCH_r{N}.json at the END of round N, so during
+    round N the newest such file is N-1 — infer from that, never from the
+    round's own (possibly not-yet-recorded) history files."""
     import glob
 
-    # the round number follows the newest CPU record (one file per round
-    # per platform); a same-round refresh OVERWRITES — re-runs must not
-    # mint phantom future rounds
-    cpu_rounds = []
-    for p in glob.glob(os.path.join(REPO, "BENCH_HISTORY", "r*_cpu.jsonl")):
-        m = re.match(r".*r(\d+)_cpu\.jsonl$", p)
+    rounds = []
+    for p in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        m = re.match(r".*BENCH_r(\d+)\.json$", p)
         if m:
-            cpu_rounds.append(int(m.group(1)))
-    n = max(cpu_rounds, default=1)
+            rounds.append(int(m.group(1)))
+    return max(rounds, default=0) + 1
+
+
+def _run_history_sweep(timeout_s: float) -> bool:
+    n = _current_round()
     out_path = os.path.join(REPO, "BENCH_HISTORY", f"r{n:02d}_tpu.jsonl")
     log(f"history: recording TPU sweep to {os.path.basename(out_path)}")
     try:
+        # --engine both: the regression gate's sensitive tier is the
+        # jax-vs-numpy quotient WITHIN one record (BENCH_HISTORY/README.md)
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "benchmarks.py"),
-             "--scale", "full", "--engine", "jax"],
+             "--scale", "full", "--engine", "both"],
             cwd=REPO, capture_output=True, text=True, timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
@@ -230,7 +236,10 @@ def _run_history_sweep(timeout_s: float) -> bool:
         return False
     tmp = out_path + ".tmp"
     with open(tmp, "w") as f:
-        f.write(json.dumps({"bench": "platform", "value": "tpu", "unit": "config"}) + "\n")
+        # record the backend the sweep ACTUALLY ran on, not an assumption
+        f.write(json.dumps(
+            {"bench": "platform", "value": platform, "unit": "config"}
+        ) + "\n")
         for rec in rows:
             if rec.get("bench") != "platform":
                 f.write(json.dumps(rec) + "\n")
@@ -240,13 +249,24 @@ def _run_history_sweep(timeout_s: float) -> bool:
     return True
 
 
+_DONE: dict = {}  # per-step success across retry cycles
+
+
 def capture_once() -> bool:
-    """One full capture attempt. True iff bench AND tests evidence landed."""
-    ok_bench = run_bench()
-    ok_tests = run_tests_tpu()
-    run_accuracy()  # best-effort extra evidence
-    run_history_sweep()  # best-effort: the round's TPU asv history leg
-    return ok_bench and ok_tests
+    """One full capture attempt. True iff bench AND tests evidence landed.
+    Steps that already succeeded this session are not re-run on retries —
+    tunnel-up time is scarce and each sweep costs up to an hour."""
+    for name, fn in (
+        ("bench", run_bench),
+        ("tests", run_tests_tpu),
+        ("accuracy", run_accuracy),
+        ("history", run_history_sweep),
+    ):
+        if _DONE.get(name):
+            log(f"{name}: already captured this session; skipping")
+            continue
+        _DONE[name] = fn()
+    return bool(_DONE.get("bench") and _DONE.get("tests"))
 
 
 def main() -> int:
