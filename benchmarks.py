@@ -222,13 +222,14 @@ def bench_scan_blelloch(scale: str):
 
 def bench_cohort_detection(scale: str):
     """time_find_group_cohorts + track_num_cohorts parity."""
-    from flox_tpu.cohorts import _COHORTS_CACHE, chunks_from_shards, find_group_cohorts
+    from flox_tpu import cache
+    from flox_tpu.cohorts import chunks_from_shards, find_group_cohorts
 
     nt, day = _era5_labels(scale)
     chunks = chunks_from_shards(nt, nt // 48)
 
     def run():
-        _COHORTS_CACHE.clear()
+        cache.clear_all()  # the reference's asv clears flox.cache the same way
         return find_group_cohorts(day, chunks, expected_groups=range(365))
 
     t = _timeit(run)

@@ -1,9 +1,11 @@
 """Host-side memoization (parity: /root/reference/flox/cache.py:3-12).
 
 The reference memoizes chunk-boundary analysis with a cachey cache keyed by
-dask tokens. Here the cached inputs are hashable tuples (label fingerprints,
-shard counts), so a plain LRU suffices; a `memoize` name is kept so the call
-sites read the same.
+dask tokens, and exposes the cache object so callers (its asv benchmarks,
+debugging sessions) can clear it between runs. Here the cached inputs are
+hashable tuples (label fingerprints, shard counts), so plain LRUs and
+dicts suffice; ``memoize`` keeps the reference's decorator name and
+``clear_all`` is the analogue of ``flox.cache.cache.clear()``.
 """
 
 from __future__ import annotations
@@ -11,3 +13,19 @@ from __future__ import annotations
 import functools
 
 memoize = functools.lru_cache(maxsize=512)
+
+
+def clear_all() -> None:
+    """Drop every host-side cache: cohort-detection memos, compiled mesh
+    program/scan caches, and the jitted kernel-bundle LRU. The analogue of
+    the reference's ``flox.cache.cache.clear()`` (its asv benchmarks clear
+    between timing rounds; ``benchmarks.py`` here does the same)."""
+    from .cohorts import _COHORTS_CACHE
+    from .core import _jitted_bundle
+    from .parallel.mapreduce import _PROGRAM_CACHE
+    from .parallel.scan import _SCAN_CACHE
+
+    _COHORTS_CACHE.clear()
+    _PROGRAM_CACHE.clear()
+    _SCAN_CACHE.clear()
+    _jitted_bundle.cache_clear()

@@ -12,20 +12,29 @@ from __future__ import annotations
 import importlib.util
 import json
 import os
+import sys
 
-import numpy as np  # noqa: F401 — keeps conftest's platform pinning active
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.fixture()
-def oc(monkeypatch, tmp_path):
+def _load_module():
     spec = importlib.util.spec_from_file_location(
         "onchip_capture", os.path.join(REPO, "tools", "onchip_capture.py")
     )
     mod = importlib.util.module_from_spec(spec)
+    before = list(sys.path)
     spec.loader.exec_module(mod)
+    # the tool prepends REPO to sys.path at import; don't let per-test
+    # loads accumulate interpreter-wide entries
+    sys.path[:] = before
+    return mod
+
+
+@pytest.fixture()
+def oc(monkeypatch, tmp_path):
+    mod = _load_module()
     # sandbox every file the tool writes: REPO roots all artifact paths,
     # LOG the probe log — no test may touch the real committed evidence
     monkeypatch.setattr(mod, "REPO", str(tmp_path))
