@@ -39,6 +39,31 @@ __all__ = [
 ]
 
 
+def normalize_engine(engine: str) -> str:
+    """Map engine names (including the reference's) to ours.
+
+    The reference's ``engine="flox"`` is its native vectorised engine
+    (reference aggregate_flox.py); ours is the jax/XLA engine, so the name
+    aliases to ``"jax"``. ``"numbagg"`` (reference aggregate_numbagg.py)
+    has no analogue by design — every device path here is already
+    JIT-compiled by XLA — so it raises with that explanation rather than
+    "unknown".
+    """
+    if engine == "flox":
+        return "jax"
+    if engine == "numbagg":
+        raise ValueError(
+            "engine='numbagg' has no analogue in flox_tpu: numbagg exists to "
+            "give the reference a JIT-compiled kernel path, and every device "
+            "path here is already JIT-compiled by XLA. Use engine='jax' (the "
+            "default; alias 'flox') or engine='numpy' (independent host "
+            "engine). See docs/api.md, 'Engines'."
+        )
+    if engine not in ("jax", "numpy"):
+        raise ValueError(f"Unknown engine {engine!r}; expected 'jax' or 'numpy'.")
+    return engine
+
+
 def generic_aggregate(
     group_idx,
     array,
@@ -52,6 +77,7 @@ def generic_aggregate(
     **kwargs,
 ):
     """Engine dispatcher (parity: aggregations.py:60-133)."""
+    engine = normalize_engine(engine)
     if callable(func):
         return func(
             group_idx, array, axis=axis, size=size, fill_value=fill_value, dtype=dtype, **kwargs

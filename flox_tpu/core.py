@@ -29,7 +29,7 @@ import numpy as np
 import pandas as pd
 
 from . import dtypes, factorize as fct, utils
-from .aggregations import Aggregation, _initialize_aggregation, generic_aggregate
+from .aggregations import Aggregation, _initialize_aggregation, generic_aggregate, normalize_engine
 from .options import OPTIONS
 
 logger = logging.getLogger("flox_tpu")
@@ -275,7 +275,7 @@ def _choose_engine(engine, array, array_is_jax: bool) -> str:
     invisible to the caller.
     """
     if engine is not None:
-        return engine
+        return normalize_engine(engine)
     if not array_is_jax and utils.x64_enabled() and np.asarray(array).size < 2048:
         logger.debug("engine heuristic: small host array -> numpy")
         return "numpy"
@@ -373,16 +373,48 @@ def groupby_reduce(
         raise ValueError(
             f"method must be one of None, 'map-reduce', 'blockwise', 'cohorts'; got {method!r}"
         )
-    if reindex not in (None, True):
-        # dense-by-design: every intermediate is already dense over
-        # expected_groups (shape-static is what XLA fusion and mesh
-        # collectives require — docs/implementation.md), so reindex=True is
-        # implicit and the reference's reindex=False / sparse strategies
-        # (reindex.py:106-157) have no dense-graph to skip.
-        raise NotImplementedError(
-            "reindex=False and ReindexStrategy are not supported: intermediates "
-            "are always dense over expected_groups (reindex=True is implicit)."
+    # -- reindex mapping (parity: _validate_reindex, reference core.py:527-586)
+    # dense-by-design: every intermediate is already dense over
+    # expected_groups (shape-static is what XLA fusion and mesh collectives
+    # require — docs/implementation.md), so reindex=True is implicit.
+    # ReindexStrategy values map onto that reality instead of raising:
+    #   * blockwise=True/None + AUTO/NUMPY  -> the implicit dense behavior
+    #   * array_type=SPARSE_COO             -> sparse host result leg
+    #   * blockwise=False (dense type)      -> no-op eagerly and for
+    #     cohorts/blockwise (label-aligned combine is already what those do;
+    #     the reference *requires* False there); raises for mesh map-reduce,
+    #     where the dense combine cannot be skipped — the bytes ceiling +
+    #     blocked program provide that capability instead.
+    from .reindex import ReindexArrayType, ReindexStrategy
+
+    reindex_sparse: ReindexStrategy | None = None
+    reindex_blockwise_false = False
+    if isinstance(reindex, ReindexStrategy):
+        if reindex.array_type is ReindexArrayType.SPARSE_COO:
+            reindex_sparse = reindex
+        elif reindex.blockwise is False:
+            reindex_blockwise_false = True
+    elif reindex is False:
+        reindex_blockwise_false = True
+    elif reindex not in (None, True):
+        raise TypeError(
+            f"reindex must be None, a bool, or a ReindexStrategy; got {reindex!r}"
         )
+    if reindex_sparse is not None:
+        _fname = func if isinstance(func, str) else getattr(func, "name", "")
+        if not isinstance(_fname, str) or any(
+            f in _fname for f in ("first", "last", "prod", "var", "std", "arg")
+        ):
+            # parity: _is_reindex_sparse_supported_reduction (reference
+            # lib.py:134-139) — these have no meaningful implicit fill
+            raise ValueError(
+                f"reindex with array_type=SPARSE_COO does not support {_fname!r}"
+            )
+        if len(by) > 1:
+            raise NotImplementedError(
+                "SPARSE_COO reindex supports a single `by` (the sparse axis "
+                "is the trailing group axis)"
+            )
     nby = len(by)
 
     from .sparse import is_sparse_array
@@ -393,6 +425,9 @@ def groupby_reduce(
         unsupported = {
             "min_count": min_count, "axis": axis, "method": method,
             "finalize_kwargs": finalize_kwargs, "mesh": mesh,
+            # dense strategies / False are eager no-ops here; only the
+            # sparse result leg is unplumbed for sparse inputs
+            "reindex (SPARSE_COO)": reindex_sparse,
         }
         bad = [k for k, v in unsupported.items() if v is not None]
         if bad:
@@ -402,7 +437,10 @@ def groupby_reduce(
             )
         return _sparse_path(
             array, by, func=func, expected_groups=expected_groups, isbin=isbin,
-            sort=sort, fill_value=fill_value, dtype=dtype, engine=engine,
+            sort=sort, fill_value=fill_value, dtype=dtype,
+            # validate/alias even though the sparse reducer is engine-fixed:
+            # engine='numbagg' etc. must fail the same way everywhere
+            engine=normalize_engine(engine) if engine is not None else None,
         )
 
     # -- host-side label normalization ------------------------------------
@@ -426,6 +464,10 @@ def groupby_reduce(
             raise NotImplementedError(
                 "finalize_kwargs are not supported for non-numeric reductions"
             )
+        if reindex_sparse is not None:
+            raise NotImplementedError(
+                "SPARSE_COO reindex is not supported for non-numeric reductions"
+            )
         if not utils.x64_enabled() and arr.size >= 2**24:
             # f32 positions are exact only to 2**24; beyond that the gather
             # silently returns wrong elements
@@ -441,7 +483,7 @@ def groupby_reduce(
             arr, bys, func, fill_value=fill_value,
             expected_groups=expected_groups, sort=sort, isbin=isbin, axis=axis,
             min_count=min_count, method=method, engine=engine,
-            mesh=mesh, axis_name=axis_name,
+            mesh=mesh, axis_name=axis_name, reindex=reindex,
         )
 
     expected = _normalize_expected(expected_groups, nby)
@@ -487,6 +529,24 @@ def groupby_reduce(
             expected_groups=range(size),
         )
         logger.debug("groupby_reduce: auto-selected method=%s", method)
+
+    if reindex_blockwise_false:
+        # any non-None method runs the sharded program (a default mesh is
+        # substituted when mesh=None), so key on the resolved method
+        if method == "map-reduce":
+            raise NotImplementedError(
+                "reindex=False (blockwise=False) with method='map-reduce' on a "
+                "mesh: the SPMD combine is dense over expected_groups by design "
+                "and cannot be skipped. The capability it targets — avoiding "
+                "huge dense intermediates — is provided instead by "
+                "set_options(dense_intermediate_bytes_max=...): additive "
+                "reductions above the ceiling auto-route to the blocked "
+                "owner-by-owner program. Use method='cohorts'/'blockwise', or "
+                "drop reindex=."
+            )
+        # eager / cohorts / blockwise: combine (if any) is already
+        # label-aligned — the request is the behavior; nothing to change
+        logger.debug("reindex(blockwise=False): no-op on this path")
 
     # -- dtype round-trips -------------------------------------------------
     func_name = func if isinstance(func, str) else func.name
@@ -602,8 +662,41 @@ def groupby_reduce(
         out_shape = new_dims + out_shape
     result = result.reshape(out_shape)
 
+    if reindex_sparse is not None:
+        result = _sparsify_result(result, codes_flat, ngroups, agg)
+
     groups = tuple(_index_values(g) for g in found_groups)
     return (result,) + groups
+
+
+def _sparsify_result(result, codes_flat, ngroups: int, agg: Aggregation):
+    """SPARSE_COO result leg (parity: ReindexStrategy(array_type=SPARSE_COO),
+    reference reindex.py:106-157 + core.py:527-586).
+
+    The *compute* stays dense — static shapes are load-bearing for XLA — and
+    the sparse container packages the host result, storing only the groups
+    that actually occur in `by` (same nnz the reference's sparse reindex
+    produces). Returns a jax BCOO when the implicit fill is zero, HostCOO
+    otherwise.
+    """
+    host = np.asarray(result)
+    if host.dtype.kind in "mMOSU":
+        raise NotImplementedError(
+            f"SPARSE_COO reindex does not support results of dtype {host.dtype}"
+        )
+    # codes are offset by kept-row (row*ngroups + g, factorize.offset_labels)
+    # when `by` has kept axes; fold back to group ids. A group is stored if
+    # it occurs in ANY kept row — the container's columns are shared.
+    valid = codes_flat[codes_flat >= 0]
+    present = np.unique(valid % ngroups)
+    from .reindex import reindex_sparse_coo
+
+    return reindex_sparse_coo(
+        host[..., present],
+        pd.Index(present),
+        pd.RangeIndex(ngroups),
+        fill_value=agg.final_fill_value,
+    )
 
 
 def _index_values(idx: pd.Index):

@@ -45,10 +45,39 @@ class ReindexArrayType(Enum):
 class ReindexStrategy:
     """Whether to reindex blockwise (per shard) and into what array type
     (reindex.py:53-89). On the mesh runtime ``blockwise=True`` is implicit:
-    each shard's intermediates are dense over expected_groups."""
+    each shard's intermediates are dense over expected_groups.
+
+    Accepted by ``groupby_reduce(reindex=...)``: ``blockwise=True/None``
+    with a dense ``array_type`` maps to the implicit dense behavior;
+    ``array_type=SPARSE_COO`` routes the host result leg through
+    :func:`reindex_sparse_coo`; ``blockwise=False`` with a dense array
+    type is a no-op eagerly and for ``cohorts``/``blockwise`` (whose
+    combines are already label-aligned) and raises only for mesh
+    ``map-reduce``, pointing at the
+    ``set_options(dense_intermediate_bytes_max=...)`` ceiling that
+    provides the capability instead — see core.py.
+    """
 
     blockwise: bool | None = None
     array_type: ReindexArrayType = ReindexArrayType.AUTO
+
+    def __post_init__(self):
+        # parity: reference reindex.py:69-73 — a sparse blockwise reindex
+        # makes no sense (each block would densify on combine)
+        if self.blockwise is True and self.array_type not in (
+            ReindexArrayType.AUTO,
+            ReindexArrayType.NUMPY,
+        ):
+            raise ValueError("Setting reindex.blockwise=True not allowed for non-numpy array type.")
+
+    def set_blockwise_for_numpy(self):
+        # parity shim: reference reindex.py:75-76 mutates in place and ported
+        # code may rely on that, so this does too (via object.__setattr__ on
+        # the frozen dataclass, re-validating). Caveat: the by-value hash
+        # changes — don't use an instance as a dict/set key before calling.
+        if self.blockwise is None:
+            object.__setattr__(self, "blockwise", True)
+            self.__post_init__()
 
 
 @dataclass
@@ -122,7 +151,12 @@ def reindex_sparse_coo(array, from_: pd.Index, to: pd.Index, *, fill_value=None,
         is_zero = not np.any(np.asarray(fill_value))
     except (TypeError, ValueError):
         pass
-    if not is_zero:
+    from .utils import x64_enabled
+
+    if not is_zero or (data.dtype.itemsize == 8 and not x64_enabled()):
+        # non-zero fill (BCOO's implicit value is always 0), OR a 64-bit
+        # result that jnp.asarray would silently truncate with x64 off —
+        # keep the exact host container either way
         return HostCOO(columns=cols, data=data, shape=shape, fill_value=fill_value)
 
     from jax.experimental import sparse as jsparse

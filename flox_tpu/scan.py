@@ -72,7 +72,11 @@ def groupby_scan(
             "distributed execution; pass method='blelloch' (engine is "
             "ignored on the mesh) or drop one of the two."
         )
-    engine = engine or OPTIONS["default_engine"]
+    from .aggregations import normalize_engine
+
+    # normalize here, not only in generic_aggregate: the engine=="jax"
+    # guards below (datetime x64 routing) must see the canonical name
+    engine = normalize_engine(engine) if engine is not None else OPTIONS["default_engine"]
     nby = len(by)
 
     bys = [utils.asarray_host(b) for b in by]

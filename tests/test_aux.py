@@ -36,11 +36,165 @@ def test_reindex_sentinel_fill():
     assert out[2] == np.iinfo(np.int64).min or np.isneginf(out[2])
 
 
+def test_engine_flox_alias_and_numbagg_rejection():
+    # reference engine names: "flox" aliases to our native "jax" engine;
+    # "numbagg" raises with the design rationale (docs/api.md "Engines")
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    labels = np.array([0, 0, 1, 1])
+    expected, _ = flox_tpu.groupby_reduce(vals, labels, func="sum", engine="jax")
+    aliased, _ = flox_tpu.groupby_reduce(vals, labels, func="sum", engine="flox")
+    np.testing.assert_allclose(aliased, expected)
+    with pytest.raises(ValueError, match="numbagg.*JIT-compiled by XLA"):
+        flox_tpu.groupby_reduce(vals, labels, func="sum", engine="numbagg")
+    with pytest.raises(ValueError, match="Unknown engine"):
+        flox_tpu.groupby_reduce(vals, labels, func="sum", engine="cupy")
+
+
 def test_reindex_strategy_sparse_supported():
-    # SPARSE_COO became a real strategy (reindex_sparse_coo); the old
-    # NotImplementedError gate is gone
-    s = ReindexStrategy(blockwise=True, array_type=ReindexArrayType.SPARSE_COO)
+    # SPARSE_COO is a real strategy (reindex_sparse_coo); blockwise=True +
+    # sparse is rejected exactly as the reference rejects it (reindex.py:69-73)
+    s = ReindexStrategy(blockwise=False, array_type=ReindexArrayType.SPARSE_COO)
     assert s.array_type is ReindexArrayType.SPARSE_COO
+    with pytest.raises(ValueError, match="blockwise=True not allowed"):
+        ReindexStrategy(blockwise=True, array_type=ReindexArrayType.SPARSE_COO)
+    s2 = ReindexStrategy(blockwise=None)
+    s2.set_blockwise_for_numpy()
+    assert s2.blockwise is True
+
+
+class TestGroupbyReduceReindexParam:
+    """groupby_reduce(reindex=...) accepts the reference's full surface
+    (VERDICT r4 #4; parity: _validate_reindex, reference core.py:527-586)."""
+
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+    labels = np.array([0, 0, 2, 2, 4, 4])
+
+    def _dense(self, **kw):
+        return flox_tpu.groupby_reduce(self.vals, self.labels, func="sum", **kw)
+
+    def test_strategy_dense_values_match_implicit(self):
+        expected, eg = self._dense()
+        for reindex in (
+            True,
+            False,  # eager: accepted like the reference's all-eager leg
+            ReindexStrategy(blockwise=True),
+            ReindexStrategy(blockwise=None),
+            ReindexStrategy(blockwise=False),
+            ReindexStrategy(blockwise=True, array_type=ReindexArrayType.NUMPY),
+        ):
+            got, g = self._dense(reindex=reindex)
+            np.testing.assert_allclose(got, expected)
+            np.testing.assert_array_equal(g, eg)
+
+    def test_bad_reindex_value_raises(self):
+        with pytest.raises(TypeError, match="reindex must be"):
+            self._dense(reindex="yes")
+
+    def test_sparse_coo_result(self):
+        # reference test_core.py::test_sparse_nan_fill_value-style contract:
+        # sparse container over expected_groups, only found groups stored
+        strat = ReindexStrategy(blockwise=False, array_type=ReindexArrayType.SPARSE_COO)
+        result, groups = flox_tpu.groupby_reduce(
+            self.vals, self.labels, func="sum",
+            expected_groups=np.arange(6), fill_value=0, reindex=strat,
+        )
+        from jax.experimental.sparse import BCOO
+
+        assert isinstance(result, BCOO)
+        dense = np.asarray(result.todense())
+        np.testing.assert_allclose(dense, [3.0, 0, 7.0, 0, 11.0, 0])
+        # only the 3 found groups are stored
+        assert result.nse == 3
+        np.testing.assert_array_equal(groups, np.arange(6))
+
+    def test_sparse_coo_nan_fill_hostcoo(self):
+        strat = ReindexStrategy(blockwise=False, array_type=ReindexArrayType.SPARSE_COO)
+        result, _ = flox_tpu.groupby_reduce(
+            self.vals, self.labels, func="nanmean",
+            expected_groups=np.arange(5), reindex=strat,
+        )
+        from flox_tpu.reindex import HostCOO
+
+        assert isinstance(result, HostCOO)
+        np.testing.assert_allclose(
+            result.todense(), [1.5, np.nan, 3.5, np.nan, 5.5], equal_nan=True
+        )
+        assert result.nnz == 3
+
+    def test_sparse_coo_2d_kept_axis(self):
+        strat = ReindexStrategy(blockwise=False, array_type=ReindexArrayType.SPARSE_COO)
+        arr = np.arange(12.0).reshape(2, 6)
+        result, _ = flox_tpu.groupby_reduce(
+            arr, self.labels, func="sum",
+            expected_groups=np.arange(6), fill_value=0, reindex=strat,
+        )
+        dense = np.asarray(result.todense())
+        expected, _ = flox_tpu.groupby_reduce(
+            arr, self.labels, func="sum", expected_groups=np.arange(6), fill_value=0,
+        )
+        np.testing.assert_allclose(dense, np.asarray(expected))
+
+    def test_sparse_coo_unsupported_funcs_raise(self):
+        strat = ReindexStrategy(blockwise=False, array_type=ReindexArrayType.SPARSE_COO)
+        for func in ("first", "nanlast", "prod", "var", "nanstd", "argmax"):
+            with pytest.raises(ValueError, match="SPARSE_COO does not support"):
+                flox_tpu.groupby_reduce(self.vals, self.labels, func=func, reindex=strat)
+
+    def test_sparse_coo_kept_by_axis_offset_codes(self):
+        # single multi-dim `by` with axis= reducing only the last by dim:
+        # factorize offsets codes per kept row (row*ngroups + g); the sparse
+        # leg must fold those back to group ids (code-review r5 finding)
+        strat = ReindexStrategy(blockwise=False, array_type=ReindexArrayType.SPARSE_COO)
+        labels = np.array([[0, 1, 0], [1, 0, 1]])
+        vals = np.arange(6.0).reshape(2, 3)
+        result, _ = flox_tpu.groupby_reduce(
+            vals, labels, func="sum", axis=-1,
+            expected_groups=np.arange(3), fill_value=0, reindex=strat,
+        )
+        expected, _ = flox_tpu.groupby_reduce(
+            vals, labels, func="sum", axis=-1,
+            expected_groups=np.arange(3), fill_value=0,
+        )
+        np.testing.assert_allclose(np.asarray(result.todense()), np.asarray(expected))
+        # group 2 never occurs: only columns 0 and 1 stored (BCOO batch dims
+        # share the sparse structure, so nse counts columns once)
+        assert result.nse == 2
+
+    def test_method_map_reduce_default_mesh_blockwise_false_raises(self):
+        # method='map-reduce' without mesh= still runs the sharded program on
+        # a default mesh — the raise must key on method, not mesh (code-review)
+        with pytest.raises(NotImplementedError, match="dense_intermediate_bytes_max"):
+            flox_tpu.groupby_reduce(
+                self.vals, self.labels, func="sum", reindex=False,
+                expected_groups=np.arange(5), method="map-reduce",
+            )
+
+    def test_frozen_strategy_and_sanctioned_mutation(self):
+        s = ReindexStrategy(blockwise=False)
+        with pytest.raises(AttributeError):
+            s.blockwise = True
+        assert hash(s) == hash(ReindexStrategy(blockwise=False))
+
+    def test_scan_engine_alias_normalized(self):
+        # the alias must hit groupby_scan's own engine=="jax" guards, not
+        # just the deep generic_aggregate call (code-review r5 finding)
+        vals = np.array([1.0, 2.0, 3.0, 4.0])
+        labels = np.array([0, 1, 0, 1])
+        a = flox_tpu.groupby_scan(vals, labels, func="cumsum", engine="flox")
+        b = flox_tpu.groupby_scan(vals, labels, func="cumsum", engine="jax")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        with pytest.raises(ValueError, match="numbagg"):
+            flox_tpu.groupby_scan(vals, labels, func="cumsum", engine="numbagg")
+
+    def test_mesh_map_reduce_blockwise_false_raises(self):
+        import jax
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+        with pytest.raises(NotImplementedError, match="dense_intermediate_bytes_max"):
+            flox_tpu.groupby_reduce(
+                self.vals, self.labels, func="sum", reindex=False,
+                expected_groups=np.arange(5), mesh=mesh, method="map-reduce",
+            )
 
 
 def test_reshard_layout_roundtrip():
@@ -111,6 +265,21 @@ def test_visualize_gated():
     else:
         with pytest.raises(ImportError):
             visualize_groups_1d(np.array([0, 1]))
+
+
+def test_reindex_sparse_coo_x64_off_keeps_host_container(monkeypatch):
+    # with x64 off, jnp.asarray would truncate 64-bit data to 32 bits; the
+    # zero-fill leg must fall back to HostCOO (code-review r5 finding)
+    import flox_tpu.reindex as rmod
+    from flox_tpu.reindex import HostCOO, reindex_sparse_coo
+    from flox_tpu import utils as futils
+
+    monkeypatch.setattr(futils, "x64_enabled", lambda: False)
+    big = np.array([2**40, 16], dtype=np.int64)
+    out = reindex_sparse_coo(big, pd.Index([0, 1]), pd.Index([0, 1, 2]), fill_value=0)
+    assert isinstance(out, HostCOO)
+    np.testing.assert_array_equal(out.todense(), [2**40, 16, 0])
+    assert out.data.dtype == np.int64
 
 
 def test_reindex_inf_fill_no_promotion():
