@@ -182,6 +182,65 @@ def _combine_firstlast(val, pos, axis_name: str, last: bool):
     return val_g, ok
 
 
+def _combine_intermediates(agg: Aggregation, inters, axis_name, nat: bool):
+    """Cross-shard combine of dense per-shard intermediates.
+
+    ``inters``: [val, global_idx] for argreductions, [val, pos] for
+    first/last, else one entry per ``agg.combine`` op. The ONE place the
+    combine contract lives — shared by the map-reduce program and the
+    streaming mesh runtime's final combine (streaming.py), so the NaT
+    re-injection rule, the user-fold gather shape, and the Chan merge
+    cannot drift between the two.
+    """
+    import jax
+
+    skipna = agg.name.startswith("nan") or agg.name == "count"
+    nat_markers = nat and not skipna
+    if agg.reduction_type == "argreduce":
+        gv, garg = _combine_arg(
+            inters[0], inters[1], axis_name,
+            arg_of_max="max" in str(agg.chunk[1]), nat=nat_markers,
+        )
+        return [gv, garg]
+    if agg.combine in (("first",), ("last",)):
+        val_g, _ok = _combine_firstlast(
+            inters[0], inters[1], axis_name, last=agg.combine == ("last",)
+        )
+        return [val_g]
+    combined = []
+    for inter, op in zip(inters, agg.combine):
+        if op == "var":
+            combined.append(_combine_var(inter, axis_name))
+        elif callable(op):
+            # general combine for user Aggregations (the reference's
+            # _grouped_combine role, dask.py:233-317): gather every
+            # shard's dense intermediate and hand the stack to the user
+            # fold — contract: op(stacked) with stacked (ndev, ..., size)
+            # -> (..., size). Leaf-wise over MultiArray pytrees.
+            if isinstance(inter, MultiArray):
+                gathered = MultiArray(
+                    tuple(jax.lax.all_gather(a, axis_name) for a in inter.arrays)
+                )
+            else:
+                gathered = jax.lax.all_gather(inter, axis_name)
+            combined.append(op(gathered))
+        else:
+            combined.append(_combine_simple(op, inter, axis_name, nat=nat_markers))
+    return combined
+
+
+def _finalize_combined(agg: Aggregation, combined, counts):
+    """Pick/fold the combined intermediates into the result and apply the
+    final fill — shared by every mesh program and the streaming runtime."""
+    if agg.reduction_type == "argreduce":
+        result = combined[1]
+    elif agg.finalize is not None:
+        result = agg.finalize(*combined, **agg.finalize_kwargs)
+    else:
+        result = combined[0]
+    return _apply_final_fill(result, counts, agg)
+
+
 # ---------------------------------------------------------------------------
 # the SPMD program
 # ---------------------------------------------------------------------------
@@ -514,13 +573,7 @@ def _build_program(
     count_skipna = skipna or agg.min_count > 0
 
     def finalize(combined, counts):
-        if agg.reduction_type == "argreduce":
-            result = combined[1]
-        elif agg.finalize is not None:
-            result = agg.finalize(*combined, **agg.finalize_kwargs)
-        else:
-            result = combined[0]
-        return _apply_final_fill(result, counts, agg)
+        return _finalize_combined(agg, combined, counts)
 
     def mapreduce_program(arr_sh, codes_sh):
         counts_local = _local_counts(codes_sh, arr_sh, size, count_skipna, nat)
@@ -538,46 +591,17 @@ def _build_program(
             local_arg = generic_kernel(arg_f, codes_sh, arr_sh, size=size, fill_value=-1, **kw)
             offset = _flat_axis_index(axis_name).astype(jnp.int64 if utils.x64_enabled() else jnp.int32) * shard_len
             gidx = jnp.where(local_arg >= 0, local_arg + offset, -1)
-            gv, garg = _combine_arg(
-                val, gidx, axis_name, arg_of_max="max" in agg.chunk[1],
-                nat=nat and not skipna,
-            )
-            return finalize((gv, garg), counts)
-
-        if agg.combine == ("first",) or agg.combine == ("last",):
-            last = agg.combine == ("last",)
+            inters = [val, gidx]
+        elif agg.combine in (("first",), ("last",)):
             offset = _flat_axis_index(axis_name).astype(jnp.int32) * shard_len
             val, pos = _local_firstlast(
-                codes_sh, arr_sh, size, skipna=skipna, last=last, nat=nat, offset=offset
+                codes_sh, arr_sh, size, skipna=skipna,
+                last=agg.combine == ("last",), nat=nat, offset=offset,
             )
-            val_g, ok = _combine_firstlast(val, pos, axis_name, last)
-            return finalize((val_g,), counts)
-
-        inters = _local_chunk(agg, codes_sh, arr_sh, size, nat)
-        combined = []
-        for inter, op in zip(inters, agg.combine):
-            if op == "var":
-                combined.append(_combine_var(inter, axis_name))
-            elif callable(op):
-                # general combine for user Aggregations (the reference's
-                # _grouped_combine role, dask.py:233-317): gather every
-                # shard's dense intermediate and hand the stack to the user
-                # fold — contract: op(stacked) with stacked (ndev, ..., size)
-                # -> (..., size). Leaf-wise over MultiArray pytrees.
-                if isinstance(inter, MultiArray):
-                    gathered = MultiArray(
-                        tuple(jax.lax.all_gather(a, axis_name) for a in inter.arrays)
-                    )
-                else:
-                    gathered = jax.lax.all_gather(inter, axis_name)
-                combined.append(op(gathered))
-            else:
-                # marker re-injection only for propagating (non-skipna) aggs:
-                # skipna identity fills (iinfo.min for int nanmax) would
-                # otherwise be mistaken for NaT
-                combined.append(
-                    _combine_simple(op, inter, axis_name, nat=nat and not skipna)
-                )
+            inters = [val, pos]
+        else:
+            inters = _local_chunk(agg, codes_sh, arr_sh, size, nat)
+        combined = _combine_intermediates(agg, inters, axis_name, nat)
         return finalize(combined, counts)
 
     def blocked_cohorts_program(arr_sh, codes_sh):

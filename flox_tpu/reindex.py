@@ -153,7 +153,7 @@ def reindex_sparse_coo(array, from_: pd.Index, to: pd.Index, *, fill_value=None,
         pass
     from .utils import x64_enabled
 
-    if not is_zero or (data.dtype.itemsize == 8 and not x64_enabled()):
+    if not is_zero or (data.dtype.itemsize >= 8 and not x64_enabled()):
         # non-zero fill (BCOO's implicit value is always 0), OR a 64-bit
         # result that jnp.asarray would silently truncate with x64 off —
         # keep the exact host container either way

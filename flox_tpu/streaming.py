@@ -37,6 +37,10 @@ __all__ = ["streaming_groupby_reduce"]
 
 _BIG = np.iinfo(np.int32).max
 
+# compiled (step, final) shard_map program pairs for the mesh runtime,
+# keyed by (agg identity, size, shard layout, mesh, options fingerprint)
+_MESH_PROGRAM_CACHE: dict = {}
+
 
 def streaming_groupby_reduce(
     array,
@@ -53,6 +57,8 @@ def streaming_groupby_reduce(
     dtype=None,
     min_count: int | None = None,
     finalize_kwargs: dict | None = None,
+    mesh=None,
+    axis_name="data",
 ):
     """Grouped reduction streaming slabs to device.
 
@@ -72,6 +78,21 @@ def streaming_groupby_reduce(
     Supported: every aggregation with a chunk stage (blockwise-only order
     statistics — median/quantile/mode — need all of a group at once and
     cannot stream; use the mesh blockwise method for those).
+
+    ``mesh=`` composes streaming with the sharded runtime (the
+    chunked-runtime × scheduler composition the reference gets from dask,
+    /root/reference/flox/dask.py:325-573): every slab is ``device_put``
+    sharded over the mesh's ``axis_name`` axes, each device folds its
+    shard into its OWN accumulator (zero collectives while streaming —
+    jax's async dispatch overlaps host loads with device reduction on all
+    chips), and ONE collective combine at the end applies the same
+    psum / pmax / two-psum Chan merges the mesh map-reduce program uses.
+    Bigger-than-host+HBM arrays therefore stream onto N chips at N× the
+    slab bandwidth. Above ``dense_intermediate_bytes_max``, additive
+    reductions switch to the blocked owner-by-owner form: per-device
+    accumulators are ``(…, size/ndev)`` from the start, so group spaces
+    beyond any single device's ceiling stream too (see
+    docs/distributed.md).
     """
     import jax
     import jax.numpy as jnp
@@ -196,7 +217,6 @@ def streaming_groupby_reduce(
     row_bytes = int(np.prod(lead_shape, dtype=np.int64)) * itemsize if lead_shape else itemsize
     if batch_len is None:
         batch_len = max(1, min(n, batch_bytes // max(row_bytes, 1)))
-    nbatches = math.ceil(n / batch_len)
 
     skipna = agg.name.startswith("nan") or agg.name == "count"
     count_skipna = skipna or agg.min_count > 0
@@ -206,9 +226,93 @@ def streaming_groupby_reduce(
 
         shift_nat_identity_fills(agg)
 
-    step = _build_step(
-        agg, size=size, batch_len=batch_len, count_skipna=count_skipna, nat=nat
-    )
+    slab_shard = codes_shard = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .options import OPTIONS
+        from .parallel.mapreduce import (
+            _is_additive,
+            _norm_axes,
+            dense_intermediate_bytes,
+        )
+        from .utils import fmt_bytes
+
+        axes = _norm_axes(axis_name, mesh)
+        ndev = int(np.prod([mesh.shape[a] for a in axes]))
+        batch_len = -(-batch_len // ndev) * ndev  # shards must be equal
+        shard_len = batch_len // ndev
+
+        # ceiling routing — the same decision sharded_groupby_reduce makes:
+        # per-device accumulators are one dense (..., size) buffer set, so
+        # above the ceiling additive aggs switch to owner-blocked
+        # accumulation and everything else fails actionably
+        lead_elems = int(np.prod(lead_shape, dtype=np.int64)) if lead_shape else 1
+        est = dense_intermediate_bytes(lead_elems, size, probe.dtype, agg, ndev)
+        ceiling = OPTIONS["dense_intermediate_bytes_max"]
+        blocked = False
+        if est > ceiling:
+            result_bytes = lead_elems * size * max(4, itemsize)
+            blocked_est = result_bytes + est // ndev
+            if _is_additive(agg) and blocked_est <= ceiling:
+                blocked = True
+            else:
+                how = (
+                    "its combine cannot be distributed by group ownership"
+                    if not _is_additive(agg)
+                    else f"even the blocked owner-by-owner form needs "
+                    f"~{fmt_bytes(blocked_est)}/device over {ndev} device(s)"
+                )
+                raise ValueError(
+                    f"streaming {agg.name!r} over {size} groups needs "
+                    f"~{fmt_bytes(est)} of dense (..., size) accumulators per "
+                    f"device, above the {fmt_bytes(ceiling)} "
+                    f"dense_intermediate_bytes_max ceiling, and {how}. Options: "
+                    "reduce expected_groups; shard over more devices; or raise "
+                    "set_options(dense_intermediate_bytes_max=...) if the "
+                    "devices really have the headroom."
+                )
+
+        spec_entry = axes if len(axes) > 1 else axes[0]
+        slab_shard = NamedSharding(mesh, P(*([None] * len(lead_shape) + [spec_entry])))
+        codes_shard = NamedSharding(mesh, P(spec_entry))
+
+        # program cache (the _PROGRAM_CACHE pattern from the sharded
+        # runtime): repeat same-shaped calls — per-variable streaming over
+        # a dataset, pipelines — reuse the three compiled shard_map
+        # programs instead of retracing
+        from .options import trace_fingerprint
+        from .parallel.mapreduce import _agg_cache_key
+
+        cache_key = (
+            _agg_cache_key(agg), size, shard_len, axes, mesh, nat, blocked,
+            len(lead_shape), trace_fingerprint(),
+        )
+        pair = _MESH_PROGRAM_CACHE.get(cache_key)
+        if pair is None:
+            if blocked:
+                size_pad = size + (-size) % ndev
+                step = _build_mesh_step_blocked(
+                    agg, size_pad=size_pad, ndev=ndev, count_skipna=count_skipna,
+                    nat=nat, mesh=mesh, axes=axes, lead_ndim=len(lead_shape),
+                )
+                final = _build_mesh_final_blocked(agg, size=size, mesh=mesh, axes=axes)
+            else:
+                step = _build_mesh_step(
+                    agg, size=size, shard_len=shard_len, count_skipna=count_skipna,
+                    nat=nat, mesh=mesh, axes=axes, lead_ndim=len(lead_shape),
+                )
+                final = _build_mesh_final(agg, mesh=mesh, axes=axes, nat=nat)
+            if len(_MESH_PROGRAM_CACHE) > 128:
+                _MESH_PROGRAM_CACHE.clear()
+            _MESH_PROGRAM_CACHE[cache_key] = (step, final)
+        else:
+            step, final = pair
+    else:
+        step = _build_step(
+            agg, size=size, batch_len=batch_len, count_skipna=count_skipna, nat=nat
+        )
+    nbatches = math.ceil(n / batch_len)
 
     state = None
     for i in range(nbatches):
@@ -221,20 +325,32 @@ def streaming_groupby_reduce(
                 [slab, np.zeros(lead_shape + (pad,), slab.dtype)], axis=-1
             )
             ccodes = np.concatenate([ccodes, np.full(pad, -1, dtype=ccodes.dtype)])
+        if mesh is not None:
+            import jax
+
+            # one host->N-device scatter per slab: each chip receives and
+            # reduces its contiguous 1/ndev of the slab
+            slab_dev = jax.device_put(slab, slab_shard)
+            ccodes_dev = jax.device_put(np.ascontiguousarray(ccodes), codes_shard)
+        else:
+            slab_dev, ccodes_dev = jnp.asarray(slab), jnp.asarray(ccodes)
         # async dispatch: this queues on device while the host loads slab i+1
-        state = step(state, jnp.asarray(slab), jnp.asarray(ccodes), jnp.asarray(np.int64(s)))
+        state = step(state, slab_dev, ccodes_dev, jnp.asarray(np.int64(s)))
+
+    if mesh is not None:
+        result = final(state)
+        from .core import _astype_final, _index_values
+
+        result = _astype_final(result, agg, datetime_dtype)
+        out_shape = tuple(lead_shape) + tuple(keep_by_shape) + grp_shape
+        if result.shape != out_shape:
+            result = result.reshape(out_shape)
+        return (result,) + tuple(_index_values(g) for g in found_groups)
 
     inters, counts = state
-    if agg.reduction_type == "argreduce":
-        result = inters[1]
-    elif agg.finalize is not None:
-        result = agg.finalize(*inters, **agg.finalize_kwargs)
-    else:
-        result = inters[0]
+    from .parallel.mapreduce import _finalize_combined
 
-    from .parallel.mapreduce import _apply_final_fill
-
-    result = _apply_final_fill(result, counts, agg)
+    result = _finalize_combined(agg, inters, counts)
     from .core import _astype_final, _index_values
 
     result = _astype_final(result, agg, datetime_dtype)
@@ -246,85 +362,96 @@ def streaming_groupby_reduce(
     return (result,) + tuple(_index_values(g) for g in found_groups)
 
 
-def _build_step(agg: Aggregation, *, size: int, batch_len: int, count_skipna: bool,
-                nat: bool = False):
-    """One jitted step: slab -> chunk intermediates -> merge into state."""
-    import jax
+def _slab_stats(agg: Aggregation, slab, ccodes, offset, *, size: int,
+                count_skipna: bool, nat: bool):
+    """Chunk intermediates + counts for one slab (or one shard of a slab).
+    ``offset`` is the slab's global start position (traced), already
+    including the device offset on the mesh path."""
     import jax.numpy as jnp
 
     from .kernels import generic_kernel
     from .parallel.mapreduce import _local_chunk, _local_counts
 
-    arg_of_max = agg.reduction_type == "argreduce" and "max" in str(agg.chunk[1])
-    is_last = agg.combine == ("last",)
-    is_first = agg.combine == ("first",)
     skipna = agg.name.startswith("nan")
     kw = {"nat": True} if nat else {}
+    counts = _local_counts(ccodes, slab, size, count_skipna, nat)
+    if agg.reduction_type == "argreduce":
+        val_f, arg_f = agg.chunk
+        val = generic_kernel(
+            val_f, ccodes, slab, size=size,
+            fill_value=agg.fill_value["intermediate"][0], **kw,
+        )
+        local_arg = generic_kernel(arg_f, ccodes, slab, size=size, fill_value=-1, **kw)
+        gidx = jnp.where(local_arg >= 0, local_arg + offset, -1)
+        return [val, gidx], counts
+    if agg.combine in (("first",), ("last",)):
+        from .parallel.mapreduce import _local_firstlast
 
-    def slab_stats(slab, ccodes, offset):
-        counts = _local_counts(ccodes, slab, size, count_skipna, nat)
-        if agg.reduction_type == "argreduce":
-            val_f, arg_f = agg.chunk
-            val = generic_kernel(
-                val_f, ccodes, slab, size=size,
-                fill_value=agg.fill_value["intermediate"][0], **kw,
-            )
-            local_arg = generic_kernel(arg_f, ccodes, slab, size=size, fill_value=-1, **kw)
-            gidx = jnp.where(local_arg >= 0, local_arg + offset, -1)
-            return [val, gidx], counts
-        if is_first or is_last:
-            from .parallel.mapreduce import _local_firstlast
+        val, pos = _local_firstlast(
+            ccodes, slab, size, skipna=skipna,
+            last=agg.combine == ("last",), nat=nat, offset=offset,
+        )
+        return [val, pos], counts
+    return _local_chunk(agg, ccodes, slab, size, nat), counts
 
-            val, pos = _local_firstlast(
-                ccodes, slab, size, skipna=skipna,
-                last=is_last, nat=nat, offset=offset,
-            )
-            return [val, pos], counts
-        return _local_chunk(agg, ccodes, slab, size, nat), counts
 
+def _merge_into(agg: Aggregation, state, inters, counts, *, nat: bool):
+    """Fold one slab's intermediates into the running state — the
+    sequential form of the mesh collectives, shared by the single-device
+    and the per-device (mesh) accumulation loops."""
+    import jax.numpy as jnp
+
+    skipna = agg.name.startswith("nan")
     # NaT marker re-injection applies only to propagating (non-skipna)
-    # merges — skipna identity fills were shifted off the sentinel above
+    # merges — skipna identity fills were shifted off the sentinel upstream
     nat_markers = nat and not skipna
-
-    def merge(state, inters, counts):
-        acc_inters, acc_counts = state
-        out = []
-        if agg.reduction_type == "argreduce":
-            va, ia = acc_inters
-            vb, ib = inters
-            better = _argmerge_better(va, vb, arg_of_max)
-            tie = vb == va
-            if jnp.issubdtype(va.dtype, jnp.floating):
-                tie = tie | (jnp.isnan(va) & jnp.isnan(vb))
-            if nat_markers:
-                # NaT-propagating: a NaT extreme wins over any value (its
-                # position is the group's first NaT); both-NaT is already a
-                # tie through integer equality
-                marker = jnp.asarray(np.iinfo(np.int64).min, va.dtype)
-                na_, nb_ = va == marker, vb == marker
-                better = (better & ~na_ & ~nb_) | (nb_ & ~na_)
-            ia_safe = jnp.where(ia >= 0, ia, _BIG)
-            ib_safe = jnp.where(ib >= 0, ib, _BIG)
-            idx = jnp.where(better, ib_safe, jnp.where(tie, jnp.minimum(ia_safe, ib_safe), ia_safe))
-            out = [jnp.where(better, vb, va), jnp.where(idx < _BIG, idx, -1)]
-        elif is_first or is_last:
-            va, pa = acc_inters
-            vb, pb = inters
-            if is_last:
-                take_b = (pb >= 0) & ((pa < 0) | (pb > pa))
-            else:
-                take_b = (pb < _BIG) & ((pa >= _BIG) | (pb < pa))
-            out = [jnp.where(take_b, vb, va), jnp.where(take_b, pb, pa)]
+    acc_inters, acc_counts = state
+    out = []
+    if agg.reduction_type == "argreduce":
+        arg_of_max = "max" in str(agg.chunk[1])
+        va, ia = acc_inters
+        vb, ib = inters
+        better = _argmerge_better(va, vb, arg_of_max)
+        tie = vb == va
+        if jnp.issubdtype(va.dtype, jnp.floating):
+            tie = tie | (jnp.isnan(va) & jnp.isnan(vb))
+        if nat_markers:
+            # NaT-propagating: a NaT extreme wins over any value (its
+            # position is the group's first NaT); both-NaT is already a
+            # tie through integer equality
+            marker = jnp.asarray(np.iinfo(np.int64).min, va.dtype)
+            na_, nb_ = va == marker, vb == marker
+            better = (better & ~na_ & ~nb_) | (nb_ & ~na_)
+        ia_safe = jnp.where(ia >= 0, ia, _BIG)
+        ib_safe = jnp.where(ib >= 0, ib, _BIG)
+        idx = jnp.where(better, ib_safe, jnp.where(tie, jnp.minimum(ia_safe, ib_safe), ia_safe))
+        out = [jnp.where(better, vb, va), jnp.where(idx < _BIG, idx, -1)]
+    elif agg.combine in (("first",), ("last",)):
+        va, pa = acc_inters
+        vb, pb = inters
+        if agg.combine == ("last",):
+            take_b = (pb >= 0) & ((pa < 0) | (pb > pa))
         else:
-            for a, b, op in zip(acc_inters, inters, agg.combine):
-                out.append(_pair_merge(op, a, b, nat=nat_markers))
-        return out, acc_counts + counts
+            take_b = (pb < _BIG) & ((pa >= _BIG) | (pb < pa))
+        out = [jnp.where(take_b, vb, va), jnp.where(take_b, pb, pa)]
+    else:
+        for a, b, op in zip(acc_inters, inters, agg.combine):
+            out.append(_pair_merge(op, a, b, nat=nat_markers))
+    return out, acc_counts + counts
+
+
+def _build_step(agg: Aggregation, *, size: int, batch_len: int, count_skipna: bool,
+                nat: bool = False):
+    """One jitted step: slab -> chunk intermediates -> merge into state."""
+    import jax
 
     def step(state, slab, ccodes, offset):
-        inters, counts = slab_stats(slab, ccodes, offset)
+        inters, counts = _slab_stats(
+            agg, slab, ccodes, offset, size=size, count_skipna=count_skipna, nat=nat
+        )
         if state is None:
             return (inters, counts)
-        return merge(state, inters, counts)
+        return _merge_into(agg, state, inters, counts, nat=nat)
 
     jitted = jax.jit(step)
 
@@ -333,6 +460,195 @@ def _build_step(agg: Aggregation, *, size: int, batch_len: int, count_skipna: bo
         return jitted(state, slab, ccodes, offset)
 
     return run
+
+
+def _build_mesh_step(agg: Aggregation, *, size: int, shard_len: int,
+                     count_skipna: bool, nat: bool, mesh, axes, lead_ndim: int):
+    """Per-slab step on the mesh: each device folds its shard of the slab
+    into ITS OWN accumulator — zero collectives while streaming. State
+    leaves are (ndev, ..., size) sharded over the leading device axis;
+    the one collective combine happens in :func:`_build_mesh_final`.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from .parallel.mapreduce import _flat_axis_index
+
+    spec_entry = axes if len(axes) > 1 else axes[0]
+    slab_spec = P(*([None] * lead_ndim + [spec_entry]))
+
+    def local_step(state, slab_sh, codes_sh, offset):
+        # shard-contiguous layout: device d holds slab[d*L:(d+1)*L], so the
+        # global position of its first element is offset + d*L
+        dev = _flat_axis_index(axes)
+        goff = offset + dev.astype(offset.dtype) * shard_len
+        inters, counts = _slab_stats(
+            agg, slab_sh, codes_sh, goff, size=size,
+            count_skipna=count_skipna, nat=nat,
+        )
+        if state is None:
+            return _expand_dev(inters), counts[None]
+        st = jax.tree.map(lambda x: x[0], state)
+        minters, mcounts = _merge_into(agg, st, inters, counts, nat=nat)
+        return _expand_dev(minters), mcounts[None]
+
+    return _mesh_step_runner(local_step, mesh, slab_spec, spec_entry)
+
+
+def _mesh_step_runner(local_step, mesh, slab_spec, spec_entry):
+    """Two jitted shard_map programs (first slab has no state yet)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def init_step(slab_sh, codes_sh, offset):
+        return local_step(None, slab_sh, codes_sh, offset)
+
+    common = dict(mesh=mesh, out_specs=P(spec_entry), check_vma=False)
+    init_fn = jax.jit(jax.shard_map(
+        init_step, in_specs=(slab_spec, P(spec_entry), P()), **common
+    ))
+    step_fn = jax.jit(jax.shard_map(
+        local_step, in_specs=(P(spec_entry), slab_spec, P(spec_entry), P()), **common
+    ))
+
+    def run(state, slab, ccodes, offset):
+        if state is None:
+            return init_fn(slab, ccodes, offset)
+        return step_fn(state, slab, ccodes, offset)
+
+    return run
+
+
+def _expand_dev(inters):
+    """Re-attach the per-device leading axis to every accumulator leaf."""
+    import jax
+
+    return jax.tree.map(lambda x: x[None], inters)
+
+
+def _build_mesh_final(agg: Aggregation, *, mesh, axes, nat: bool):
+    """The ONE collective combine: per-device accumulated states meet the
+    SAME combine contract as the mesh map-reduce program — literally the
+    shared ``_combine_intermediates``/``_finalize_combined`` helpers in
+    parallel/mapreduce.py. Output replicated."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from .parallel.mapreduce import _combine_intermediates, _finalize_combined
+
+    spec_entry = axes if len(axes) > 1 else axes[0]
+
+    def final(state):
+        st = jax.tree.map(lambda x: x[0], state)
+        inters, counts = st
+        counts_g = jax.lax.psum(counts, axes)
+        combined = _combine_intermediates(agg, inters, axes, nat)
+        return _finalize_combined(agg, combined, counts_g)
+
+    return jax.jit(
+        jax.shard_map(
+            final, mesh=mesh, in_specs=(P(spec_entry),), out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def _build_mesh_step_blocked(agg: Aggregation, *, size_pad: int, ndev: int,
+                             count_skipna: bool, nat: bool, mesh, axes,
+                             lead_ndim: int):
+    """Huge-label-space streaming (the streaming form of the blocked
+    owner-by-owner program, parallel/mapreduce.py): per slab, a fori_loop
+    walks the ndev owner blocks — each block's (..., size/ndev)
+    intermediates are psum'd and the owner keeps its slice — so no dense
+    (..., size) buffer ever materializes on any device, per slab OR in the
+    accumulators. Communication per slab totals one psum of (..., size);
+    the data makes ndev passes per slab (the price of the ceiling).
+    Additive combines only (sum / the var triple)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from .parallel.mapreduce import (
+        _combine_simple,
+        _combine_var,
+        _flat_axis_index,
+        _local_chunk,
+        _local_counts,
+    )
+
+    spec_entry = axes if len(axes) > 1 else axes[0]
+    slab_spec = P(*([None] * lead_ndim + [spec_entry]))
+    b = size_pad // ndev
+    skipna = agg.name.startswith("nan")
+    nat_markers = nat and not skipna
+
+    def local_step(state, slab_sh, codes_sh, offset):
+        me = _flat_axis_index(axes)
+
+        def block(d):
+            in_block = (codes_sh >= d * b) & (codes_sh < (d + 1) * b)
+            bc = jnp.where(in_block, codes_sh - d * b, -1)
+            counts = jax.lax.psum(
+                _local_counts(bc, slab_sh, b, count_skipna, nat), axes
+            )
+            outs = []
+            for inter, op in zip(_local_chunk(agg, bc, slab_sh, b, nat), agg.combine):
+                outs.append(
+                    _combine_var(inter, axes)
+                    if op == "var"
+                    else _combine_simple(op, inter, axes, nat=nat_markers)
+                )
+            return counts, outs
+
+        c0, o0 = block(0)
+        keep0 = me == 0
+        carry0 = jax.tree.map(lambda x: jnp.where(keep0, x, jnp.zeros_like(x)), (c0, o0))
+
+        def body(d, carry):
+            c, o = block(d)
+            keep = me == d
+            return jax.tree.map(lambda new, acc: jnp.where(keep, new, acc), (c, o), carry)
+
+        counts_blk, inters_blk = jax.lax.fori_loop(1, ndev, body, carry0)
+        if state is None:
+            return _expand_dev(inters_blk), counts_blk[None]
+        st = jax.tree.map(lambda x: x[0], state)
+        acc_inters, acc_counts = st
+        merged = [
+            _pair_merge(op, a, new, nat=nat_markers)
+            for a, new, op in zip(acc_inters, inters_blk, agg.combine)
+        ]
+        return _expand_dev(merged), (acc_counts + counts_blk)[None]
+
+    return _mesh_step_runner(local_step, mesh, slab_spec, spec_entry)
+
+
+def _build_mesh_final_blocked(agg: Aggregation, *, size: int, mesh, axes):
+    """Finalize per-owner accumulators and gather the full group axis —
+    the tail of the blocked owner-by-owner program."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from .parallel.mapreduce import _crop, _finalize_combined
+
+    spec_entry = axes if len(axes) > 1 else axes[0]
+
+    def final(state):
+        st = jax.tree.map(lambda x: x[0], state)
+        inters, counts = st
+        result_own = _finalize_combined(agg, inters, counts)
+        full = jax.lax.all_gather(
+            jnp.moveaxis(result_own, -1, 0), axes, tiled=True
+        )
+        return _crop(jnp.moveaxis(full, 0, -1), size)
+
+    return jax.jit(
+        jax.shard_map(
+            final, mesh=mesh, in_specs=(P(spec_entry),), out_specs=P(),
+            check_vma=False,
+        )
+    )
 
 
 def _argmerge_better(va, vb, arg_of_max: bool):

@@ -237,3 +237,179 @@ class TestWideStreaming:
         ref, _ = groupby_reduce(td, codes, func="nansum")
         got, _ = streaming_groupby_reduce(td, codes, func="nansum", batch_len=23)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+class TestMeshStreaming:
+    """streaming x mesh composition (VERDICT r4 #2): slabs device_put
+    sharded over the mesh, per-device local accumulation, ONE collective
+    combine at the end — the chunked-runtime x scheduler composition the
+    reference gets from dask (dask.py:325-573)."""
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        from flox_tpu.parallel.mesh import make_mesh
+
+        return make_mesh()
+
+    @pytest.fixture(scope="class")
+    def mdata(self):
+        rng = np.random.default_rng(11)
+        n = 6000
+        vals = rng.normal(size=(4, n))
+        vals[:, ::13] = np.nan
+        labels = rng.integers(0, 9, n)
+        return vals, labels
+
+    @pytest.mark.parametrize("func", STREAM_FUNCS)
+    def test_matches_eager(self, mesh, mdata, func):
+        vals, labels = mdata
+        v = vals if func not in ("any", "all") else ~np.isnan(vals)
+        expected, eg = groupby_reduce(v, labels, func=func)
+        got, g = streaming_groupby_reduce(
+            v, labels, func=func, batch_len=997, mesh=mesh
+        )
+        np.testing.assert_array_equal(g, eg)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=1e-12, equal_nan=True
+        )
+
+    def test_batch_len_rounds_to_shards(self, mesh, mdata):
+        # batch_len not divisible by ndev rounds up; results unchanged
+        vals, labels = mdata
+        expected, _ = groupby_reduce(vals, labels, func="nansum")
+        got, _ = streaming_groupby_reduce(
+            vals, labels, func="nansum", batch_len=1001, mesh=mesh
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-12)
+
+    def test_loader_streams_to_mesh(self, mesh, mdata):
+        vals, labels = mdata
+        expected, _ = groupby_reduce(vals, labels, func="nanmean")
+        got, _ = streaming_groupby_reduce(
+            lambda s, e: vals[:, s:e], labels, func="nanmean",
+            batch_len=512, mesh=mesh,
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-12, equal_nan=True)
+
+    def test_datetime_nat_crosses_shards_and_slabs(self, mesh):
+        rng = np.random.default_rng(5)
+        n = 4000
+        labels = rng.integers(0, 6, n)
+        dt = (
+            np.datetime64("2021-06-01")
+            + rng.integers(0, 10**6, n).astype("timedelta64[s]")
+        ).astype("datetime64[ns]")
+        dt[rng.random(n) < 0.04] = np.datetime64("NaT")
+        for func in ("min", "nanmax", "first", "nanlast", "mean", "count"):
+            expected, _ = groupby_reduce(dt, labels, func=func)
+            got, _ = streaming_groupby_reduce(dt, labels, func=func, batch_len=640, mesh=mesh)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+    def test_custom_aggregation_on_mesh(self, mesh, mdata):
+        import jax.numpy as jnp
+
+        from flox_tpu import Aggregation
+
+        def sq(gi, a, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+            from flox_tpu.kernels import generic_kernel
+
+            return generic_kernel("nansum", gi, jnp.asarray(a) ** 2, size=size, fill_value=0.0)
+
+        def ct(gi, a, *, axis=-1, size, fill_value=None, dtype=None, **kw):
+            from flox_tpu.kernels import generic_kernel
+
+            return generic_kernel("nanlen", gi, a, size=size)
+
+        rms = Aggregation(
+            "rms", numpy=(sq, ct), chunk=(sq, ct),
+            combine=(lambda s: s.sum(0), lambda s: s.sum(0)),
+            finalize=lambda ss, nn, **kw: (ss / nn) ** 0.5,
+            fill_value={"intermediate": (0.0, 0)}, final_fill_value=np.nan,
+        )
+        vals, labels = mdata
+        expected, _ = groupby_reduce(vals, labels, func=rms)
+        got, _ = streaming_groupby_reduce(vals, labels, func=rms, batch_len=800, mesh=mesh)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=1e-12, equal_nan=True
+        )
+
+    def test_program_cache_reused(self, mesh, mdata):
+        # repeat same-shaped calls must not retrace (code-review r5):
+        # the compiled (step, final) pair is cached like the sharded
+        # runtime's _PROGRAM_CACHE
+        from flox_tpu.streaming import _MESH_PROGRAM_CACHE
+
+        vals, labels = mdata
+        _MESH_PROGRAM_CACHE.clear()
+        streaming_groupby_reduce(vals, labels, func="nansum", batch_len=997, mesh=mesh)
+        assert len(_MESH_PROGRAM_CACHE) == 1
+        vals2 = vals + 1.0
+        streaming_groupby_reduce(vals2, labels, func="nansum", batch_len=997, mesh=mesh)
+        assert len(_MESH_PROGRAM_CACHE) == 1  # hit, not a rebuild
+        # clear_all drops it with every other program cache
+        import flox_tpu.cache
+
+        flox_tpu.cache.clear_all()
+        assert len(_MESH_PROGRAM_CACHE) == 0
+
+    def test_min_count_on_mesh(self, mesh, mdata):
+        vals, labels = mdata
+        expected, _ = groupby_reduce(vals, labels, func="nansum", min_count=800)
+        got, _ = streaming_groupby_reduce(
+            vals, labels, func="nansum", min_count=800, batch_len=900, mesh=mesh
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=1e-12, equal_nan=True
+        )
+
+
+class TestMeshStreamingBlocked:
+    """Above dense_intermediate_bytes_max, additive reductions stream with
+    owner-blocked (…, size/ndev) per-device accumulators — a group space
+    above any single device's ceiling (VERDICT r4 #2 'above the
+    single-device ceiling')."""
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        from flox_tpu.parallel.mesh import make_mesh
+
+        return make_mesh()
+
+    def test_blocked_sum_and_var_match_eager(self, mesh):
+        import flox_tpu
+
+        rng = np.random.default_rng(17)
+        n, size = 6000, 40_000
+        labels = rng.integers(0, size, n)
+        vals = rng.normal(size=(4, n))
+        exp_sum, _ = groupby_reduce(vals, labels, func="sum", expected_groups=np.arange(size), fill_value=0)
+        exp_var, _ = groupby_reduce(vals, labels, func="nanvar", expected_groups=np.arange(size))
+        # dense per-device accumulators (~4*40000*8B x legs) exceed the
+        # ceiling; owned (size/8) blocks + the result fit under it
+        with flox_tpu.set_options(dense_intermediate_bytes_max=4 * 2**20):
+            got_sum, _ = streaming_groupby_reduce(
+                vals, labels, func="sum", expected_groups=np.arange(size),
+                fill_value=0, batch_len=800, mesh=mesh,
+            )
+            got_var, _ = streaming_groupby_reduce(
+                vals, labels, func="nanvar", expected_groups=np.arange(size),
+                batch_len=800, mesh=mesh,
+            )
+        np.testing.assert_allclose(np.asarray(got_sum), np.asarray(exp_sum), rtol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(got_var), np.asarray(exp_var), rtol=1e-9, equal_nan=True
+        )
+
+    def test_non_additive_above_ceiling_raises(self, mesh):
+        import flox_tpu
+
+        rng = np.random.default_rng(17)
+        n, size = 2000, 40_000
+        labels = rng.integers(0, size, n)
+        vals = rng.normal(size=(4, n))
+        with flox_tpu.set_options(dense_intermediate_bytes_max=2 * 2**20):
+            with pytest.raises(ValueError, match="cannot be distributed by group ownership"):
+                streaming_groupby_reduce(
+                    vals, labels, func="max", expected_groups=np.arange(size),
+                    batch_len=800, mesh=mesh,
+                )
