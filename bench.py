@@ -397,7 +397,11 @@ def main() -> None:
         "note": (
             "CPU FALLBACK — accelerator unreachable; value is a liveness "
             "signal, NOT a TPU measurement (see last_onchip for the most "
-            "recent hardware sweep)"
+            "recent hardware sweep). impl_sweep_gbps/quantile_gbps are "
+            "skipped by design on CPU (auto==scatter here; force with "
+            "FLOX_TPU_BENCH_FORCE_SWEEP=1) — the per-family CPU record "
+            "lives in BENCH_HISTORY/r{N}_cpu.jsonl (benchmarks.py, "
+            "median-of-3 sweeps)"
         )
         if not on_accel
         else "measured on accelerator; winner of the impl sweep",

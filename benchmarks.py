@@ -251,6 +251,13 @@ def main() -> None:
         "environment's sitecustomize otherwise selects the accelerator, "
         "which hangs when the TPU tunnel is down)",
     )
+    ap.add_argument(
+        "--sweeps", type=int, default=3,
+        help="run the whole battery N times and record the per-row MEDIAN "
+        "(VERDICT r4 #3: back-to-back reps share transient host load; "
+        "sweeps minutes apart sample the session's noise distribution). "
+        "1 = a quick single sweep.",
+    )
     args = ap.parse_args()
 
     import jax
@@ -264,25 +271,47 @@ def main() -> None:
     ))
 
     engines = ["jax", "numpy"] if args.engine == "both" else [args.engine]
-    results = []
-    for engine in engines:
-        results += bench_reduce(engine)
-        results += bench_reduce_bare(engine)
-        results += bench_quantile(engine, args.scale)
-        results += bench_era5_dayofyear(engine, args.scale)
-        results += bench_era5_resampling(engine, args.scale)
-        results += bench_nwm_zonal(engine, args.scale)
-        results += bench_random_big(engine, args.scale)
-        results += bench_scan(engine, args.scale)
-    if "jax" in engines:
-        # mesh benchmarks need a working jax backend; keep --engine numpy
-        # runnable on hosts without one
-        results += bench_mesh_methods(args.scale)
-        results += bench_scan_blelloch(args.scale)
-        results += bench_streaming(args.scale)
-    results += bench_cohort_detection(args.scale)
-    for r in results:
-        print(json.dumps(r))
+
+    def one_sweep():
+        results = []
+        for engine in engines:
+            results += bench_reduce(engine)
+            results += bench_reduce_bare(engine)
+            results += bench_quantile(engine, args.scale)
+            results += bench_era5_dayofyear(engine, args.scale)
+            results += bench_era5_resampling(engine, args.scale)
+            results += bench_nwm_zonal(engine, args.scale)
+            results += bench_random_big(engine, args.scale)
+            results += bench_scan(engine, args.scale)
+        if "jax" in engines:
+            # mesh benchmarks need a working jax backend; keep --engine numpy
+            # runnable on hosts without one
+            results += bench_mesh_methods(args.scale)
+            results += bench_scan_blelloch(args.scale)
+            results += bench_streaming(args.scale)
+        results += bench_cohort_detection(args.scale)
+        return results
+
+    sweeps = [one_sweep() for _ in range(max(1, args.sweeps))]
+    print(json.dumps({
+        "bench": "timer", "value": f"median-of-{len(sweeps)}-sweeps",
+        "unit": "config",
+    }))
+    # per-row median across sweeps; non-numeric rows pass through from the
+    # first sweep (config rows are sweep-invariant)
+    by_name: dict = {}
+    for sweep in sweeps:
+        for r in sweep:
+            by_name.setdefault(r["bench"], []).append(r)
+    for name, rows in by_name.items():
+        vals = sorted(r["value"] for r in rows if isinstance(r["value"], (int, float)))
+        if vals:
+            k = len(vals)
+            med = vals[k // 2] if k % 2 else round((vals[k // 2 - 1] + vals[k // 2]) / 2, 6)
+            out = dict(rows[0], value=med)
+        else:
+            out = rows[0]
+        print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -464,19 +464,22 @@ def sharded_groupby_reduce(
     )
     fn = _PROGRAM_CACHE.get(cache_key)
     if fn is None:
-        program = _build_program(
-            agg, size=size, size_pad=size_pad, method=method, axis_name=axes,
-            shard_len=shard_len, nat=nat, cohort_perm=cohort_perm,
-            blocked=blocked, ndev=ndev,
-        )
-        # check_vma=False: outputs are replicated by construction (psum /
-        # all_gather), but the static checker cannot infer that through
-        # argmin/take_along_axis owner-selection.
-        fn = jax.jit(
-            jax.shard_map(
-                program, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        from ..profiling import timed
+
+        with timed(f"sharded program build [{agg.name}/{method}]"):
+            program = _build_program(
+                agg, size=size, size_pad=size_pad, method=method, axis_name=axes,
+                shard_len=shard_len, nat=nat, cohort_perm=cohort_perm,
+                blocked=blocked, ndev=ndev,
             )
-        )
+            # check_vma=False: outputs are replicated by construction (psum /
+            # all_gather), but the static checker cannot infer that through
+            # argmin/take_along_axis owner-selection.
+            fn = jax.jit(
+                jax.shard_map(
+                    program, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+                )
+            )
         if len(_PROGRAM_CACHE) > 256:
             _PROGRAM_CACHE.clear()
         _PROGRAM_CACHE[cache_key] = fn

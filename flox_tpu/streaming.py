@@ -309,33 +309,35 @@ def streaming_groupby_reduce(
         else:
             step, final = pair
     else:
-        step = _build_step(
-            agg, size=size, batch_len=batch_len, count_skipna=count_skipna, nat=nat
-        )
+        step = _build_step(agg, size=size, count_skipna=count_skipna, nat=nat)
     nbatches = math.ceil(n / batch_len)
 
-    state = None
-    for i in range(nbatches):
-        s, e = i * batch_len, min((i + 1) * batch_len, n)
-        slab = np.asarray(loader(s, e))
-        ccodes = codes[s:e]
-        pad = batch_len - (e - s)
-        if pad:
-            slab = np.concatenate(
-                [slab, np.zeros(lead_shape + (pad,), slab.dtype)], axis=-1
-            )
-            ccodes = np.concatenate([ccodes, np.full(pad, -1, dtype=ccodes.dtype)])
-        if mesh is not None:
-            import jax
+    from .profiling import timed
 
-            # one host->N-device scatter per slab: each chip receives and
-            # reduces its contiguous 1/ndev of the slab
-            slab_dev = jax.device_put(slab, slab_shard)
-            ccodes_dev = jax.device_put(np.ascontiguousarray(ccodes), codes_shard)
-        else:
-            slab_dev, ccodes_dev = jnp.asarray(slab), jnp.asarray(ccodes)
-        # async dispatch: this queues on device while the host loads slab i+1
-        state = step(state, slab_dev, ccodes_dev, jnp.asarray(np.int64(s)))
+    state = None
+    with timed(f"stream [{agg.name}] {nbatches} slab(s) x {batch_len}"):
+        for i in range(nbatches):
+            s, e = i * batch_len, min((i + 1) * batch_len, n)
+            slab = np.asarray(loader(s, e))
+            ccodes = codes[s:e]
+            pad = batch_len - (e - s)
+            if pad:
+                slab = np.concatenate(
+                    [slab, np.zeros(lead_shape + (pad,), slab.dtype)], axis=-1
+                )
+                ccodes = np.concatenate([ccodes, np.full(pad, -1, dtype=ccodes.dtype)])
+            if mesh is not None:
+                import jax
+
+                # one host->N-device scatter per slab: each chip receives and
+                # reduces its contiguous 1/ndev of the slab
+                slab_dev = jax.device_put(slab, slab_shard)
+                ccodes_dev = jax.device_put(np.ascontiguousarray(ccodes), codes_shard)
+            else:
+                slab_dev, ccodes_dev = jnp.asarray(slab), jnp.asarray(ccodes)
+            # async dispatch: this queues on device while the host loads
+            # slab i+1 (the timed block measures dispatch, not device work)
+            state = step(state, slab_dev, ccodes_dev, jnp.asarray(np.int64(s)))
 
     if mesh is not None:
         result = final(state)
@@ -440,7 +442,7 @@ def _merge_into(agg: Aggregation, state, inters, counts, *, nat: bool):
     return out, acc_counts + counts
 
 
-def _build_step(agg: Aggregation, *, size: int, batch_len: int, count_skipna: bool,
+def _build_step(agg: Aggregation, *, size: int, count_skipna: bool,
                 nat: bool = False):
     """One jitted step: slab -> chunk intermediates -> merge into state."""
     import jax

@@ -1,7 +1,9 @@
 """Contract tests for the adapter's real-xarray branches (VERDICT r3 #4).
 
 xarray cannot be installed in this environment (pip has no network; the
-attempt fails resolving pypi.org), so the ``HAS_XARRAY`` branches of
+attempt fails resolving pypi.org — retried and still dead 2026-07-30,
+round 5: ``pip install xarray`` and ``pip download xarray --no-deps``
+both return "no matching distribution"), so the ``HAS_XARRAY`` branches of
 ``flox_tpu.xarray`` would otherwise never execute. This module installs a
 mock ``xarray`` package implementing the EXACT API subset those branches
 touch — method-delegate reductions with real-xarray signatures
@@ -219,3 +221,92 @@ def test_multiindex_groups_use_coordinates_api(real_xr):
     assert isinstance(groups, pd.MultiIndex)
     assert list(groups.names) == ["letter", "num"]
     np.testing.assert_allclose(np.asarray(out.data), [4.0, 6.0, 8.0, 10.0])
+
+
+# ---------------------------------------------------------------------------
+# high-value behaviors from xarray's own test_groupby.py (VERDICT r4 #7),
+# asserted against BOTH the xrlite binding and the mock-real-xarray binding
+# so neither backend can drift: groupby_bins labels, resample-shaped time
+# groupers, and the Dataset attrs policy.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=["xrlite", "mock"])
+def da_cls(request, monkeypatch):
+    """DataArray class under the selected binding. 'xrlite' runs the
+    bundled fallback (HAS_XARRAY False, the env default); 'mock' installs
+    the real-xarray API mock."""
+    if request.param == "mock":
+        import flox_tpu.utils
+
+        mod = _build_mock_xarray()
+        monkeypatch.setitem(sys.modules, "xarray", mod)
+        monkeypatch.setattr(flox_tpu.utils, "HAS_XARRAY", True)
+        monkeypatch.setattr(fxr, "HAS_XARRAY", True)
+        CALLS.clear()
+        return MockDataArray
+    return xrlite.DataArray
+
+
+def test_groupby_bins_labels(da_cls):
+    # xarray test_groupby.py::test_groupby_bins — the output dim is named
+    # "{name}_bins" and its coordinate is the right-closed IntervalIndex
+    # pd.cut would produce; out-of-range values fall outside every bin
+    vals = da_cls(np.arange(10.0), dims=("x",), name="v")
+    by = da_cls(
+        np.array([1, 1, 2, 3, 4, 5, 6, 7, 8, 20], dtype=float),
+        dims=("x",), name="g",
+    )
+    out = fxr.xarray_reduce(
+        vals, by, func="sum", expected_groups=np.array([0, 3, 6, 10]),
+        isbin=True, fill_value=0.0,
+    )
+    assert "g_bins" in out.dims
+    groups = out["g_bins"].data
+    assert isinstance(groups, pd.IntervalIndex)
+    assert groups.closed == "right"
+    np.testing.assert_array_equal(groups.left, [0, 3, 6])
+    np.testing.assert_array_equal(groups.right, [3, 6, 10])
+    # (0,3]: by 1,1,2,3 -> 0+1+2+3; (3,6]: 4,5,6 -> 4+5+6; (6,10]: 7,8
+    # (the 20 falls outside every bin and must not contribute)
+    np.testing.assert_allclose(np.asarray(out.data), [6.0, 15.0, 15.0])
+
+
+def test_resample_shaped_time_grouper(da_cls):
+    # xarray test_groupby.py::test_groupby_resample-shape: hourly data
+    # grouped by its floor-to-day datetime labels — the result coordinate
+    # carries the datetime64 day labels in order
+    hours = np.arange(72, dtype="timedelta64[h]")
+    times = np.datetime64("2001-01-01", "ns") + hours
+    days = times.astype("datetime64[D]")
+    obj = da_cls(np.arange(72.0), dims=("time",), name="v")
+    by = da_cls(days, dims=("time",), name="date")
+    out = fxr.xarray_reduce(obj, by, func="mean")
+    groups = np.asarray(out["date"].data)
+    np.testing.assert_array_equal(
+        groups.astype("datetime64[D]"),
+        np.array(["2001-01-01", "2001-01-02", "2001-01-03"], dtype="datetime64[D]"),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.data),
+        [np.arange(24).mean(), np.arange(24, 48).mean(), np.arange(48, 72).mean()],
+    )
+
+
+def test_dataset_attrs_policy(da_cls):
+    # xarray's keep_attrs contract on Datasets: True keeps BOTH the
+    # Dataset attrs and each variable's attrs; False drops both
+    a = da_cls(np.arange(8.0), dims=("x",), name="a", attrs={"units": "K"})
+    b = da_cls(np.arange(8.0) * 2, dims=("x",), name="b", attrs={"units": "m"})
+    ds = xrlite.Dataset({"a": a, "b": b}, attrs={"title": "t0"})
+    by = da_cls(np.arange(8) % 2, dims=("x",), name="g")
+
+    kept = fxr.xarray_reduce(ds, by, func="sum", keep_attrs=True)
+    assert kept.attrs == {"title": "t0"}
+    assert kept["a"].attrs == {"units": "K"}
+    assert kept["b"].attrs == {"units": "m"}
+    np.testing.assert_allclose(np.asarray(kept["a"].data), [12.0, 16.0])
+
+    dropped = fxr.xarray_reduce(ds, by, func="sum", keep_attrs=False)
+    assert dropped.attrs in ({}, None) or not dropped.attrs
+    assert not dropped["a"].attrs
