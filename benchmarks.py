@@ -169,6 +169,14 @@ def bench_mesh_methods(scale: str):
             )
         )
         out.append({"bench": f"time_mesh_combine[{method}]", "value": round(t * 1e3, 2), "unit": "ms"})
+    # distributed order statistics (radix-select counting passes psum'd):
+    # the capability row the reference cannot run at all
+    t = _timeit(
+        lambda: _block(
+            groupby_reduce(vals, labels, func="nanmedian", method="map-reduce", mesh=mesh)[0]
+        )
+    )
+    out.append({"bench": "time_mesh_quantile[nanmedian-mapreduce]", "value": round(t * 1e3, 2), "unit": "ms"})
     return out
 
 

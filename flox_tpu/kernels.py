@@ -1015,7 +1015,7 @@ def _uint_to_value(key, dtype):
     return jax.lax.bitcast_convert_type(bits.astype(ut), dtype)
 
 
-def _radix_select(data, codes, size, ranks, valid_mask):
+def _radix_select(data, codes, size, ranks, valid_mask, axis_name=None):
     """Exact per-group order statistics WITHOUT sorting: MSB radix
     bisection over the monotonic integer view of ``data``.
 
@@ -1031,6 +1031,15 @@ def _radix_select(data, codes, size, ranks, valid_mask):
     stack into one widened segment-sum). The sort-free analogue of the
     reference's complex-partition trick (aggregate_flox.py:50-130), shaped
     for the hardware instead of for numpy.
+
+    ``axis_name``: mesh axis name(s) when running inside ``shard_map`` on a
+    SHARD of the data. The bisection state (prefix, rank) is per-group and
+    replicated; the only cross-element op is the counting segment-sum, so a
+    ``psum`` per pass makes the selection exactly global — the selected
+    value is reconstructed bit-by-bit from the counts, never gathered from
+    any one shard. This is what lets quantile/median run method='map-reduce'
+    on a mesh (the reference must force blockwise for order statistics,
+    core.py:685-709: its combine would need whole groups on one worker).
     """
     ut = _uint_type(data.dtype)
     nbits = jnp.dtype(ut).itemsize * 8
@@ -1041,8 +1050,11 @@ def _radix_select(data, codes, size, ranks, valid_mask):
         # targeting the first nn elements can never land on one
         keys = jnp.where(valid_mask, keys, ~jnp.zeros((), ut))
     n = data.shape[0]
-    # counts ride f32 (the MXU path) when they cannot overflow its exact
-    # integer range; int32 scatter otherwise
+    if axis_name is not None:
+        axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        n = n * int(np.prod([jax.lax.axis_size(a) for a in axes]))
+    # counts ride f32 (the MXU path) when the GLOBAL count cannot overflow
+    # its exact integer range; int32 scatter otherwise
     cdtype = jnp.float32 if n < 2**24 else jnp.int32
     m = ranks.shape[0]
     trail = data.shape[1:]
@@ -1064,6 +1076,9 @@ def _radix_select(data, codes, size, ranks, valid_mask):
         # one widened segment-sum counts every rank lane in a single pass
         cnt = _seg("sum", jnp.moveaxis(pred, 0, -1).astype(cdtype), codes, size)
         cnt = jnp.moveaxis(cnt, -1, 0).astype(jnp.int32)  # (m, size, ...)
+        if axis_name is not None:
+            # int32 psum: exact, and local f32 counts were exact below 2^24
+            cnt = jax.lax.psum(cnt, axis_name)
         take_hi = rank >= cnt
         bit = jnp.asarray(1, ut) << bshift
         return (
@@ -1087,7 +1102,8 @@ def _quantile_impl_choice() -> str:
     return policy
 
 
-def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna, method="linear"):
+def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna,
+                   method="linear", axis_name=None):
     codes = _safe_codes(group_idx, size)
     data = _to_leading(array)
     if not jnp.issubdtype(data.dtype, jnp.floating):
@@ -1096,12 +1112,17 @@ def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna, meth
     mask = _nan_mask(data)
     if not skipna and mask is not None:
         # NaN propagates: a group containing any NaN yields NaN.
-        group_has_nan = _seg("max", (~mask).astype(jnp.int8), codes, size) > 0
+        has_nan_local = _seg("max", (~mask).astype(jnp.int8), codes, size)
+        if axis_name is not None:
+            has_nan_local = jax.lax.pmax(has_nan_local, axis_name)
+        group_has_nan = has_nan_local > 0
     else:
         group_has_nan = None
     qs = np.atleast_1d(np.asarray(q, dtype=np.float64))
     scalar_q = np.ndim(q) == 0
-    sel = _quantile_impl_choice() == "select"
+    # on a mesh shard only the counting bisection distributes (the sort
+    # path would sort shard-locally and select wrong elements)
+    sel = axis_name is not None or _quantile_impl_choice() == "select"
 
     if sel:
         sorted_data = data  # only its shape/dtype are consulted below
@@ -1116,6 +1137,8 @@ def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna, meth
         # range.
         off_b = offsets.reshape((size,) + (1,) * (sorted_data.ndim - 1))
     nn = _counts(codes, size, mask=mask)  # non-NaN counts, (size, ...) or (size,)
+    if axis_name is not None:
+        nn = jax.lax.psum(nn, axis_name)  # global group sizes
     nn_full = jnp.broadcast_to(
         _bcast_present(nn, sorted_data[:1]), (size,) + sorted_data.shape[1:]
     )
@@ -1176,7 +1199,9 @@ def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna, meth
                 ia, ib = len(rank_list), len(rank_list) + 1
                 rank_list += [lo_in, hi_in]
             meta.append((pos, lo_in, ia, ib))
-        selected = _radix_select(data, codes, size, jnp.stack(rank_list), mask)
+        selected = _radix_select(
+            data, codes, size, jnp.stack(rank_list), mask, axis_name=axis_name
+        )
 
     for k, qi in enumerate(qs):
         if sel:
@@ -1217,20 +1242,20 @@ def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna, meth
     return jnp.stack(outs, axis=0)
 
 
-def quantile(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, q, method="linear", **kw):
-    return _quantile_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, q=q, skipna=False, method=method)
+def quantile(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, q, method="linear", axis_name=None, **kw):
+    return _quantile_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, q=q, skipna=False, method=method, axis_name=axis_name)
 
 
-def nanquantile(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, q, method="linear", **kw):
-    return _quantile_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, q=q, skipna=True, method=method)
+def nanquantile(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, q, method="linear", axis_name=None, **kw):
+    return _quantile_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, q=q, skipna=True, method=method, axis_name=axis_name)
 
 
-def median(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
-    return _quantile_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, q=0.5, skipna=False)
+def median(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, axis_name=None, **kw):
+    return _quantile_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, q=0.5, skipna=False, axis_name=axis_name)
 
 
-def nanmedian(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
-    return _quantile_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, q=0.5, skipna=True)
+def nanmedian(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, axis_name=None, **kw):
+    return _quantile_impl(group_idx, array, size=size, fill_value=fill_value, dtype=dtype, q=0.5, skipna=True, axis_name=axis_name)
 
 
 def _mode_impl(group_idx, array, *, size, fill_value, skipna):

@@ -277,6 +277,12 @@ def _cached_mesh_default():
     return make_mesh()
 
 
+# order statistics whose device kernel can distribute by psum-ing the
+# radix-select counting passes (kernels._radix_select axis_name=). mode is
+# NOT here: its run-length structure needs contiguous sorted groups.
+_DISTRIBUTED_ORDER_STATS = ("median", "nanmedian", "quantile", "nanquantile")
+
+
 def _is_additive(agg: Aggregation) -> bool:
     """Combines expressible as psum / psum_scatter (the ops the cohorts and
     blocked programs can distribute by group ownership)."""
@@ -349,11 +355,27 @@ def sharded_groupby_reduce(
     ndev = int(np.prod([mesh.shape[a] for a in axes]))
 
     if agg.blockwise_only and method != "blockwise":
-        raise NotImplementedError(
-            f"{agg.name!r} needs whole groups on one shard; use method='blockwise' "
-            "with shard-local groups (rechunk.reshard_for_blockwise prepares that "
-            "layout — the reference forces blockwise for these too, core.py:685-709)."
-        )
+        if agg.name in _DISTRIBUTED_ORDER_STATS:
+            # quantile/median DO run distributed here — the radix-select
+            # bisection's counting passes psum across shards, so no shard
+            # ever needs a whole group (kernels._radix_select). The
+            # reference must force blockwise for order statistics
+            # (core.py:685-709); this framework does not.
+            if method == "cohorts":
+                import logging
+
+                logging.getLogger("flox_tpu").debug(
+                    "%s: cohorts has no ownership win for order statistics; "
+                    "running the distributed radix-select map-reduce program",
+                    agg.name,
+                )
+            method = "map-reduce"
+        else:
+            raise NotImplementedError(
+                f"{agg.name!r} needs whole groups on one shard; use method='blockwise' "
+                "with shard-local groups (rechunk.reshard_for_blockwise prepares that "
+                "layout — the reference forces blockwise for these too, core.py:685-709)."
+            )
 
     if agg.appended_count:
         # the mesh programs compute counts themselves; the appended nanlen
@@ -464,25 +486,28 @@ def sharded_groupby_reduce(
     )
     fn = _PROGRAM_CACHE.get(cache_key)
     if fn is None:
-        from ..profiling import timed
-
-        with timed(f"sharded program build [{agg.name}/{method}]"):
-            program = _build_program(
-                agg, size=size, size_pad=size_pad, method=method, axis_name=axes,
-                shard_len=shard_len, nat=nat, cohort_perm=cohort_perm,
-                blocked=blocked, ndev=ndev,
+        program = _build_program(
+            agg, size=size, size_pad=size_pad, method=method, axis_name=axes,
+            shard_len=shard_len, nat=nat, cohort_perm=cohort_perm,
+            blocked=blocked, ndev=ndev,
+        )
+        # check_vma=False: outputs are replicated by construction (psum /
+        # all_gather), but the static checker cannot infer that through
+        # argmin/take_along_axis owner-selection.
+        fn = jax.jit(
+            jax.shard_map(
+                program, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
             )
-            # check_vma=False: outputs are replicated by construction (psum /
-            # all_gather), but the static checker cannot infer that through
-            # argmin/take_along_axis owner-selection.
-            fn = jax.jit(
-                jax.shard_map(
-                    program, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
-                )
-            )
+        )
         if len(_PROGRAM_CACHE) > 256:
             _PROGRAM_CACHE.clear()
         _PROGRAM_CACHE[cache_key] = fn
+        from ..profiling import timed
+
+        # jit/shard_map construction is lazy — trace + XLA compile happen
+        # on the first call, so THAT is what the build timer must wrap
+        with timed(f"sharded program trace+compile+first-run [{agg.name}/{method}]"):
+            return fn(arr, codes_dev)
     return fn(arr, codes_dev)
 
 
@@ -577,6 +602,33 @@ def _build_program(
 
     def finalize(combined, counts):
         return _finalize_combined(agg, combined, counts)
+
+    def numpy_kernel(f, codes_sh, arr_sh, **extra):
+        """Invoke one whole-reduction (agg.numpy) kernel — the SINGLE place
+        the orderstat and blockwise programs assemble finalize_kwargs/nat,
+        so the two paths cannot drift."""
+        kw = dict(agg.finalize_kwargs)
+        if nat:
+            kw["nat"] = True
+        kw.update(extra)
+        if callable(f):
+            return f(codes_sh, arr_sh, size=size, fill_value=None, **kw)
+        from ..kernels import generic_kernel
+
+        return generic_kernel(f, codes_sh, arr_sh, size=size, fill_value=None, **kw)
+
+    def orderstat_program(arr_sh, codes_sh):
+        """Distributed quantile/median: ONE kernel call whose radix-select
+        counting passes psum across shards (kernels._quantile_impl
+        axis_name=). The selected value is reconstructed bit-by-bit from
+        the global counts — never gathered from any single shard — so the
+        result is replicated by construction. Capability the reference
+        does not have: it forces method='blockwise' for order statistics
+        (core.py:685-709)."""
+        counts_local = _local_counts(codes_sh, arr_sh, size, count_skipna, nat)
+        counts = jax.lax.psum(counts_local, axis_name)
+        result = numpy_kernel(agg.numpy[0], codes_sh, arr_sh, axis_name=axis_name)
+        return _apply_final_fill(result, counts, agg)
 
     def mapreduce_program(arr_sh, codes_sh):
         counts_local = _local_counts(codes_sh, arr_sh, size, count_skipna, nat)
@@ -714,18 +766,8 @@ def _build_program(
         return from_slots(jnp.moveaxis(full, 0, -1))
 
     def blockwise_program(arr_sh, codes_sh):
-        from ..kernels import generic_kernel
-
         counts_local = _local_counts(codes_sh, arr_sh, size, count_skipna, nat)
-        kw = dict(agg.finalize_kwargs)
-        if nat:
-            kw["nat"] = True
-        locals_ = [
-            f(codes_sh, arr_sh, size=size, fill_value=None, **kw)
-            if callable(f)
-            else generic_kernel(f, codes_sh, arr_sh, size=size, fill_value=None, **kw)
-            for f in agg.numpy
-        ]
+        locals_ = [numpy_kernel(f, codes_sh, arr_sh) for f in agg.numpy]
         if agg.reduction_type == "argreduce" and len(locals_) > 1:
             result_local = locals_[1]
         elif agg.finalize is not None and len(agg.numpy) > 1:
@@ -750,7 +792,7 @@ def _build_program(
         return _apply_final_fill(result, counts, agg)
 
     if method == "map-reduce":
-        return mapreduce_program
+        return orderstat_program if agg.blockwise_only else mapreduce_program
     if method == "cohorts":
         return cohorts_program
     if method == "blockwise":
