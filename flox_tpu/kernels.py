@@ -1043,12 +1043,7 @@ def _radix_select(data, codes, size, ranks, valid_mask, axis_name=None):
     """
     ut = _uint_type(data.dtype)
     nbits = jnp.dtype(ut).itemsize * 8
-    keys = _monotonic_uint(data)
-    if valid_mask is not None:
-        # invalid lanes get the maximal key: every valid key is strictly
-        # below it (valid data is never NaN-with-full-payload), so ranks
-        # targeting the first nn elements can never land on one
-        keys = jnp.where(valid_mask, keys, ~jnp.zeros((), ut))
+    keys = _valid_keys(data, valid_mask)
     n = data.shape[0]
     if axis_name is not None:
         axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
@@ -1058,36 +1053,117 @@ def _radix_select(data, codes, size, ranks, valid_mask, axis_name=None):
     cdtype = jnp.float32 if n < 2**24 else jnp.int32
     m = ranks.shape[0]
     trail = data.shape[1:]
-    pad_row = jnp.zeros((m, 1) + trail, ut)
-
-    def gather(table):  # (m, size, ...) -> (m, n, ...): per-element value
-        return jnp.take(jnp.concatenate([table, pad_row], axis=1), codes, axis=1)
 
     state0 = (jnp.zeros((m, size) + trail, ut), ranks.astype(jnp.int32))
 
     def body(i, st):
         prefix, rank = st
-        b = nbits - 1 - i
-        bshift = jnp.asarray(b, ut)
-        shifted = jnp.right_shift(keys, bshift)
-        # candidate subtree with bit b == 0: high bits match the prefix
-        # (whose bit b is still 0) after the shift
-        pred = shifted[None] == gather(jnp.right_shift(prefix, bshift))
-        # one widened segment-sum counts every rank lane in a single pass
-        cnt = _seg("sum", jnp.moveaxis(pred, 0, -1).astype(cdtype), codes, size)
-        cnt = jnp.moveaxis(cnt, -1, 0).astype(jnp.int32)  # (m, size, ...)
+        bshift = jnp.asarray(nbits - 1 - i, ut)
+        cnt = _radix_pass_count(keys, codes, size, prefix, bshift, cdtype)
         if axis_name is not None:
             # int32 psum: exact, and local f32 counts were exact below 2^24
             cnt = jax.lax.psum(cnt, axis_name)
-        take_hi = rank >= cnt
-        bit = jnp.asarray(1, ut) << bshift
-        return (
-            jnp.where(take_hi, prefix | bit, prefix),
-            jnp.where(take_hi, rank - cnt, rank),
-        )
+        return _radix_update(prefix, rank, cnt, bshift)
 
     prefix, _ = jax.lax.fori_loop(0, nbits, body, state0)
     return _uint_to_value(prefix, data.dtype)
+
+
+def _valid_keys(data, valid_mask):
+    """Monotonic uint view with invalid lanes parked at the maximal key:
+    every valid key is strictly below it (valid data is never
+    NaN-with-full-payload), so ranks targeting the first nn elements can
+    never land on one."""
+    ut = _uint_type(data.dtype)
+    keys = _monotonic_uint(data)
+    if valid_mask is not None:
+        keys = jnp.where(valid_mask, keys, ~jnp.zeros((), ut))
+    return keys
+
+
+def _radix_pass_count(keys, codes, size, prefix, bshift, cdtype):
+    """One counting pass of the radix bisection: per rank lane, how many
+    elements fall in the candidate subtree whose high bits match the
+    prefix. Shared by the eager/mesh select (fori body above) and the
+    streaming driver (streaming._stream_quantile), which accumulates it
+    slab by slab."""
+    ut = keys.dtype
+    m = prefix.shape[0]
+    pad_row = jnp.zeros((m, 1) + keys.shape[1:], ut)
+    shifted = jnp.right_shift(keys, bshift)
+    # candidate subtree with bit b == 0: high bits match the prefix
+    # (whose bit b is still 0) after the shift
+    table = jnp.concatenate([jnp.right_shift(prefix, bshift), pad_row], axis=1)
+    pred = shifted[None] == jnp.take(table, codes, axis=1)
+    # one widened segment-sum counts every rank lane in a single pass
+    cnt = _seg("sum", jnp.moveaxis(pred, 0, -1).astype(cdtype), codes, size)
+    return jnp.moveaxis(cnt, -1, 0).astype(jnp.int32)  # (m, size, ...)
+
+
+def _radix_update(prefix, rank, cnt, bshift):
+    """Bisection step: lanes whose rank falls past the zero-subtree count
+    descend into the one-subtree (set bit b, discount the count)."""
+    take_hi = rank >= cnt
+    bit = jnp.asarray(1, prefix.dtype) << bshift
+    return (
+        jnp.where(take_hi, prefix | bit, prefix),
+        jnp.where(take_hi, rank - cnt, rank),
+    )
+
+
+# Continuous interpolation families share numpy's (alpha, beta)
+# plotting-position parametrization: h = q*(n + 1 - a - b) + a - 1,
+# clipped to [0, n-1], linearly interpolated. The discrete variants
+# (lower/higher/nearest/midpoint) derive from the linear h.
+_ALPHA_BETA = {
+    "linear": (1.0, 1.0),
+    "hazen": (0.5, 0.5),
+    "weibull": (0.0, 0.0),
+    "interpolated_inverted_cdf": (0.0, 1.0),
+    "median_unbiased": (1 / 3, 1 / 3),
+    "normal_unbiased": (3 / 8, 3 / 8),
+}
+
+
+def _quantile_alpha_beta(method: str):
+    if method in _ALPHA_BETA:
+        return _ALPHA_BETA[method]
+    if method in ("lower", "higher", "nearest", "midpoint"):
+        return 1.0, 1.0
+    raise ValueError(
+        f"Unsupported quantile method {method!r}; supported: "
+        f"{sorted(_ALPHA_BETA) + ['lower', 'higher', 'nearest', 'midpoint']} "
+        "(the numpy engine additionally supports every np.quantile method)."
+    )
+
+
+def _quantile_rank_sets(qs, nnf, method, alpha, beta):
+    """Every within-group rank the stacked bisection must select, across
+    ALL q values (each counting pass serves every lane), plus per-q meta
+    (pos, lo_in, ia, ib) for the interpolation. Shared by the eager/mesh
+    select and the streaming driver."""
+    rank_list: list = []
+    meta = []
+    for qi in qs:
+        pos = qi * (nnf + 1 - alpha - beta) + (alpha - 1)
+        pos = jnp.clip(pos, 0, jnp.maximum(nnf - 1, 0))
+        lo_in = jnp.floor(pos).astype(jnp.int32)
+        hi_in = jnp.ceil(pos).astype(jnp.int32)
+        if method == "nearest":
+            # np.quantile rounds the virtual index half-to-even
+            ia = ib = len(rank_list)
+            rank_list.append(jnp.round(pos).astype(jnp.int32))
+        elif method == "lower":
+            ia = ib = len(rank_list)
+            rank_list.append(lo_in)
+        elif method == "higher":
+            ia = ib = len(rank_list)
+            rank_list.append(hi_in)
+        else:
+            ia, ib = len(rank_list), len(rank_list) + 1
+            rank_list += [lo_in, hi_in]
+        meta.append((pos, lo_in, ia, ib))
+    return jnp.stack(rank_list), meta
 
 
 def _quantile_impl_choice() -> str:
@@ -1143,28 +1219,7 @@ def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna,
         _bcast_present(nn, sorted_data[:1]), (size,) + sorted_data.shape[1:]
     )
 
-    # Continuous interpolation families share numpy's (alpha, beta)
-    # plotting-position parametrization: h = q*(n + 1 - a - b) + a - 1,
-    # clipped to [0, n-1], linearly interpolated. The discrete variants
-    # (lower/higher/nearest/midpoint) derive from the linear h.
-    _ALPHA_BETA = {
-        "linear": (1.0, 1.0),
-        "hazen": (0.5, 0.5),
-        "weibull": (0.0, 0.0),
-        "interpolated_inverted_cdf": (0.0, 1.0),
-        "median_unbiased": (1 / 3, 1 / 3),
-        "normal_unbiased": (3 / 8, 3 / 8),
-    }
-    if method in _ALPHA_BETA:
-        alpha, beta = _ALPHA_BETA[method]
-    elif method in ("lower", "higher", "nearest", "midpoint"):
-        alpha, beta = 1.0, 1.0
-    else:
-        raise ValueError(
-            f"Unsupported quantile method {method!r}; supported: "
-            f"{sorted(_ALPHA_BETA) + ['lower', 'higher', 'nearest', 'midpoint']} "
-            "(the numpy engine additionally supports every np.quantile method)."
-        )
+    alpha, beta = _quantile_alpha_beta(method)
 
     outs = []
     nmax = sorted_data.shape[0]
@@ -1179,29 +1234,8 @@ def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna,
         return pos, jnp.floor(pos).astype(jnp.int32), jnp.ceil(pos).astype(jnp.int32)
 
     if sel:
-        # collect every rank needed across ALL q values and run ONE stacked
-        # bisection — each of the nbits counting passes serves every lane
-        rank_list: list = []
-        meta = []
-        for qi in qs:
-            pos, lo_in, hi_in = _pos_ranks(qi)
-            if method == "nearest":
-                # np.quantile rounds the virtual index half-to-even
-                ia = ib = len(rank_list)
-                rank_list.append(jnp.round(pos).astype(jnp.int32))
-            elif method == "lower":
-                ia = ib = len(rank_list)
-                rank_list.append(lo_in)
-            elif method == "higher":
-                ia = ib = len(rank_list)
-                rank_list.append(hi_in)
-            else:
-                ia, ib = len(rank_list), len(rank_list) + 1
-                rank_list += [lo_in, hi_in]
-            meta.append((pos, lo_in, ia, ib))
-        selected = _radix_select(
-            data, codes, size, jnp.stack(rank_list), mask, axis_name=axis_name
-        )
+        ranks, meta = _quantile_rank_sets(qs, nnf, method, alpha, beta)
+        selected = _radix_select(data, codes, size, ranks, mask, axis_name=axis_name)
 
     for k, qi in enumerate(qs):
         if sel:

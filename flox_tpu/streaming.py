@@ -75,9 +75,13 @@ def streaming_groupby_reduce(
     flat trailing axis. Loaders define a 1-D axis contract, so they keep
     1-D ``by`` / ``axis=None``.
 
-    Supported: every aggregation with a chunk stage (blockwise-only order
-    statistics — median/quantile/mode — need all of a group at once and
-    cannot stream; use the mesh blockwise method for those).
+    Supported: every aggregation with a chunk stage, PLUS exact
+    quantile/median — the radix-select bisection consumes only per-group
+    counts, which accumulate slab by slab, so order statistics stream in
+    ``nbits + 1`` full passes over the loader (33 for f32; an explicit,
+    documented IO trade — see :func:`_stream_quantile`). ``mode`` cannot
+    stream (run-length structure needs contiguous sorted groups); use the
+    mesh blockwise method for it.
 
     ``mesh=`` composes streaming with the sharded runtime (the
     chunked-runtime × scheduler composition the reference gets from dask,
@@ -194,12 +198,24 @@ def streaming_groupby_reduce(
         # (datetime64 -> int64/float64), so lead shape and itemsize — the
         # only things probe feeds — are unchanged, and a zarr/S3 loader
         # should not pay a second remote chunk read
+    stream_orderstat = False
     if agg.blockwise_only:
-        raise NotImplementedError(
-            f"{agg.name!r} needs whole groups at once and cannot stream; "
-            "use groupby_reduce(method='blockwise', mesh=...) after "
-            "rechunk.reshard_for_blockwise."
-        )
+        if agg.name in ("median", "nanmedian", "quantile", "nanquantile") and mesh is None:
+            # quantile/median DO stream: the radix-select bisection only
+            # ever needs per-group COUNTS, which accumulate slab by slab —
+            # (nbits + 1) full passes over the data (see _stream_quantile)
+            stream_orderstat = True
+        else:
+            hint = (
+                "compose with groupby_reduce(mesh=, method='map-reduce') — "
+                "distributed order statistics run in-memory there"
+                if agg.name not in ("mode", "nanmode")
+                else "use groupby_reduce(method='blockwise', mesh=...) after "
+                "rechunk.reshard_for_blockwise"
+            )
+            raise NotImplementedError(
+                f"{agg.name!r} cannot stream on this path; {hint}."
+            )
     if (
         n >= _BIG
         and not utils.x64_enabled()
@@ -217,6 +233,23 @@ def streaming_groupby_reduce(
     row_bytes = int(np.prod(lead_shape, dtype=np.int64)) * itemsize if lead_shape else itemsize
     if batch_len is None:
         batch_len = max(1, min(n, batch_bytes // max(row_bytes, 1)))
+
+    if stream_orderstat:
+        result = _stream_quantile(
+            agg, loader, codes, size=size, n=n, batch_len=batch_len,
+            lead_shape=tuple(lead_shape),
+            # the datetime wrap changes the effective dtype to float64
+            probe_dtype=np.float64 if datetime_dtype is not None else probe.dtype,
+        )
+        from .core import _astype_final, _index_values
+
+        result = _astype_final(result, agg, datetime_dtype)
+        out_shape = (
+            agg.new_dims() + tuple(lead_shape) + tuple(keep_by_shape) + grp_shape
+        )
+        if result.shape != out_shape:
+            result = result.reshape(out_shape)
+        return (result,) + tuple(_index_values(g) for g in found_groups)
 
     skipna = agg.name.startswith("nan") or agg.name == "count"
     count_skipna = skipna or agg.min_count > 0
@@ -651,6 +684,160 @@ def _build_mesh_final_blocked(agg: Aggregation, *, size: int, mesh, axes):
             check_vma=False,
         )
     )
+
+
+def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
+                     batch_len: int, lead_shape: tuple, probe_dtype):
+    """Out-of-core EXACT quantile/median: the radix-select bisection
+    (kernels._radix_select) only ever consumes per-group COUNTS, and counts
+    accumulate slab by slab — so order statistics stream in ``nbits + 1``
+    full passes over the loader (1 count pass + one per key bit; 33 for
+    f32, 65 for f64). The reference cannot do this at all: its chunked
+    quantile requires whole groups per block (dask.py's blockwise
+    constraint). Bit-identical to the eager select path — same counts,
+    same bit-by-bit reconstruction.
+
+    IO cost is the point to understand: the data is read ``nbits + 1``
+    times. For a zarr/S3 loader that is ``nbits + 1`` remote sweeps — an
+    explicit, documented trade for never materializing the array.
+    """
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from .kernels import (
+        _from_leading,
+        _nan_mask,
+        _quantile_alpha_beta,
+        _quantile_rank_sets,
+        _radix_pass_count,
+        _radix_update,
+        _safe_codes,
+        _seg,
+        _to_leading,
+        _uint_type,
+        _uint_to_value,
+        _valid_keys,
+        _counts,
+    )
+    from .profiling import timed
+
+    skipna = agg.name.startswith("nan")
+    fkw = dict(agg.finalize_kwargs)
+    if agg.name in ("median", "nanmedian"):
+        q, method = 0.5, "linear"
+    else:
+        if "q" not in fkw:
+            raise TypeError(f"{agg.name} requires finalize_kwargs={{'q': ...}}")
+        q = fkw["q"]
+        method = fkw.get("method", "linear")
+    qs = np.atleast_1d(np.asarray(q, dtype=np.float64))
+    scalar_q = np.ndim(q) == 0
+    alpha, beta = _quantile_alpha_beta(method)
+
+    nbatches = math.ceil(n / batch_len)
+
+    def slabs():
+        for i in range(nbatches):
+            s, e = i * batch_len, min((i + 1) * batch_len, n)
+            slab = np.asarray(loader(s, e))
+            ccodes = codes[s:e]
+            pad = batch_len - (e - s)
+            if pad:
+                slab = np.concatenate(
+                    [slab, np.zeros(lead_shape + (pad,), slab.dtype)], axis=-1
+                )
+                ccodes = np.concatenate([ccodes, np.full(pad, -1, dtype=ccodes.dtype)])
+            yield jnp.asarray(slab), jnp.asarray(ccodes)
+
+    # resolved float dtype: same rule as the eager kernel (probe_dtype comes
+    # from the caller's one probe — no second remote chunk read)
+    if np.issubdtype(probe_dtype, np.floating):
+        fdtype = jnp.dtype(probe_dtype)
+    else:
+        fdtype = jnp.float64 if utils.x64_enabled() else jnp.float32
+    ut = _uint_type(fdtype)
+    nbits = jnp.dtype(ut).itemsize * 8
+    cdtype = jnp.float32 if n < 2**24 else jnp.int32
+
+    def prep(slab):
+        data = _to_leading(slab)
+        if data.dtype != fdtype:
+            data = data.astype(fdtype)
+        return data
+
+    @jax.jit
+    def count_pass(nn, hasnan, slab, ccodes):
+        data = prep(slab)
+        sc = _safe_codes(ccodes, size)
+        mask = _nan_mask(data)
+        nn = nn + _counts(sc, size, mask=mask)
+        if not skipna and mask is not None:
+            hasnan = jnp.maximum(hasnan, _seg("max", (~mask).astype(jnp.int8), sc, size))
+        return nn, hasnan
+
+    @jax.jit
+    def bit_pass(cnt, prefix, slab, ccodes, bshift):
+        data = prep(slab)
+        keys = _valid_keys(data, _nan_mask(data))
+        return cnt + _radix_pass_count(
+            keys, _safe_codes(ccodes, size), size, prefix, bshift, cdtype
+        )
+
+    update = jax.jit(_radix_update)
+
+    trail = lead_shape  # leading layout puts the reduce axis first
+    with timed(f"stream-quantile [{agg.name}] {nbits + 1} passes x {nbatches} slab(s)"):
+        # counts accumulate EXACTLY in int32 (f32 would drift past 2^24 and
+        # shift rank positions — the bit-identity claim rests on this)
+        nn = jnp.zeros((size,) + trail, jnp.int32)
+        hasnan = jnp.zeros((size,) + trail, jnp.int8)
+        for slab, ccodes in slabs():
+            nn, hasnan = count_pass(nn, hasnan, slab, ccodes)
+
+        idx_dtype = jnp.float64 if utils.x64_enabled() else jnp.float32
+        nnf = nn.astype(idx_dtype)
+        ranks, meta = _quantile_rank_sets(qs, nnf, method, alpha, beta)
+        m = ranks.shape[0]
+        prefix = jnp.zeros((m, size) + trail, ut)
+        rank = ranks.astype(jnp.int32)
+        for i in range(nbits):
+            bshift = jnp.asarray(nbits - 1 - i, ut)
+            cnt = jnp.zeros((m, size) + trail, jnp.int32)
+            for slab, ccodes in slabs():
+                cnt = bit_pass(cnt, prefix, slab, ccodes, bshift)
+            prefix, rank = update(prefix, rank, cnt, bshift)
+
+    selected = _uint_to_value(prefix, fdtype)
+    group_has_nan = (hasnan > 0) if not skipna else None
+    fv = agg.final_fill_value
+    try:
+        fv_arr = jnp.asarray(np.nan if fv is None else fv, fdtype)
+    except (TypeError, ValueError):
+        fv_arr = jnp.asarray(jnp.nan, fdtype)
+    threshold = max(agg.min_count, 1)
+
+    outs = []
+    for k, _qi in enumerate(qs):
+        pos, lo_in, ia, ib = meta[k]
+        v_lo, v_hi = selected[ia], selected[ib]
+        frac = (pos - lo_in).astype(fdtype)
+        if method == "lower" or method == "nearest":
+            val = v_lo
+        elif method == "higher":
+            val = v_hi
+        elif method == "midpoint":
+            val = (v_lo + v_hi) / 2
+        else:
+            val = v_lo + frac * (v_hi - v_lo)
+        val = jnp.where(nn < threshold, fv_arr, val)
+        if group_has_nan is not None:
+            val = jnp.where(group_has_nan, jnp.asarray(jnp.nan, fdtype), val)
+        outs.append(_from_leading(val))
+    if scalar_q:
+        return outs[0]
+    return jnp.stack(outs, axis=0)
 
 
 def _argmerge_better(va, vb, arg_of_max: bool):

@@ -87,10 +87,17 @@ def test_min_count(data):
     assert np.isnan(np.asarray(got)).all()  # nothing reaches min_count
 
 
-def test_order_statistics_rejected(data):
+def test_mode_rejected_median_streams(data):
+    # median/quantile stream now (TestStreamingOrderStats); mode's
+    # run-length structure still cannot
     vals, labels = data
     with pytest.raises(NotImplementedError, match="stream"):
-        streaming_groupby_reduce(vals, labels, func="median")
+        streaming_groupby_reduce(vals, labels, func="nanmode")
+    got, _ = streaming_groupby_reduce(vals, labels, func="median", batch_len=2048)
+    ref, _ = groupby_reduce(vals, labels, func="median")
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=5e-16, equal_nan=True
+    )
 
 
 def test_single_batch_degenerate(data):
@@ -413,3 +420,86 @@ class TestMeshStreamingBlocked:
                     vals, labels, func="max", expected_groups=np.arange(size),
                     batch_len=800, mesh=mesh,
                 )
+
+
+class TestStreamingOrderStats:
+    """Out-of-core EXACT quantile/median (beyond-reference capability —
+    the reference's chunked quantile needs whole groups per block): the
+    radix-select bisection consumes only per-group counts, which
+    accumulate slab by slab in nbits+1 passes over the loader."""
+
+    @pytest.fixture(scope="class")
+    def qdata(self):
+        rng = np.random.default_rng(23)
+        n = 5000
+        vals = rng.normal(size=(3, n))
+        vals[:, ::11] = np.nan
+        labels = rng.integers(0, 9, n)
+        return vals, labels
+
+    @pytest.mark.parametrize("func,fkw", [
+        ("nanmedian", None),
+        ("median", None),
+        ("nanquantile", {"q": 0.9}),
+        ("quantile", {"q": [0.25, 0.75]}),
+        ("nanquantile", {"q": 0.3, "method": "nearest"}),
+        ("nanquantile", {"q": 0.6, "method": "midpoint"}),
+        ("nanquantile", {"q": 0.5, "method": "hazen"}),
+    ])
+    def test_matches_eager(self, qdata, func, fkw):
+        vals, labels = qdata
+        expected, eg = groupby_reduce(vals, labels, func=func, finalize_kwargs=fkw)
+        got, g = streaming_groupby_reduce(
+            vals, labels, func=func, finalize_kwargs=fkw, batch_len=700
+        )
+        np.testing.assert_array_equal(g, eg)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=5e-16, equal_nan=True
+        )
+
+    def test_loader_and_int_dtype(self, qdata):
+        _, labels = qdata
+        rng = np.random.default_rng(5)
+        iv = rng.integers(-100, 100, size=labels.shape[0])
+        expected, _ = groupby_reduce(iv, labels, func="median")
+        got, _ = streaming_groupby_reduce(
+            lambda s, e: iv[s:e], labels, func="median", batch_len=640
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-15)
+
+    def test_datetime_nat(self, qdata):
+        _, labels = qdata
+        rng = np.random.default_rng(7)
+        dt = np.datetime64("2020-01-01", "ns") + rng.integers(
+            0, 10**9, labels.shape[0]
+        ).astype("timedelta64[ns]")
+        dt[::17] = np.datetime64("NaT")
+        expected, _ = groupby_reduce(dt, labels, func="nanmedian")
+        got, _ = streaming_groupby_reduce(dt, labels, func="nanmedian", batch_len=640)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+    def test_expected_groups_with_empty(self, qdata):
+        vals, labels = qdata
+        expected, _ = groupby_reduce(
+            vals, labels, func="nanmedian", expected_groups=np.arange(12)
+        )
+        got, _ = streaming_groupby_reduce(
+            vals, labels, func="nanmedian", expected_groups=np.arange(12), batch_len=900
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=5e-16, equal_nan=True
+        )
+
+    def test_mode_still_rejected(self, qdata):
+        vals, labels = qdata
+        with pytest.raises(NotImplementedError, match="cannot stream"):
+            streaming_groupby_reduce(vals, labels, func="mode", batch_len=700)
+
+    def test_mesh_quantile_points_at_sharded_runtime(self, qdata):
+        from flox_tpu.parallel.mesh import make_mesh
+
+        vals, labels = qdata
+        with pytest.raises(NotImplementedError, match="map-reduce"):
+            streaming_groupby_reduce(
+                vals, labels, func="nanmedian", batch_len=700, mesh=make_mesh()
+            )
