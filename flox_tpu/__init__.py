@@ -14,7 +14,7 @@ from .reindex import ReindexArrayType, ReindexStrategy
 from .core import groupby_reduce
 from .device import codes_device, groupby_reduce_device
 from .scan import groupby_scan
-from .streaming import streaming_groupby_reduce
+from .streaming import streaming_groupby_reduce, streaming_groupby_scan
 from .dtypes import INF, NA, NINF
 from .factorize import factorize_, factorize_single
 from .multiarray import MultiArray
@@ -45,6 +45,7 @@ __all__ = [
     "ReindexStrategy",
     "set_options",
     "streaming_groupby_reduce",
+    "streaming_groupby_scan",
     "xarray_reduce",
     "xrlite",
 ]

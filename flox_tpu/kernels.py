@@ -1166,6 +1166,23 @@ def _quantile_rank_sets(qs, nnf, method, alpha, beta):
     return jnp.stack(rank_list), meta
 
 
+def _quantile_interp_value(method, meta_k, selected, dtype):
+    """Interpolate one q's value from the radix-selected order statistics —
+    the ONE place the select-path method branches live, shared by the
+    eager/mesh kernel and the streaming driver (streaming._stream_quantile).
+    'nearest' selected its rounded rank directly, so it reads v_lo."""
+    pos, lo_in, ia, ib = meta_k
+    v_lo, v_hi = selected[ia], selected[ib]
+    if method in ("lower", "nearest"):
+        return v_lo
+    if method == "higher":
+        return v_hi
+    if method == "midpoint":
+        return (v_lo + v_hi) / 2
+    frac = (pos - lo_in).astype(dtype)
+    return v_lo + frac * (v_hi - v_lo)
+
+
 def _quantile_impl_choice() -> str:
     from .options import OPTIONS
 
@@ -1239,30 +1256,26 @@ def _quantile_impl(group_idx, array, *, size, fill_value, dtype, q, skipna,
 
     for k, qi in enumerate(qs):
         if sel:
-            pos, lo_in, ia, ib = meta[k]
-            v_lo, v_hi = selected[ia], selected[ib]
+            val = _quantile_interp_value(method, meta[k], selected, sorted_data.dtype)
         else:
             pos, lo_in, hi_in = _pos_ranks(qi)
             lo_c = jnp.clip(off_b + lo_in, 0, nmax - 1)
             hi_c = jnp.clip(off_b + hi_in, 0, nmax - 1)
             v_lo = jnp.take_along_axis(sorted_data, lo_c, axis=0)
             v_hi = jnp.take_along_axis(sorted_data, hi_c, axis=0)
-        frac = (pos - lo_in).astype(sorted_data.dtype)
-        if method == "lower":
-            val = v_lo
-        elif method == "higher":
-            val = v_hi
-        elif method == "nearest":
-            if sel:
-                val = v_lo  # the rounded rank was selected directly
-            else:
+            frac = (pos - lo_in).astype(sorted_data.dtype)
+            if method == "lower":
+                val = v_lo
+            elif method == "higher":
+                val = v_hi
+            elif method == "nearest":
                 # np.quantile rounds the virtual index half-to-even
                 nr = jnp.clip(off_b + jnp.round(pos).astype(jnp.int32), 0, nmax - 1)
                 val = jnp.take_along_axis(sorted_data, nr, axis=0)
-        elif method == "midpoint":
-            val = (v_lo + v_hi) / 2
-        else:  # all continuous families: linear interpolation at h
-            val = v_lo + frac * (v_hi - v_lo)
+            elif method == "midpoint":
+                val = (v_lo + v_hi) / 2
+            else:  # all continuous families: linear interpolation at h
+                val = v_lo + frac * (v_hi - v_lo)
         empty = nn_full <= 0
         fv = fill_value if fill_value is not None else jnp.nan
         val = jnp.where(empty, jnp.asarray(fv).astype(val.dtype), val)

@@ -33,7 +33,7 @@ from . import factorize as fct, utils
 from .aggregations import Aggregation, _initialize_aggregation
 from .multiarray import MultiArray
 
-__all__ = ["streaming_groupby_reduce"]
+__all__ = ["streaming_groupby_reduce", "streaming_groupby_scan"]
 
 _BIG = np.iinfo(np.int32).max
 
@@ -686,6 +686,218 @@ def _build_mesh_final_blocked(agg: Aggregation, *, size: int, mesh, axes):
     )
 
 
+def streaming_groupby_scan(
+    array,
+    by,
+    *,
+    func: str,
+    batch_len: int | None = None,
+    batch_bytes: int = 256 * 2**20,
+    expected_groups=None,
+    dtype=None,
+    out: Callable[[int, int, Any], None] | None = None,
+):
+    """Out-of-core grouped scan: slabs stream through a per-group carry.
+
+    The reference runs scans over chunked arrays via dask's cumreduction
+    (dask.py:576-663); this is the sequential form of the same Blelloch
+    decomposition — each slab runs the within-slab segmented scan, the
+    per-group block summary becomes the next slab's carry, and the carry
+    is applied through the codes. ``bfill`` streams the slabs in REVERSE
+    (the ``(start, stop)`` loader contract is random-access).
+
+    ``array``: host array ``(..., n)`` or loader ``callable(start, stop)``;
+    ``by``: 1-D labels along the streamed (scan) axis. ``out``: optional
+    writer ``callable(start, stop, result_slab)`` — with a writer the
+    result streams straight back out (nothing array-sized materializes;
+    returns None); without one the full result array is allocated.
+    Semantics match :func:`flox_tpu.groupby_scan` exactly, including
+    datetime64/timedelta64 NaT rules and int promotion.
+    """
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from . import dtypes as dtps
+    from .aggregations import _initialize_scan
+    from .core import _convert_expected_groups_to_index, _normalize_expected, _normalize_isbin
+    from .kernels import _nan_mask, generic_kernel
+    from .profiling import timed
+
+    labels = utils.asarray_host(by)
+    if labels.ndim != 1:
+        raise NotImplementedError(
+            "streaming_groupby_scan scans the streamed axis: pass 1-D labels "
+            "(use groupby_scan for in-memory nD layouts)"
+        )
+    n = labels.shape[0]
+
+    if callable(array):
+        loader = array
+        lead_shape = None
+    else:
+        arr = np.asarray(array) if not utils.is_jax_array(array) else array
+        if arr.shape[-1] != n:
+            raise ValueError(
+                f"array trailing dim {arr.shape[-1]} != by length {n}"
+            )
+        lead_shape = arr.shape[:-1]
+        loader = lambda s, e: arr[..., s:e]
+
+    expected = _normalize_expected(expected_groups, 1)
+    expected_idx = _convert_expected_groups_to_index(expected, _normalize_isbin(False, 1), True)
+    codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
+        [labels], axes=(0,), expected_groups=expected_idx, sort=True
+    )
+    codes = np.asarray(codes).reshape(-1)
+    if size == 0:
+        raise ValueError("No groups to scan over (empty expected_groups?)")
+
+    scan = _initialize_scan(func)
+
+    probe = np.asarray(loader(0, 1))
+    if lead_shape is None:
+        lead_shape = probe.shape[:-1]
+    arr_dtype = probe.dtype
+    datetime_dtype = arr_dtype if dtps.is_datetime_like(arr_dtype) else None
+    nat = datetime_dtype is not None
+    base_loader = loader
+    if nat:
+        # same rules as groupby_scan (scan.py:118-151)
+        if scan.name in ("cumsum", "nancumsum") and arr_dtype.kind == "M":
+            raise TypeError(
+                "cumsum of datetime64 values is undefined (numpy cannot add "
+                "points in time); cumsum timedelta64 works."
+            )
+        if dtype is not None:
+            raise TypeError(
+                "dtype= is not supported for datetime/timedelta scans; the "
+                "scan runs on the exact int64 view and returns "
+                f"{arr_dtype} unchanged."
+            )
+        if not utils.x64_enabled():
+            raise ValueError(
+                "datetime/timedelta streaming scans need jax_enable_x64 "
+                "(int64 NaT sentinels do not survive int32 truncation)."
+            )
+        loader = lambda s, e: np.asarray(base_loader(s, e)).view("int64")
+    # int promotion for accumulating scans (parity: scan.py:153-156)
+    if scan.name in ("cumsum", "nancumsum") and dtype is None and not nat:
+        if arr_dtype.kind in "iub":
+            dtype = np.result_type(arr_dtype, np.int_)
+
+    itemsize = probe.dtype.itemsize
+    row_bytes = int(np.prod(lead_shape, dtype=np.int64)) * itemsize if lead_shape else itemsize
+    if batch_len is None:
+        batch_len = max(1, min(n, batch_bytes // max(row_bytes, 1)))
+    nbatches = math.ceil(n / batch_len)
+
+    has_missing = bool((codes < 0).any())
+    reverse = scan.name == "bfill"
+    kw = {"nat": True} if nat else {}
+
+    def apply_carry_codes(table, ccodes):
+        safe = jnp.where(ccodes < 0, size, ccodes)
+        pad = jnp.zeros(table.shape[:-1] + (1,), table.dtype)
+        return jnp.take(jnp.concatenate([table, pad], axis=-1), safe, axis=-1)
+
+    if scan.mode == "apply_binary_op":
+
+        def slab_scan(slab, ccodes, carry, had):
+            local = generic_kernel(scan.scan, ccodes, slab, size=size, dtype=dtype, **kw)
+            if nat:
+                from .kernels import _NAT_INT
+
+                is_nat = slab == jnp.asarray(_NAT_INT, slab.dtype)
+                summed = jnp.where(is_nat, jnp.zeros((), slab.dtype), slab)
+            else:
+                summed = slab
+            block = generic_kernel(
+                scan.reduction, ccodes, summed, size=size, fill_value=0
+            ).astype(local.dtype)
+            if carry is None:
+                out_slab = local
+                new_carry = block
+            else:
+                out_slab = local + apply_carry_codes(carry, ccodes)
+                new_carry = carry + block
+            new_had = had
+            if nat and scan.scan == "cumsum":
+                # non-skipna datetime poisoning: a NaT earlier in the group
+                # poisons everything after — sticky per-group channel
+                from .kernels import _NAT_INT
+
+                had_slab = generic_kernel(
+                    "sum", ccodes, is_nat.astype(jnp.int32), size=size, fill_value=0
+                ) > 0
+                nat_val = jnp.asarray(_NAT_INT, out_slab.dtype)
+                if had is not None:
+                    poison_e = apply_carry_codes(had.astype(jnp.int8), ccodes) > 0
+                    out_slab = jnp.where(poison_e, nat_val, out_slab)
+                    new_had = had | had_slab
+                else:
+                    new_had = had_slab
+                out_slab = jnp.where(local == nat_val, nat_val, out_slab)
+            return out_slab, new_carry, new_had
+
+    else:  # ffill / bfill
+
+        def slab_scan(slab, ccodes, carry, has):
+            local = generic_kernel(scan.scan, ccodes, slab, size=size, **kw)
+            is_float = jnp.issubdtype(slab.dtype, jnp.floating)
+            valid_cnt = generic_kernel("nanlen", ccodes, slab, size=size, **kw)
+            edge_val = generic_kernel(
+                scan.reduction, ccodes, slab, size=size,
+                fill_value=jnp.nan if is_float else 0, **kw,
+            )
+            mask = _nan_mask(local, nat)
+            still = ~mask if mask is not None else jnp.zeros(local.shape, bool)
+            out_slab = local
+            if carry is not None:
+                carry_e = apply_carry_codes(carry, ccodes)
+                has_e = apply_carry_codes(has.astype(jnp.int8), ccodes) > 0
+                out_slab = jnp.where(still & has_e & (ccodes >= 0), carry_e, local)
+                new_carry = jnp.where(valid_cnt > 0, edge_val.astype(carry.dtype), carry)
+                new_has = has | (valid_cnt > 0)
+            else:
+                new_carry = edge_val
+                new_has = valid_cnt > 0
+            return out_slab, new_carry, new_has
+
+    init_fn = jax.jit(lambda slab, ccodes: slab_scan(slab, ccodes, None, None))
+    step_fn = jax.jit(slab_scan)
+
+    result_arr = None
+    order = range(nbatches) if not reverse else range(nbatches - 1, -1, -1)
+    carry = had = None
+    with timed(f"stream-scan [{scan.name}] {nbatches} slab(s)"):
+        for i in order:
+            s, e = i * batch_len, min((i + 1) * batch_len, n)
+            slab = jnp.asarray(np.asarray(loader(s, e)))
+            ccodes = jnp.asarray(np.ascontiguousarray(codes[s:e]))
+            if carry is None:
+                out_slab, carry, had = init_fn(slab, ccodes)
+            else:
+                out_slab, carry, had = step_fn(slab, ccodes, carry, had)
+            if has_missing:
+                from .scan import _mask_positions
+
+                out_slab = _mask_positions(out_slab, np.asarray(ccodes) < 0, nat=nat)
+            res = np.asarray(out_slab)
+            if nat:
+                res = res.astype("int64").view(datetime_dtype)
+            if out is not None:
+                out(s, e, res)
+            else:
+                if result_arr is None:
+                    result_arr = np.empty(tuple(lead_shape) + (n,), res.dtype)
+                result_arr[..., s:e] = res
+    if out is not None:
+        return None
+    return result_arr
+
+
 def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
                      batch_len: int, lead_shape: tuple, probe_dtype):
     """Out-of-core EXACT quantile/median: the radix-select bisection
@@ -752,9 +964,15 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
             yield jnp.asarray(slab), jnp.asarray(ccodes)
 
     # resolved float dtype: same rule as the eager kernel (probe_dtype comes
-    # from the caller's one probe — no second remote chunk read)
+    # from the caller's one probe — no second remote chunk read). MUST be
+    # the CANONICALIZED dtype: with x64 off jax downcasts f64 slabs to f32,
+    # and keying nbits off the host dtype would run 65 passes on uint32
+    # keys — out-of-range shifts (implementation-defined on TPU) and double
+    # the loader IO
+    from jax.dtypes import canonicalize_dtype
+
     if np.issubdtype(probe_dtype, np.floating):
-        fdtype = jnp.dtype(probe_dtype)
+        fdtype = canonicalize_dtype(probe_dtype)
     else:
         fdtype = jnp.float64 if utils.x64_enabled() else jnp.float32
     ut = _uint_type(fdtype)
@@ -818,19 +1036,11 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
         fv_arr = jnp.asarray(jnp.nan, fdtype)
     threshold = max(agg.min_count, 1)
 
+    from .kernels import _quantile_interp_value
+
     outs = []
     for k, _qi in enumerate(qs):
-        pos, lo_in, ia, ib = meta[k]
-        v_lo, v_hi = selected[ia], selected[ib]
-        frac = (pos - lo_in).astype(fdtype)
-        if method == "lower" or method == "nearest":
-            val = v_lo
-        elif method == "higher":
-            val = v_hi
-        elif method == "midpoint":
-            val = (v_lo + v_hi) / 2
-        else:
-            val = v_lo + frac * (v_hi - v_lo)
+        val = _quantile_interp_value(method, meta[k], selected, fdtype)
         val = jnp.where(nn < threshold, fv_arr, val)
         if group_has_nan is not None:
             val = jnp.where(group_has_nan, jnp.asarray(jnp.nan, fdtype), val)

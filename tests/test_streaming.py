@@ -503,3 +503,118 @@ class TestStreamingOrderStats:
             streaming_groupby_reduce(
                 vals, labels, func="nanmedian", batch_len=700, mesh=make_mesh()
             )
+
+
+class TestStreamingScan:
+    """Out-of-core grouped scans (the sequential form of the Blelloch
+    decomposition the reference runs through dask's cumreduction,
+    dask.py:576-663): per-slab segmented scan + per-group carry."""
+
+    @pytest.fixture(scope="class")
+    def sdata(self):
+        rng = np.random.default_rng(31)
+        n = 4000
+        vals = rng.normal(size=(2, n))
+        vals[:, ::9] = np.nan
+        labels = rng.integers(0, 6, n)
+        return vals, labels
+
+    @pytest.mark.parametrize("func", ["cumsum", "nancumsum", "ffill", "bfill"])
+    @pytest.mark.parametrize("batch_len", [700, 4000])
+    def test_matches_eager(self, sdata, func, batch_len):
+        from flox_tpu import groupby_scan, streaming_groupby_scan
+
+        vals, labels = sdata
+        expected = groupby_scan(vals, labels, func=func)
+        got = streaming_groupby_scan(vals, labels, func=func, batch_len=batch_len)
+        # carry summation order differs from the eager log-tree scan:
+        # last-ulp accumulation noise, same tolerance as the reduce suite
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=1e-10, atol=1e-12,
+            equal_nan=True,
+        )
+
+    def test_int_promotion_matches(self, sdata):
+        from flox_tpu import groupby_scan, streaming_groupby_scan
+
+        _, labels = sdata
+        iv = np.arange(labels.shape[0], dtype=np.int32) % 97
+        expected = np.asarray(groupby_scan(iv, labels, func="cumsum"))
+        got = streaming_groupby_scan(iv, labels, func="cumsum", batch_len=700)
+        assert got.dtype == expected.dtype
+        np.testing.assert_array_equal(got, expected)
+
+    def test_timedelta_cumsum_nat_poisons_across_slabs(self, sdata):
+        from flox_tpu import groupby_scan, streaming_groupby_scan
+
+        _, labels = sdata
+        rng = np.random.default_rng(3)
+        td = rng.integers(1, 100, labels.shape[0]).astype("timedelta64[ns]")
+        td[5] = np.timedelta64("NaT")  # poisons its group in every later slab
+        expected = np.asarray(groupby_scan(td, labels, func="cumsum"))
+        got = streaming_groupby_scan(td, labels, func="cumsum", batch_len=600)
+        assert got.dtype == expected.dtype
+        np.testing.assert_array_equal(got.view("int64"), expected.view("int64"))
+
+    def test_datetime_ffill_bfill(self, sdata):
+        from flox_tpu import groupby_scan, streaming_groupby_scan
+
+        _, labels = sdata
+        rng = np.random.default_rng(4)
+        dt = np.datetime64("2020-01-01", "ns") + rng.integers(
+            0, 10**9, labels.shape[0]
+        ).astype("timedelta64[ns]")
+        dt[::13] = np.datetime64("NaT")
+        for func in ("ffill", "bfill"):
+            expected = np.asarray(groupby_scan(dt, labels, func=func))
+            got = streaming_groupby_scan(dt, labels, func=func, batch_len=600)
+            np.testing.assert_array_equal(got.view("int64"), expected.view("int64"))
+
+    def test_loader_and_writer_stream_both_ways(self, sdata):
+        # the fully out-of-core path: loader in, writer out, nothing
+        # array-sized materializes inside
+        from flox_tpu import groupby_scan, streaming_groupby_scan
+
+        vals, labels = sdata
+        n = labels.shape[0]
+        written = np.full((2, n), np.nan)
+        spans = []
+
+        def writer(s, e, res):
+            spans.append((s, e))
+            written[..., s:e] = res
+
+        r = streaming_groupby_scan(
+            lambda s, e: vals[:, s:e], labels, func="nancumsum",
+            batch_len=512, out=writer,
+        )
+        assert r is None
+        assert spans == [(i * 512, min((i + 1) * 512, n)) for i in range(len(spans))]
+        expected = groupby_scan(vals, labels, func="nancumsum")
+        # carry summation order differs from the eager log-tree scan:
+        # last-ulp accumulation noise, same tolerance as the reduce suite
+        np.testing.assert_allclose(
+            written, np.asarray(expected), rtol=1e-10, atol=1e-12, equal_nan=True
+        )
+
+    def test_missing_labels_scan_to_nan(self, sdata):
+        from flox_tpu import groupby_scan, streaming_groupby_scan
+
+        vals, labels = sdata
+        lab = labels.copy()
+        lab[::50] = 99  # outside expected_groups -> code -1
+        expected = np.asarray(
+            groupby_scan(vals, lab, func="cumsum", expected_groups=np.arange(6))
+        )
+        got = streaming_groupby_scan(
+            vals, lab, func="cumsum", expected_groups=np.arange(6), batch_len=700
+        )
+        np.testing.assert_allclose(got, expected, rtol=1e-10, atol=1e-12, equal_nan=True)
+        assert np.isnan(got[..., ::50]).all()
+
+    def test_nd_labels_rejected(self, sdata):
+        from flox_tpu import streaming_groupby_scan
+
+        vals, _ = sdata
+        with pytest.raises(NotImplementedError, match="1-D"):
+            streaming_groupby_scan(vals, np.zeros((2, 3), np.int64), func="cumsum")
