@@ -1,5 +1,5 @@
-"""Out-of-core grouped reductions: stream host slabs through device
-accumulators (L5).
+"""Out-of-core grouped reductions and scans: stream host slabs through
+device accumulators (L5).
 
 The reference handles bigger-than-memory arrays by delegating to a chunked
 runtime (dask: /root/reference/flox/dask.py:325-573; cubed:
