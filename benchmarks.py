@@ -210,7 +210,9 @@ def bench_streaming(scale: str):
     blen = nt // 4
 
     def run_q():
-        streaming_groupby_reduce(sub, month, func="nanmedian", batch_len=blen)
+        # block: the 33 bit-pass dispatches are async — unsynced timing
+        # would stop the clock at dispatch, not completion
+        _block(streaming_groupby_reduce(sub, month, func="nanmedian", batch_len=blen)[0])
 
     run_q()  # warm (compile)
     t0 = time.perf_counter()

@@ -24,10 +24,10 @@ def clear_all() -> None:
     from .core import _jitted_bundle
     from .parallel.mapreduce import _PROGRAM_CACHE
     from .parallel.scan import _SCAN_CACHE
-    from .streaming import _MESH_PROGRAM_CACHE
+    from .streaming import _STEP_CACHE
 
     _COHORTS_CACHE.clear()
     _PROGRAM_CACHE.clear()
     _SCAN_CACHE.clear()
-    _MESH_PROGRAM_CACHE.clear()
+    _STEP_CACHE.clear()
     _jitted_bundle.cache_clear()

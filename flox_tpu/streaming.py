@@ -37,9 +37,26 @@ __all__ = ["streaming_groupby_reduce", "streaming_groupby_scan"]
 
 _BIG = np.iinfo(np.int32).max
 
-# compiled (step, final) shard_map program pairs for the mesh runtime,
-# keyed by (agg identity, size, shard layout, mesh, options fingerprint)
-_MESH_PROGRAM_CACHE: dict = {}
+# compiled step/pass/program functions for every streaming runtime path
+# (single-device steps, quantile passes, scan steps, mesh shard_map
+# pairs) — a fresh jax.jit object per call would recompile on every
+# streaming_groupby_* invocation, so repeat same-shaped calls
+# (per-variable pipelines) would pay full retrace. Keys carry the
+# semantic identity plus trace_fingerprint() (appended by _step_cached).
+_STEP_CACHE: dict = {}
+
+
+def _step_cached(key, build):
+    from .options import trace_fingerprint
+
+    key = key + (trace_fingerprint(),)
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        fn = build()
+        if len(_STEP_CACHE) > 256:
+            _STEP_CACHE.clear()
+        _STEP_CACHE[key] = fn
+    return fn
 
 
 def streaming_groupby_reduce(
@@ -314,35 +331,38 @@ def streaming_groupby_reduce(
         # runtime): repeat same-shaped calls — per-variable streaming over
         # a dataset, pipelines — reuse the three compiled shard_map
         # programs instead of retracing
-        from .options import trace_fingerprint
         from .parallel.mapreduce import _agg_cache_key
 
-        cache_key = (
-            _agg_cache_key(agg), size, shard_len, axes, mesh, nat, blocked,
-            len(lead_shape), trace_fingerprint(),
-        )
-        pair = _MESH_PROGRAM_CACHE.get(cache_key)
-        if pair is None:
+        def _build_mesh_pair():
             if blocked:
                 size_pad = size + (-size) % ndev
-                step = _build_mesh_step_blocked(
-                    agg, size_pad=size_pad, ndev=ndev, count_skipna=count_skipna,
-                    nat=nat, mesh=mesh, axes=axes, lead_ndim=len(lead_shape),
+                return (
+                    _build_mesh_step_blocked(
+                        agg, size_pad=size_pad, ndev=ndev, count_skipna=count_skipna,
+                        nat=nat, mesh=mesh, axes=axes, lead_ndim=len(lead_shape),
+                    ),
+                    _build_mesh_final_blocked(agg, size=size, mesh=mesh, axes=axes),
                 )
-                final = _build_mesh_final_blocked(agg, size=size, mesh=mesh, axes=axes)
-            else:
-                step = _build_mesh_step(
+            return (
+                _build_mesh_step(
                     agg, size=size, shard_len=shard_len, count_skipna=count_skipna,
                     nat=nat, mesh=mesh, axes=axes, lead_ndim=len(lead_shape),
-                )
-                final = _build_mesh_final(agg, mesh=mesh, axes=axes, nat=nat)
-            if len(_MESH_PROGRAM_CACHE) > 128:
-                _MESH_PROGRAM_CACHE.clear()
-            _MESH_PROGRAM_CACHE[cache_key] = (step, final)
-        else:
-            step, final = pair
+                ),
+                _build_mesh_final(agg, mesh=mesh, axes=axes, nat=nat),
+            )
+
+        step, final = _step_cached(
+            ("mesh", _agg_cache_key(agg), size, shard_len, axes, mesh, nat,
+             blocked, len(lead_shape)),
+            _build_mesh_pair,
+        )
     else:
-        step = _build_step(agg, size=size, count_skipna=count_skipna, nat=nat)
+        from .parallel.mapreduce import _agg_cache_key
+
+        step = _step_cached(
+            ("reduce-step", _agg_cache_key(agg), size, count_skipna, nat),
+            lambda: _build_step(agg, size=size, count_skipna=count_skipna, nat=nat),
+        )
     nbatches = math.ceil(n / batch_len)
 
     from .profiling import timed
@@ -865,8 +885,13 @@ def streaming_groupby_scan(
                 new_has = valid_cnt > 0
             return out_slab, new_carry, new_has
 
-    init_fn = jax.jit(lambda slab, ccodes: slab_scan(slab, ccodes, None, None))
-    step_fn = jax.jit(slab_scan)
+    init_fn, step_fn = _step_cached(
+        ("scan-step", scan.name, size, nat, str(dtype), has_missing),
+        lambda: (
+            jax.jit(lambda slab, ccodes: slab_scan(slab, ccodes, None, None)),
+            jax.jit(slab_scan),
+        ),
+    )
 
     result_arr = None
     order = range(nbatches) if not reverse else range(nbatches - 1, -1, -1)
@@ -985,25 +1010,28 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
             data = data.astype(fdtype)
         return data
 
-    @jax.jit
-    def count_pass(nn, hasnan, slab, ccodes):
-        data = prep(slab)
-        sc = _safe_codes(ccodes, size)
-        mask = _nan_mask(data)
-        nn = nn + _counts(sc, size, mask=mask)
-        if not skipna and mask is not None:
-            hasnan = jnp.maximum(hasnan, _seg("max", (~mask).astype(jnp.int8), sc, size))
-        return nn, hasnan
+    def _build_passes():
+        def count_pass(nn, hasnan, slab, ccodes):
+            data = prep(slab)
+            sc = _safe_codes(ccodes, size)
+            mask = _nan_mask(data)
+            nn = nn + _counts(sc, size, mask=mask)
+            if not skipna and mask is not None:
+                hasnan = jnp.maximum(hasnan, _seg("max", (~mask).astype(jnp.int8), sc, size))
+            return nn, hasnan
 
-    @jax.jit
-    def bit_pass(cnt, prefix, slab, ccodes, bshift):
-        data = prep(slab)
-        keys = _valid_keys(data, _nan_mask(data))
-        return cnt + _radix_pass_count(
-            keys, _safe_codes(ccodes, size), size, prefix, bshift, cdtype
-        )
+        def bit_pass(cnt, prefix, slab, ccodes, bshift):
+            data = prep(slab)
+            keys = _valid_keys(data, _nan_mask(data))
+            return cnt + _radix_pass_count(
+                keys, _safe_codes(ccodes, size), size, prefix, bshift, cdtype
+            )
 
-    update = jax.jit(_radix_update)
+        return jax.jit(count_pass), jax.jit(bit_pass), jax.jit(_radix_update)
+
+    count_pass, bit_pass, update = _step_cached(
+        ("quantile-pass", size, str(fdtype), str(cdtype), skipna), _build_passes
+    )
 
     trail = lead_shape  # leading layout puts the reduce axis first
     with timed(f"stream-quantile [{agg.name}] {nbits + 1} passes x {nbatches} slab(s)"):

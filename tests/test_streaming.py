@@ -344,20 +344,20 @@ class TestMeshStreaming:
         # repeat same-shaped calls must not retrace (code-review r5):
         # the compiled (step, final) pair is cached like the sharded
         # runtime's _PROGRAM_CACHE
-        from flox_tpu.streaming import _MESH_PROGRAM_CACHE
+        from flox_tpu.streaming import _STEP_CACHE
 
         vals, labels = mdata
-        _MESH_PROGRAM_CACHE.clear()
+        _STEP_CACHE.clear()
         streaming_groupby_reduce(vals, labels, func="nansum", batch_len=997, mesh=mesh)
-        assert len(_MESH_PROGRAM_CACHE) == 1
+        assert len(_STEP_CACHE) == 1
         vals2 = vals + 1.0
         streaming_groupby_reduce(vals2, labels, func="nansum", batch_len=997, mesh=mesh)
-        assert len(_MESH_PROGRAM_CACHE) == 1  # hit, not a rebuild
+        assert len(_STEP_CACHE) == 1  # hit, not a rebuild
         # clear_all drops it with every other program cache
         import flox_tpu.cache
 
         flox_tpu.cache.clear_all()
-        assert len(_MESH_PROGRAM_CACHE) == 0
+        assert len(_STEP_CACHE) == 0
 
     def test_min_count_on_mesh(self, mesh, mdata):
         vals, labels = mdata
