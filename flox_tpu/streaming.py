@@ -46,6 +46,27 @@ _BIG = np.iinfo(np.int32).max
 _STEP_CACHE: dict = {}
 
 
+def _mesh_stream_layout(mesh, axis_name, batch_len: int, lead_ndim: int):
+    """The ONE place the slab sharding layout is decided: device_put
+    shardings and shard_map in_specs must stay byte-identical, and
+    batch_len must divide into equal shards — both runtimes (reduce and
+    quantile) read this."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .parallel.mapreduce import _norm_axes
+
+    axes = _norm_axes(axis_name, mesh)
+    ndev = int(np.prod([mesh.shape[a] for a in axes]))
+    batch_len = -(-batch_len // ndev) * ndev  # shards must be equal
+    spec_entry = axes if len(axes) > 1 else axes[0]
+    sspec = P(*([None] * lead_ndim + [spec_entry]))
+    cspec = P(spec_entry)
+    return (
+        axes, ndev, batch_len, spec_entry, sspec, cspec,
+        NamedSharding(mesh, sspec), NamedSharding(mesh, cspec),
+    )
+
+
 def _step_cached(key, build):
     from .options import trace_fingerprint
 
@@ -217,21 +238,18 @@ def streaming_groupby_reduce(
         # should not pay a second remote chunk read
     stream_orderstat = False
     if agg.blockwise_only:
-        if agg.name in ("median", "nanmedian", "quantile", "nanquantile") and mesh is None:
+        if agg.name in ("median", "nanmedian", "quantile", "nanquantile"):
             # quantile/median DO stream: the radix-select bisection only
             # ever needs per-group COUNTS, which accumulate slab by slab —
-            # (nbits + 1) full passes over the data (see _stream_quantile)
+            # (nbits + 1) full passes over the data (see _stream_quantile).
+            # With mesh= each slab is sharded and every counting pass
+            # psums — out-of-core AND distributed at once.
             stream_orderstat = True
         else:
-            hint = (
-                "compose with groupby_reduce(mesh=, method='map-reduce') — "
-                "distributed order statistics run in-memory there"
-                if agg.name not in ("mode", "nanmode")
-                else "use groupby_reduce(method='blockwise', mesh=...) after "
-                "rechunk.reshard_for_blockwise"
-            )
             raise NotImplementedError(
-                f"{agg.name!r} cannot stream on this path; {hint}."
+                f"{agg.name!r} cannot stream on this path; use "
+                "groupby_reduce(method='blockwise', mesh=...) after "
+                "rechunk.reshard_for_blockwise."
             )
     if (
         n >= _BIG
@@ -254,7 +272,7 @@ def streaming_groupby_reduce(
     if stream_orderstat:
         result = _stream_quantile(
             agg, loader, codes, size=size, n=n, batch_len=batch_len,
-            lead_shape=tuple(lead_shape),
+            lead_shape=tuple(lead_shape), mesh=mesh, axis_name=axis_name,
             # the datetime wrap changes the effective dtype to float64
             probe_dtype=np.float64 if datetime_dtype is not None else probe.dtype,
         )
@@ -278,19 +296,13 @@ def streaming_groupby_reduce(
 
     slab_shard = codes_shard = None
     if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         from .options import OPTIONS
-        from .parallel.mapreduce import (
-            _is_additive,
-            _norm_axes,
-            dense_intermediate_bytes,
-        )
+        from .parallel.mapreduce import _is_additive, dense_intermediate_bytes
         from .utils import fmt_bytes
 
-        axes = _norm_axes(axis_name, mesh)
-        ndev = int(np.prod([mesh.shape[a] for a in axes]))
-        batch_len = -(-batch_len // ndev) * ndev  # shards must be equal
+        axes, ndev, batch_len, _spec_entry, _sspec, _cspec, slab_shard, codes_shard = (
+            _mesh_stream_layout(mesh, axis_name, batch_len, len(lead_shape))
+        )
         shard_len = batch_len // ndev
 
         # ceiling routing — the same decision sharded_groupby_reduce makes:
@@ -322,10 +334,6 @@ def streaming_groupby_reduce(
                     "set_options(dense_intermediate_bytes_max=...) if the "
                     "devices really have the headroom."
                 )
-
-        spec_entry = axes if len(axes) > 1 else axes[0]
-        slab_shard = NamedSharding(mesh, P(*([None] * len(lead_shape) + [spec_entry])))
-        codes_shard = NamedSharding(mesh, P(spec_entry))
 
         # program cache (the _PROGRAM_CACHE pattern from the sharded
         # runtime): repeat same-shaped calls — per-variable streaming over
@@ -924,7 +932,8 @@ def streaming_groupby_scan(
 
 
 def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
-                     batch_len: int, lead_shape: tuple, probe_dtype):
+                     batch_len: int, lead_shape: tuple, probe_dtype,
+                     mesh=None, axis_name="data"):
     """Out-of-core EXACT quantile/median: the radix-select bisection
     (kernels._radix_select) only ever consumes per-group COUNTS, and counts
     accumulate slab by slab — so order statistics stream in ``nbits + 1``
@@ -973,6 +982,16 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
     scalar_q = np.ndim(q) == 0
     alpha, beta = _quantile_alpha_beta(method)
 
+    axes = None
+    slab_shard = codes_shard = None
+    if mesh is not None:
+        # out-of-core AND distributed: slabs scatter over the mesh and each
+        # counting pass psums — the per-group bisection state is replicated,
+        # so the two compositions stack with no new machinery. The layout
+        # comes from the SAME helper the reduce runtime uses.
+        axes, _ndev, batch_len, _spec_entry, sspec, cspec, slab_shard, codes_shard = (
+            _mesh_stream_layout(mesh, axis_name, batch_len, len(lead_shape))
+        )
     nbatches = math.ceil(n / batch_len)
 
     def slabs():
@@ -986,7 +1005,13 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
                     [slab, np.zeros(lead_shape + (pad,), slab.dtype)], axis=-1
                 )
                 ccodes = np.concatenate([ccodes, np.full(pad, -1, dtype=ccodes.dtype)])
-            yield jnp.asarray(slab), jnp.asarray(ccodes)
+            if mesh is not None:
+                yield (
+                    jax.device_put(slab, slab_shard),
+                    jax.device_put(np.ascontiguousarray(ccodes), codes_shard),
+                )
+            else:
+                yield jnp.asarray(slab), jnp.asarray(ccodes)
 
     # resolved float dtype: same rule as the eager kernel (probe_dtype comes
     # from the caller's one probe — no second remote chunk read). MUST be
@@ -1015,22 +1040,54 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
             data = prep(slab)
             sc = _safe_codes(ccodes, size)
             mask = _nan_mask(data)
-            nn = nn + _counts(sc, size, mask=mask)
-            if not skipna and mask is not None:
-                hasnan = jnp.maximum(hasnan, _seg("max", (~mask).astype(jnp.int8), sc, size))
+            nn_add = _counts(sc, size, mask=mask)
+            hn = _seg("max", (~mask).astype(jnp.int8), sc, size) if (
+                not skipna and mask is not None
+            ) else None
+            if axes is not None:
+                nn_add = jax.lax.psum(nn_add, axes)
+                if hn is not None:
+                    hn = jax.lax.pmax(hn, axes)
+            nn = nn + nn_add
+            if hn is not None:
+                hasnan = jnp.maximum(hasnan, hn)
             return nn, hasnan
 
         def bit_pass(cnt, prefix, slab, ccodes, bshift):
             data = prep(slab)
             keys = _valid_keys(data, _nan_mask(data))
-            return cnt + _radix_pass_count(
+            add = _radix_pass_count(
                 keys, _safe_codes(ccodes, size), size, prefix, bshift, cdtype
             )
+            if axes is not None:
+                add = jax.lax.psum(add, axes)
+            return cnt + add
 
-        return jax.jit(count_pass), jax.jit(bit_pass), jax.jit(_radix_update)
+        if axes is None:
+            return jax.jit(count_pass), jax.jit(bit_pass), jax.jit(_radix_update)
+
+        # mesh: slab/codes sharded in (the SAME sspec/cspec the device_put
+        # above uses); bisection state replicated in AND out
+        from jax.sharding import PartitionSpec as P
+
+        return (
+            jax.jit(jax.shard_map(
+                count_pass, mesh=mesh,
+                in_specs=(P(), P(), sspec, cspec), out_specs=P(),
+                check_vma=False,
+            )),
+            jax.jit(jax.shard_map(
+                bit_pass, mesh=mesh,
+                in_specs=(P(), P(), sspec, cspec, P()), out_specs=P(),
+                check_vma=False,
+            )),
+            jax.jit(_radix_update),
+        )
 
     count_pass, bit_pass, update = _step_cached(
-        ("quantile-pass", size, str(fdtype), str(cdtype), skipna), _build_passes
+        ("quantile-pass", size, str(fdtype), str(cdtype), skipna,
+         None if axes is None else (axes, mesh), len(lead_shape)),
+        _build_passes,
     )
 
     trail = lead_shape  # leading layout puts the reduce axis first

@@ -495,14 +495,83 @@ class TestStreamingOrderStats:
         with pytest.raises(NotImplementedError, match="cannot stream"):
             streaming_groupby_reduce(vals, labels, func="mode", batch_len=700)
 
-    def test_mesh_quantile_points_at_sharded_runtime(self, qdata):
+    def test_mesh_streaming_median_propagates_nan(self, qdata):
+        # the non-skipna hasnan channel must pmax across shards: ONE NaN
+        # total, placed so it lands on a single shard of a single slab —
+        # without the pmax only that shard would flag the group, and the
+        # check_vma=False replication claim would accept the wrong lanes
+        import jax
+
+        from flox_tpu.parallel.mesh import make_mesh
+
+        rng = np.random.default_rng(99)
+        n = 4096
+        labels = rng.integers(0, 9, n)
+        vals = rng.normal(size=(2, n))
+        batch_len = 1024
+        ndev = len(jax.devices())
+        shard_len = batch_len // ndev
+        # inside slab 1, shard 2: position = slab_start + shard*shard_len + 3
+        vals[:, batch_len + 2 * shard_len + 3] = np.nan
+        expected, _ = groupby_reduce(vals, labels, func="median")
+        assert np.isnan(np.asarray(expected)).any()  # the case is exercised
+        got, _ = streaming_groupby_reduce(
+            vals, labels, func="median", batch_len=batch_len, mesh=make_mesh()
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=5e-16, atol=0, equal_nan=True
+        )
+
+    def test_mesh_streaming_quantile_two_axis_mesh(self, qdata):
+        # ("dcn","ici")-style 2-axis mesh: the tuple spec_entry branch
+        import jax
+
         from flox_tpu.parallel.mesh import make_mesh
 
         vals, labels = qdata
-        with pytest.raises(NotImplementedError, match="map-reduce"):
-            streaming_groupby_reduce(
-                vals, labels, func="nanmedian", batch_len=700, mesh=make_mesh()
+        ndev = len(jax.devices())
+        if ndev < 4:
+            pytest.skip("needs >= 4 devices for a 2-D mesh")
+        mesh = make_mesh(shape=(2, ndev // 2), axis_names=("dcn", "ici"))
+        expected, _ = streaming_groupby_reduce(
+            vals, labels, func="nanmedian", batch_len=700
+        )
+        got, _ = streaming_groupby_reduce(
+            vals, labels, func="nanmedian", batch_len=700,
+            mesh=mesh, axis_name=("dcn", "ici"),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=5e-16, atol=0, equal_nan=True
+        )
+
+    def test_mesh_streaming_quantile_composes(self, qdata):
+        # out-of-core AND distributed at once: slabs scatter over the mesh,
+        # every counting pass psums; bit-identical to eager select
+        import flox_tpu
+        from flox_tpu.parallel.mesh import make_mesh
+
+        vals, labels = qdata
+        with flox_tpu.set_options(quantile_impl="select"):
+            expected, _ = groupby_reduce(vals, labels, func="nanmedian")
+        got, _ = streaming_groupby_reduce(
+            vals, labels, func="nanmedian", batch_len=700, mesh=make_mesh()
+        )
+        # selection is count-exact; the lerp may differ by an ULP (XLA FMA
+        # contraction differs between the shard_map and eager programs)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expected), rtol=5e-16, atol=0, equal_nan=True
+        )
+        gotq, _ = streaming_groupby_reduce(
+            vals, labels, func="nanquantile", batch_len=700, mesh=make_mesh(),
+            finalize_kwargs={"q": [0.1, 0.9]},
+        )
+        with flox_tpu.set_options(quantile_impl="select"):
+            expq, _ = groupby_reduce(
+                vals, labels, func="nanquantile", finalize_kwargs={"q": [0.1, 0.9]}
             )
+        np.testing.assert_allclose(
+            np.asarray(gotq), np.asarray(expq), rtol=5e-16, atol=0, equal_nan=True
+        )
 
 
 class TestStreamingScan:
