@@ -97,6 +97,33 @@ def sharded_groupby_scan(
     return out
 
 
+def build_stream_scan_step(scan: Scan, *, size: int, mesh, axis_name="data",
+                           nat: bool = False, lead_ndim: int = 0):
+    """One jitted shard_map step for the streaming × mesh scan composition:
+    ``(slab_sharded, codes_sharded, carry_a, carry_b) ->
+    (out_sharded, new_carry_a, new_carry_b)`` — the within-slab distributed
+    Blelloch (identical to the in-memory program) plus the cross-slab
+    carry fold. Carry state: (per-group sums, had-NaT int8) for
+    cumsum-mode; (per-group edge value, has int8) for ffill/bfill."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    axes = _norm_axes(axis_name, mesh)
+    program = _build_scan_program(
+        scan, size=size, axis_name=axes, nat=nat, stream_carry=True
+    )
+    spec_entry = axes if len(axes) > 1 else axes[0]
+    arr_spec = P(*([None] * lead_ndim + [spec_entry]))
+    return jax.jit(
+        jax.shard_map(
+            program, mesh=mesh,
+            in_specs=(arr_spec, P(spec_entry), P(), P()),
+            out_specs=(arr_spec, P(), P()),
+            check_vma=False,
+        )
+    )
+
+
 def _validate_shard_local(codes: np.ndarray, ndev: int) -> None:
     """Blockwise precondition: every group's positions within one shard."""
     n = codes.shape[0]
@@ -133,13 +160,18 @@ def _build_blockwise_scan_program(scan: Scan, *, size, nat=False):
     return program
 
 
-def _build_scan_program(scan: Scan, *, size, axis_name, nat=False):
+def _build_scan_program(scan: Scan, *, size, axis_name, nat=False, stream_carry=False):
+    """``stream_carry=True`` builds the STREAMING variant: the program takes
+    a replicated cross-slab carry state and returns ``(out, new_state)`` —
+    the same within-slab Blelloch plus the slab-boundary fold, so
+    out-of-core scans distribute over the mesh with the identical carry
+    semantics (streaming.streaming_groupby_scan mesh path)."""
     import jax
     import jax.numpy as jnp
 
     from ..kernels import generic_kernel
 
-    def program(arr_sh, codes_sh):
+    def program(arr_sh, codes_sh, *carry_state):
         # 1. within-shard segmented scan
         local = generic_kernel(scan.scan, codes_sh, arr_sh, size=size, nat=nat)
 
@@ -194,12 +226,25 @@ def _build_scan_program(scan: Scan, *, size, axis_name, nat=False):
             )
             per_elem = jnp.take(carry_pad, safe, axis=-1)
             out = local + per_elem
+            if stream_carry:
+                # cross-slab carry: previous slabs' per-group totals add to
+                # every element; the new state folds THIS slab's global
+                # block totals in (psum = all shards of the slab)
+                prev_sums = carry_state[0]
+                prev_pad = jnp.concatenate(
+                    [prev_sums, jnp.zeros(prev_sums.shape[:-1] + (1,), prev_sums.dtype)],
+                    axis=-1,
+                )
+                out = out + jnp.take(prev_pad, safe, axis=-1).astype(out.dtype)
+                new_sums = prev_sums + jax.lax.psum(block, axis_name).astype(prev_sums.dtype)
             if poison_channel:
                 # non-skipna: a NaT anywhere earlier in the group poisons
                 # every later element. In-shard poisoning is already in
                 # ``local`` (== NaT sentinel); cross-shard comes from the
                 # had-NaT channel folded over shards strictly before me.
                 poison = jnp.any(mask & g_had, axis=0)  # (..., size)
+                if stream_carry:
+                    poison = poison | (carry_state[1] > 0)  # earlier slabs
                 poison_pad = jnp.concatenate(
                     [poison, jnp.zeros(poison.shape[:-1] + (1,), bool)], axis=-1
                 )
@@ -209,6 +254,13 @@ def _build_scan_program(scan: Scan, *, size, axis_name, nat=False):
             # skipna (nancumsum): NaT counts as zero on the eager path, so
             # the plain carry add is already exact — no sentinel survives
             # the within-shard scan
+            if stream_carry:
+                slab_had = (
+                    (jnp.any(g_had, axis=0).astype(jnp.int8) | carry_state[1])
+                    if poison_channel
+                    else carry_state[1]
+                )
+                return out, new_sums, slab_had
             return out
 
         # ffill/bfill: carry = last (first) valid value per group in shards
@@ -255,6 +307,30 @@ def _build_scan_program(scan: Scan, *, size, axis_name, nat=False):
 
         mask = _nan_mask(local, nat)  # None when nothing can be missing
         still = ~mask if mask is not None else jnp.zeros(local.shape, bool)
-        return jnp.where(still & has_e & (codes_sh >= 0), carry_e, local)
+        out = jnp.where(still & has_e & (codes_sh >= 0), carry_e, local)
+        if not stream_carry:
+            return out
+        # cross-slab: positions STILL missing after the within-slab fill
+        # take the previous slabs' carry; the new state picks this slab's
+        # edge value (last valid shard for ffill, first for bfill) over
+        # ALL shards, keeping the old value for groups absent here
+        prev_val, prev_has = carry_state
+        mask2 = _nan_mask(out, nat)
+        still2 = ~mask2 if mask2 is not None else jnp.zeros(out.shape, bool)
+        out = jnp.where(
+            still2 & (gather_groups(prev_has) > 0) & (codes_sh >= 0),
+            gather_groups(prev_val).astype(out.dtype),
+            out,
+        )
+        any_valid = jnp.any(g_valid, axis=0)  # (..., size), over ALL shards
+        if not reverse:
+            pick_all = jnp.max(jnp.where(g_valid, dev_idx, -1), axis=0)
+        else:
+            pick_all = jnp.min(jnp.where(g_valid, dev_idx, ndev), axis=0)
+        pick_all_c = jnp.clip(pick_all, 0, ndev - 1)
+        slab_edge = jnp.take_along_axis(g_vals, pick_all_c[None], axis=0)[0]
+        new_val = jnp.where(any_valid, slab_edge.astype(prev_val.dtype), prev_val)
+        new_has = prev_has | any_valid.astype(prev_has.dtype)
+        return out, new_val, new_has
 
     return program

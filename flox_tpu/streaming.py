@@ -724,6 +724,8 @@ def streaming_groupby_scan(
     expected_groups=None,
     dtype=None,
     out: Callable[[int, int, Any], None] | None = None,
+    mesh=None,
+    axis_name="data",
 ):
     """Out-of-core grouped scan: slabs stream through a per-group carry.
 
@@ -741,6 +743,12 @@ def streaming_groupby_scan(
     returns None); without one the full result array is allocated.
     Semantics match :func:`flox_tpu.groupby_scan` exactly, including
     datetime64/timedelta64 NaT rules and int promotion.
+
+    ``mesh=`` completes the composition matrix: each slab scatters over
+    the mesh and runs the SAME distributed Blelloch program as the
+    in-memory mesh scan (within-slab carry exchange over the collective),
+    with the cross-slab carry folded at the slab boundary — out-of-core
+    AND multi-chip scans, results still streamable through ``out=``.
     """
     import math
 
@@ -893,6 +901,16 @@ def streaming_groupby_scan(
                 new_has = valid_cnt > 0
             return out_slab, new_carry, new_has
 
+    if mesh is not None:
+        return _run_mesh_stream_scan(
+            scan, loader, codes, size=size, n=n, batch_len=batch_len,
+            lead_shape=tuple(lead_shape), dtype=dtype, nat=nat,
+            datetime_dtype=datetime_dtype, has_missing=has_missing,
+            reverse=reverse, out=out, mesh=mesh, axis_name=axis_name,
+            # the wrap views datetimes as int64; no second loader probe
+            probe_dtype=np.dtype("int64") if nat else probe.dtype,
+        )
+
     init_fn, step_fn = _step_cached(
         ("scan-step", scan.name, size, nat, str(dtype), has_missing),
         lambda: (
@@ -908,24 +926,103 @@ def streaming_groupby_scan(
         for i in order:
             s, e = i * batch_len, min((i + 1) * batch_len, n)
             slab = jnp.asarray(np.asarray(loader(s, e)))
-            ccodes = jnp.asarray(np.ascontiguousarray(codes[s:e]))
+            ccodes_np = np.ascontiguousarray(codes[s:e])
+            ccodes = jnp.asarray(ccodes_np)
             if carry is None:
                 out_slab, carry, had = init_fn(slab, ccodes)
             else:
                 out_slab, carry, had = step_fn(slab, ccodes, carry, had)
-            if has_missing:
-                from .scan import _mask_positions
+            result_arr = _emit_scan_slab(
+                out_slab, ccodes_np, s, e, nat=nat, datetime_dtype=datetime_dtype,
+                has_missing=has_missing, out=out, result_arr=result_arr,
+                lead_shape=lead_shape, n=n,
+            )
+    if out is not None:
+        return None
+    return result_arr
 
-                out_slab = _mask_positions(out_slab, np.asarray(ccodes) < 0, nat=nat)
-            res = np.asarray(out_slab)
-            if nat:
-                res = res.astype("int64").view(datetime_dtype)
-            if out is not None:
-                out(s, e, res)
-            else:
-                if result_arr is None:
-                    result_arr = np.empty(tuple(lead_shape) + (n,), res.dtype)
-                result_arr[..., s:e] = res
+
+def _emit_scan_slab(out_slab, ccodes_np, s, e, *, nat, datetime_dtype,
+                    has_missing, out, result_arr, lead_shape, n):
+    """Trim/mask/view one scanned slab and hand it to the writer or the
+    result array — the ONE emit step both scan loops (single-device and
+    mesh) share, so missing-label masking and the datetime view cannot
+    drift between them. Returns the (possibly just-allocated) result
+    array."""
+    res = np.asarray(out_slab)[..., : e - s]
+    if has_missing:
+        from .scan import _mask_positions
+
+        res = np.asarray(_mask_positions(res, ccodes_np[: e - s] < 0, nat=nat))
+    if nat:
+        res = res.astype("int64").view(datetime_dtype)
+    if out is not None:
+        out(s, e, res)
+        return result_arr
+    if result_arr is None:
+        result_arr = np.empty(tuple(lead_shape) + (n,), res.dtype)
+    result_arr[..., s:e] = res
+    return result_arr
+
+
+def _run_mesh_stream_scan(scan, loader, codes, *, size, n, batch_len, lead_shape,
+                          dtype, nat, datetime_dtype, has_missing, reverse,
+                          out, mesh, axis_name, probe_dtype):
+    """streaming × mesh scan: each slab runs the distributed Blelloch with
+    cross-slab carry I/O (parallel.scan.build_stream_scan_step)."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from .profiling import timed
+
+    axes, _ndev, batch_len, _spec_entry, _sspec, _cspec, slab_shard, codes_shard = (
+        _mesh_stream_layout(mesh, axis_name, batch_len, len(lead_shape))
+    )
+    nbatches = math.ceil(n / batch_len)
+
+    from .parallel.scan import build_stream_scan_step
+
+    step = _step_cached(
+        ("scan-mesh-step", scan.name, size, nat, str(dtype), axes, mesh,
+         len(lead_shape)),
+        lambda: build_stream_scan_step(
+            scan, size=size, mesh=mesh, axis_name=axes, nat=nat,
+            lead_ndim=len(lead_shape),
+        ),
+    )
+
+    # carry init needs the working dtype up front: the promoted/cast slab
+    # dtype for cumsum sums and ffill edge values
+    work_dtype = np.dtype(dtype) if dtype is not None else probe_dtype
+    c0 = jnp.zeros(lead_shape + (size,), work_dtype)
+    c1 = jnp.zeros(lead_shape + (size,), jnp.int8)  # had-NaT / has-value
+
+    result_arr = None
+    order = range(nbatches) if not reverse else range(nbatches - 1, -1, -1)
+    with timed(f"stream-scan-mesh [{scan.name}] {nbatches} slab(s)"):
+        for i in order:
+            s, e = i * batch_len, min((i + 1) * batch_len, n)
+            slab = np.asarray(loader(s, e))
+            if dtype is not None and slab.dtype != work_dtype:
+                slab = slab.astype(work_dtype)
+            ccodes = codes[s:e]
+            pad = batch_len - (e - s)
+            if pad:
+                slab = np.concatenate(
+                    [slab, np.zeros(lead_shape + (pad,), slab.dtype)], axis=-1
+                )
+                ccodes = np.concatenate([ccodes, np.full(pad, -1, dtype=ccodes.dtype)])
+            slab_dev = jax.device_put(slab, slab_shard)
+            ccodes_np = np.ascontiguousarray(ccodes)
+            codes_dev = jax.device_put(ccodes_np.astype(np.int32), codes_shard)
+            out_sh, c0, c1 = step(slab_dev, codes_dev, c0, c1)
+            result_arr = _emit_scan_slab(
+                out_sh, ccodes_np, s, e, nat=nat, datetime_dtype=datetime_dtype,
+                has_missing=has_missing, out=out, result_arr=result_arr,
+                lead_shape=lead_shape, n=n,
+            )
     if out is not None:
         return None
     return result_arr

@@ -687,3 +687,74 @@ class TestStreamingScan:
         vals, _ = sdata
         with pytest.raises(NotImplementedError, match="1-D"):
             streaming_groupby_scan(vals, np.zeros((2, 3), np.int64), func="cumsum")
+
+
+class TestMeshStreamingScan:
+    """streaming x mesh scans: each slab runs the distributed Blelloch with
+    cross-slab carry I/O — out-of-core AND multi-chip, results still
+    streamable through a writer."""
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        from flox_tpu.parallel.mesh import make_mesh
+
+        return make_mesh()
+
+    @pytest.fixture(scope="class")
+    def msdata(self):
+        rng = np.random.default_rng(41)
+        n = 4096
+        vals = rng.normal(size=(2, n))
+        vals[:, ::9] = np.nan
+        labels = rng.integers(0, 6, n)
+        return vals, labels
+
+    @pytest.mark.parametrize("func", ["cumsum", "nancumsum", "ffill", "bfill"])
+    def test_matches_eager(self, mesh, msdata, func):
+        from flox_tpu import groupby_scan, streaming_groupby_scan
+
+        vals, labels = msdata
+        expected = np.asarray(groupby_scan(vals, labels, func=func))
+        got = streaming_groupby_scan(vals, labels, func=func, batch_len=1000, mesh=mesh)
+        np.testing.assert_allclose(got, expected, rtol=1e-10, atol=1e-12, equal_nan=True)
+
+    def test_timedelta_nat_poisons_across_slabs_and_shards(self, mesh, msdata):
+        # ONE NaT: its poison must cross shard boundaries (within-slab
+        # collective) AND slab boundaries (the sticky carry channel)
+        from flox_tpu import groupby_scan, streaming_groupby_scan
+
+        _, labels = msdata
+        rng = np.random.default_rng(6)
+        td = rng.integers(1, 100, labels.shape[0]).astype("timedelta64[ns]")
+        td[7] = np.timedelta64("NaT")
+        expected = np.asarray(groupby_scan(td, labels, func="cumsum"))
+        got = streaming_groupby_scan(td, labels, func="cumsum", batch_len=1000, mesh=mesh)
+        np.testing.assert_array_equal(got.view("int64"), expected.view("int64"))
+
+    def test_int_promotion_and_writer(self, mesh, msdata):
+        from flox_tpu import groupby_scan, streaming_groupby_scan
+
+        _, labels = msdata
+        n = labels.shape[0]
+        iv = (np.arange(n) % 97).astype(np.int32)
+        expected = np.asarray(groupby_scan(iv, labels, func="cumsum"))
+        written = np.empty(n, expected.dtype)
+        r = streaming_groupby_scan(
+            iv, labels, func="cumsum", batch_len=1000, mesh=mesh,
+            out=lambda s, e, res: written.__setitem__(slice(s, e), res),
+        )
+        assert r is None
+        np.testing.assert_array_equal(written, expected)
+
+    def test_datetime_ffill(self, mesh, msdata):
+        from flox_tpu import groupby_scan, streaming_groupby_scan
+
+        _, labels = msdata
+        rng = np.random.default_rng(8)
+        dt = np.datetime64("2020-01-01", "ns") + rng.integers(
+            0, 10**9, labels.shape[0]
+        ).astype("timedelta64[ns]")
+        dt[::13] = np.datetime64("NaT")
+        expected = np.asarray(groupby_scan(dt, labels, func="ffill"))
+        got = streaming_groupby_scan(dt, labels, func="ffill", batch_len=1000, mesh=mesh)
+        np.testing.assert_array_equal(got.view("int64"), expected.view("int64"))
