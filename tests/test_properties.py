@@ -131,3 +131,44 @@ def test_radix_select_equals_sort(data, q, method):
             finalize_kwargs={"q": q, "method": method},
         )
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    data=array_and_labels(with_nan=True),
+    func=st.sampled_from(SIMPLE_FUNCS + ["nanmedian", "median"]),
+    batch_len=st.integers(min_value=1, max_value=17),
+)
+def test_streaming_equals_eager_property(data, func, batch_len):
+    # the streaming runtime (including the counts-only streaming quantile)
+    # must equal eager for ANY slab size, label layout, and NaN pattern
+    from flox_tpu.streaming import streaming_groupby_reduce
+
+    vals, labels = data
+    ref, g1 = groupby_reduce(vals, labels, func=func)
+    got, g2 = streaming_groupby_reduce(vals, labels, func=func, batch_len=batch_len)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+    np.testing.assert_allclose(
+        np.asarray(got).astype(float), np.asarray(ref).astype(float),
+        rtol=1e-9, atol=1e-9, equal_nan=True,
+    )
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    data=array_and_labels(with_nan=True),
+    func=st.sampled_from(["cumsum", "nancumsum", "ffill", "bfill"]),
+    batch_len=st.integers(min_value=1, max_value=17),
+)
+def test_streaming_scan_equals_eager_property(data, func, batch_len):
+    # the cross-slab carry must reproduce the eager scan for ANY slab
+    # boundary placement (carries crossing mid-group, empty slabs for a
+    # group, bfill's reverse order)
+    from flox_tpu.streaming import streaming_groupby_scan
+
+    vals, labels = data
+    ref = groupby_scan(vals, labels, func=func)
+    got = streaming_groupby_scan(vals, labels, func=func, batch_len=batch_len)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-9, atol=1e-9, equal_nan=True
+    )
