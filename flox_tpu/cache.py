@@ -22,11 +22,14 @@ def clear_all() -> None:
     between timing rounds; ``benchmarks.py`` here does the same)."""
     from .cohorts import _COHORTS_CACHE
     from .core import _jitted_bundle
+    from .factorize import _FACTORIZE_CACHE, _FACTORIZE_CACHE_BYTES
     from .parallel.mapreduce import _PROGRAM_CACHE
     from .parallel.scan import _SCAN_CACHE
     from .streaming import _STEP_CACHE
 
     _COHORTS_CACHE.clear()
+    _FACTORIZE_CACHE.clear()
+    _FACTORIZE_CACHE_BYTES[0] = 0
     _PROGRAM_CACHE.clear()
     _SCAN_CACHE.clear()
     _STEP_CACHE.clear()
