@@ -162,6 +162,27 @@ def test_mesh_method_through_adapter(da):
     )
 
 
+def test_distributed_quantile_through_adapter(da):
+    # vector-q quantile under method='map-reduce' on the mesh (the
+    # distributed radix-select) through the full labeled-array path: the
+    # 'quantile' dim lands LAST like the eager path's (new dims trail,
+    # xarray.py _restore_dim_order) and results match the eager sort path
+    # bit-tight (the selection is count-exact)
+    from flox_tpu.parallel import make_mesh
+
+    out_eager = xarray_reduce(da, "month", func="quantile", q=[0.25, 0.75])
+    out_mesh = xarray_reduce(
+        da, "month", func="quantile", q=[0.25, 0.75],
+        method="map-reduce", mesh=make_mesh(8),
+    )
+    assert out_mesh.dims == out_eager.dims
+    assert "quantile" in out_mesh.dims
+    np.testing.assert_allclose(
+        np.asarray(out_mesh.data), np.asarray(out_eager.data),
+        rtol=5e-16, atol=0, equal_nan=True,
+    )
+
+
 def test_keep_attrs_false(da):
     out = xarray_reduce(da, "month", func="mean", keep_attrs=False)
     assert out.attrs == {}
