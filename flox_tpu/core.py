@@ -331,23 +331,23 @@ def _reduce_non_numeric(arr, bys, func: str, *, fill_value, **passthrough):
 
 
 def groupby_reduce(
-    array,
-    *by,
+    array: Any,
+    *by: Any,
     func: str | Aggregation,
-    expected_groups=None,
+    expected_groups: Any = None,
     sort: bool = True,
-    isbin=False,
-    axis=None,
-    fill_value=None,
-    dtype=None,
+    isbin: bool | Sequence[bool] = False,
+    axis: int | Sequence[int] | None = None,
+    fill_value: Any = None,
+    dtype: Any = None,
     min_count: int | None = None,
     method: str | None = None,
     engine: str | None = None,
-    reindex=None,
+    reindex: Any = None,
     finalize_kwargs: dict | None = None,
-    mesh=None,
+    mesh: Any = None,
     axis_name: str = "data",
-):
+) -> tuple:
     """GroupBy reduction (parity: core.py:739-1222; same signature contract).
 
     Returns ``(result, *groups)`` where ``result`` has the reduced axes

@@ -15,7 +15,7 @@ is host-only).
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Any, Sequence
 
 import numpy as np
 
@@ -25,7 +25,13 @@ from .aggregations import _initialize_aggregation
 __all__ = ["groupby_reduce_device", "codes_device"]
 
 
-def codes_device(by, expected_values=None, *, bins=None, closed: str = "right"):
+def codes_device(
+    by: Any,
+    expected_values: Sequence | None = None,
+    *,
+    bins: Sequence | None = None,
+    closed: str = "right",
+) -> Any:
     """Traceable label -> dense code computation on device.
 
     Exactly one of ``expected_values`` (sorted unique labels) or ``bins``
@@ -39,15 +45,15 @@ def codes_device(by, expected_values=None, *, bins=None, closed: str = "right"):
 
 
 def groupby_reduce_device(
-    array,
-    *by,
+    array: Any,
+    *by: Any,
     func: str,
     expected_values: Sequence | None = None,
     bins: Sequence | None = None,
-    fill_value=None,
-    dtype=None,
+    fill_value: Any = None,
+    dtype: Any = None,
     finalize_kwargs: dict | None = None,
-):
+) -> Any:
     """Grouped reduction with every step on device — safe inside ``jax.jit``.
 
     ``by`` entries are device arrays whose *flattened* elements align with

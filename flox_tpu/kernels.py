@@ -1046,8 +1046,10 @@ def _radix_select(data, codes, size, ranks, valid_mask, axis_name=None):
     keys = _valid_keys(data, valid_mask)
     n = data.shape[0]
     if axis_name is not None:
+        from .parallel.mesh import axis_size
+
         axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
-        n = n * int(np.prod([jax.lax.axis_size(a) for a in axes]))
+        n = n * int(np.prod([axis_size(a) for a in axes]))
     # counts ride f32 (the MXU path) when the GLOBAL count cannot overflow
     # its exact integer range; int32 scatter otherwise
     cdtype = jnp.float32 if n < 2**24 else jnp.int32

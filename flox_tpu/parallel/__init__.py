@@ -21,8 +21,14 @@ Dense, shape-static intermediates over ``expected_groups`` (the reference's
 contribution identical in shape, which is exactly what collectives need.
 """
 
-from .mesh import make_mesh
+from .mesh import axis_size, make_mesh, shard_map
 from .mapreduce import sharded_groupby_reduce
 from .scan import sharded_groupby_scan
 
-__all__ = ["make_mesh", "sharded_groupby_reduce", "sharded_groupby_scan"]
+__all__ = [
+    "axis_size",
+    "make_mesh",
+    "shard_map",
+    "sharded_groupby_reduce",
+    "sharded_groupby_scan",
+]

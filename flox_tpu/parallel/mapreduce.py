@@ -30,12 +30,14 @@ reduction with the collectives.
 from __future__ import annotations
 
 import functools
+from typing import Any
+
 import numpy as np
 
 from .. import utils
 from ..aggregations import Aggregation
 from ..multiarray import MultiArray
-from .mesh import make_mesh
+from .mesh import axis_size, make_mesh, shard_map
 
 _BIG = np.iinfo(np.int32).max
 
@@ -268,7 +270,7 @@ def _flat_axis_index(axes: tuple[str, ...]):
 
     idx = jax.lax.axis_index(axes[0])
     for a in axes[1:]:
-        idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+        idx = idx * axis_size(a) + jax.lax.axis_index(a)
     return idx
 
 
@@ -329,16 +331,16 @@ def dense_intermediate_bytes(
 
 
 def sharded_groupby_reduce(
-    array,
-    codes,
+    array: Any,
+    codes: Any,
     agg: Aggregation,
     *,
     size: int,
-    mesh=None,
-    axis_name: str = "data",
+    mesh: Any = None,
+    axis_name: str | tuple[str, ...] = "data",
     method: str = "map-reduce",
     nat: bool = False,
-):
+) -> Any:
     """Run one grouped reduction as a sharded SPMD program.
 
     ``array``: (..., N) (host or device), sharded over the trailing axis;
@@ -495,7 +497,7 @@ def sharded_groupby_reduce(
         # all_gather), but the static checker cannot infer that through
         # argmin/take_along_axis owner-selection.
         fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 program, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
             )
         )

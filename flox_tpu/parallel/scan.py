@@ -20,27 +20,30 @@ Mesh realization, one jitted SPMD program:
 
 from __future__ import annotations
 
+from typing import Any
+
 import numpy as np
 
 from .. import utils
 from ..aggregations import Scan
 from .mapreduce import _cached_mesh_default, _flat_axis_index, _norm_axes, _pad_to
+from .mesh import shard_map
 
 _SCAN_CACHE: dict = {}
 
 
 def sharded_groupby_scan(
-    array,
-    codes,
+    array: Any,
+    codes: Any,
     scan: Scan,
     *,
     size: int,
-    mesh=None,
-    axis_name: str = "data",
-    dtype=None,
+    mesh: Any = None,
+    axis_name: str | tuple[str, ...] = "data",
+    dtype: Any = None,
     method: str = "blelloch",
     nat: bool = False,
-):
+) -> Any:
     """Sharded grouped scan over the trailing axis. Returns same shape as
     ``array`` (padded positions stripped).
 
@@ -86,7 +89,7 @@ def sharded_groupby_scan(
         else:
             program = _build_scan_program(scan, size=size, axis_name=axes, nat=nat)
         fn = jax.jit(
-            jax.shard_map(program, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+            shard_map(program, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
         )
         if len(_SCAN_CACHE) > 256:
             _SCAN_CACHE.clear()
@@ -115,7 +118,7 @@ def build_stream_scan_step(scan: Scan, *, size: int, mesh, axis_name="data",
     spec_entry = axes if len(axes) > 1 else axes[0]
     arr_spec = P(*([None] * lead_ndim + [spec_entry]))
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             program, mesh=mesh,
             in_specs=(arr_spec, P(spec_entry), P(), P()),
             out_specs=(arr_spec, P(), P()),

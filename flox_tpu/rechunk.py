@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass
+from typing import Any
 
 import numpy as np
 
@@ -110,7 +111,9 @@ def reshard_for_blockwise(codes: np.ndarray, n_shards: int) -> BlockwiseLayout:
     )
 
 
-def rechunk_for_blockwise(array, axis: int, labels, n_shards: int | None = None):
+def rechunk_for_blockwise(
+    array: Any, axis: int, labels: Any, n_shards: int | None = None
+) -> tuple:
     """Convenience wrapper mirroring the reference's public name
     (rechunk.py:158-223): returns ``(resharded_array, resharded_codes)``
     ready for ``groupby_reduce(..., method='blockwise')``.
@@ -133,13 +136,13 @@ def rechunk_for_blockwise(array, axis: int, labels, n_shards: int | None = None)
 
 
 def rechunk_for_cohorts(
-    array,
+    array: Any,
     axis: int,
-    labels,
-    force_new_chunk_at,
+    labels: Any,
+    force_new_chunk_at: Any,
     chunksize: int | None = None,
     debug: bool = False,
-):
+) -> tuple[int, ...] | tuple[tuple[int, ...], list[int]]:
     """Chunk boundaries anchored at label-pattern starts (parity:
     rechunk.py:64-155).
 

@@ -19,6 +19,8 @@ range, so one flat segmented scan handles every row without crossing rows.
 from __future__ import annotations
 
 import logging
+from typing import Any
+
 import numpy as np
 
 from . import factorize as fct
@@ -33,16 +35,16 @@ __all__ = ["groupby_scan"]
 
 
 def groupby_scan(
-    array,
-    *by,
+    array: Any,
+    *by: Any,
     func: str | Scan,
-    expected_groups=None,
+    expected_groups: Any = None,
     axis: int = -1,
-    dtype=None,
+    dtype: Any = None,
     method: str | None = None,
     engine: str | None = None,
-    mesh=None,
-):
+    mesh: Any = None,
+) -> Any:
     """Grouped scan along ``axis``; output has the same shape as ``array``.
 
     Parity: scan.py:101-315 — single-axis validation (scan.py:176-177),

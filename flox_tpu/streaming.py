@@ -81,23 +81,23 @@ def _step_cached(key, build):
 
 
 def streaming_groupby_reduce(
-    array,
-    by,
+    array: Any,
+    by: Any,
     *,
     func: str | Aggregation,
     batch_len: int | None = None,
     batch_bytes: int = 256 * 2**20,
-    expected_groups=None,
-    isbin=False,
+    expected_groups: Any = None,
+    isbin: Any = False,
     sort: bool = True,
-    axis=None,
-    fill_value=None,
-    dtype=None,
+    axis: Any = None,
+    fill_value: Any = None,
+    dtype: Any = None,
     min_count: int | None = None,
     finalize_kwargs: dict | None = None,
-    mesh=None,
-    axis_name="data",
-):
+    mesh: Any = None,
+    axis_name: str | tuple[str, ...] = "data",
+) -> tuple:
     """Grouped reduction streaming slabs to device.
 
     ``array``: a host array ``(..., *by.shape)`` **or** a loader
@@ -563,14 +563,16 @@ def _mesh_step_runner(local_step, mesh, slab_spec, spec_entry):
     import jax
     from jax.sharding import PartitionSpec as P
 
+    from .parallel.mesh import shard_map
+
     def init_step(slab_sh, codes_sh, offset):
         return local_step(None, slab_sh, codes_sh, offset)
 
     common = dict(mesh=mesh, out_specs=P(spec_entry), check_vma=False)
-    init_fn = jax.jit(jax.shard_map(
+    init_fn = jax.jit(shard_map(
         init_step, in_specs=(slab_spec, P(spec_entry), P()), **common
     ))
-    step_fn = jax.jit(jax.shard_map(
+    step_fn = jax.jit(shard_map(
         local_step, in_specs=(P(spec_entry), slab_spec, P(spec_entry), P()), **common
     ))
 
@@ -598,6 +600,7 @@ def _build_mesh_final(agg: Aggregation, *, mesh, axes, nat: bool):
     from jax.sharding import PartitionSpec as P
 
     from .parallel.mapreduce import _combine_intermediates, _finalize_combined
+    from .parallel.mesh import shard_map
 
     spec_entry = axes if len(axes) > 1 else axes[0]
 
@@ -609,7 +612,7 @@ def _build_mesh_final(agg: Aggregation, *, mesh, axes, nat: bool):
         return _finalize_combined(agg, combined, counts_g)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             final, mesh=mesh, in_specs=(P(spec_entry),), out_specs=P(),
             check_vma=False,
         )
@@ -694,6 +697,7 @@ def _build_mesh_final_blocked(agg: Aggregation, *, size: int, mesh, axes):
     from jax.sharding import PartitionSpec as P
 
     from .parallel.mapreduce import _crop, _finalize_combined
+    from .parallel.mesh import shard_map
 
     spec_entry = axes if len(axes) > 1 else axes[0]
 
@@ -707,7 +711,7 @@ def _build_mesh_final_blocked(agg: Aggregation, *, size: int, mesh, axes):
         return _crop(jnp.moveaxis(full, 0, -1), size)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             final, mesh=mesh, in_specs=(P(spec_entry),), out_specs=P(),
             check_vma=False,
         )
@@ -715,18 +719,18 @@ def _build_mesh_final_blocked(agg: Aggregation, *, size: int, mesh, axes):
 
 
 def streaming_groupby_scan(
-    array,
-    by,
+    array: Any,
+    by: Any,
     *,
     func: str,
     batch_len: int | None = None,
     batch_bytes: int = 256 * 2**20,
-    expected_groups=None,
-    dtype=None,
+    expected_groups: Any = None,
+    dtype: Any = None,
     out: Callable[[int, int, Any], None] | None = None,
-    mesh=None,
-    axis_name="data",
-):
+    mesh: Any = None,
+    axis_name: str | tuple[str, ...] = "data",
+) -> Any:
     """Out-of-core grouped scan: slabs stream through a per-group carry.
 
     The reference runs scans over chunked arrays via dask's cumreduction
@@ -1167,13 +1171,15 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
         # above uses); bisection state replicated in AND out
         from jax.sharding import PartitionSpec as P
 
+        from .parallel.mesh import shard_map
+
         return (
-            jax.jit(jax.shard_map(
+            jax.jit(shard_map(
                 count_pass, mesh=mesh,
                 in_specs=(P(), P(), sspec, cspec), out_specs=P(),
                 check_vma=False,
             )),
-            jax.jit(jax.shard_map(
+            jax.jit(shard_map(
                 bit_pass, mesh=mesh,
                 in_specs=(P(), P(), sspec, cspec, P()), out_specs=P(),
                 check_vma=False,

@@ -149,23 +149,23 @@ def _plain_reduce(obj, dims, func: str, finalize_kwargs, keep_attrs: bool):
 
 
 def xarray_reduce(
-    obj,
-    *by,
+    obj: Any,
+    *by: Any,
     func: str,
-    expected_groups=None,
+    expected_groups: Any = None,
     isbin: bool | Sequence[bool] = False,
     sort: bool = True,
-    dim=None,
-    fill_value=None,
-    dtype=None,
+    dim: Hashable | Sequence[Hashable] | None = None,
+    fill_value: Any = None,
+    dtype: Any = None,
     method: str | None = None,
     engine: str | None = None,
     keep_attrs: bool = True,
     skipna: bool | None = None,
     min_count: int | None = None,
-    mesh=None,
+    mesh: Any = None,
     **finalize_kwargs: Any,
-):
+) -> Any:
     """GroupBy reduction on an xarray Dataset/DataArray.
 
     ``by`` entries may be variable/coordinate names or DataArrays. Returns

@@ -1,0 +1,216 @@
+"""Self-tests for tools/floxlint: every rule against the fixture corpus, the
+clean-package gate, suppression comments, CLI exit codes and JSON output.
+
+The fixture contract: each seeded violation line carries a trailing
+``# expect: FLXnnn`` marker; a fixture file's expected finding set is exactly
+its markers (so new false positives in a rule fail these tests too).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tools" / "floxlint" / "fixtures"
+
+sys.path.insert(0, str(REPO))
+
+from tools.floxlint import RULES, get_rules, lint_file, lint_paths  # noqa: E402
+from tools.floxlint.cli import main as floxlint_main  # noqa: E402
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*((?:FLX\d{3}[,\s]*)+)")
+
+
+def expected_findings(path: Path) -> set[tuple[str, int]]:
+    out: set[tuple[str, int]] = set()
+    for lineno, line in enumerate(path.read_text().splitlines(), start=1):
+        m = _EXPECT_RE.search(line)
+        if m:
+            for rule in re.findall(r"FLX\d{3}", m.group(1)):
+                out.add((rule, lineno))
+    return out
+
+
+def actual_findings(paths) -> set[tuple[str, int]]:
+    return {(f.rule, f.line) for f in lint_paths(paths)}
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: exact (rule, line) agreement per file
+# ---------------------------------------------------------------------------
+
+def test_fixture_corpus_is_nonempty():
+    assert len(list(FIXTURES.rglob("*.py"))) >= 7
+
+
+@pytest.mark.parametrize(
+    "fixture",
+    ["flx001_host_sync.py", "flx002_recompile_traps.py", "flx003_dtype_policy.py",
+     "flx004_version_gated.py", "clean_module.py", "suppressed.py"],
+)
+def test_fixture_findings_match_markers(fixture):
+    path = FIXTURES / fixture
+    assert actual_findings([path]) == expected_findings(path)
+
+
+def test_flx005_package_fixture():
+    pkg = FIXTURES / "flx005_pkg"
+    expected = expected_findings(pkg / "api.py")
+    assert expected  # the fixture seeds at least one violation
+    assert actual_findings([pkg]) == expected
+
+
+def test_every_rule_has_fixture_coverage():
+    """Each FLX rule must fire at least once across the corpus."""
+    seen = {rule for rule, _ in actual_findings([FIXTURES])}
+    assert seen == set(RULES), f"rules without fixture coverage: {set(RULES) - seen}"
+
+
+# ---------------------------------------------------------------------------
+# the package itself is clean (the lint gate this PR establishes)
+# ---------------------------------------------------------------------------
+
+
+def test_flox_tpu_package_is_clean():
+    findings = lint_paths([REPO / "flox_tpu"])
+    assert findings == [], "\n".join(f.format_human() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# acceptance regressions: re-introducing the fixed hazards must fail the lint
+# ---------------------------------------------------------------------------
+
+
+def test_bare_shard_map_reintroduction_fails(tmp_path):
+    bad = tmp_path / "regress_shard_map.py"
+    bad.write_text(
+        "import jax\n\n"
+        "def build(program, mesh, in_specs, out_specs):\n"
+        "    return jax.jit(jax.shard_map(program, mesh=mesh,\n"
+        "        in_specs=in_specs, out_specs=out_specs))\n"
+    )
+    rc = floxlint_main([str(bad)])
+    assert rc == 1
+    assert any(f.rule == "FLX004" for f in lint_file(bad))
+
+
+def test_bf16_combine_accumulator_reintroduction_fails(tmp_path):
+    bad = tmp_path / "regress_bf16.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n\n"
+        "def combine(partial, size):\n"
+        "    acc = jnp.zeros((size,), dtype=jnp.bfloat16)\n"
+        "    return acc + partial\n"
+    )
+    rc = floxlint_main([str(bad)])
+    assert rc == 1
+    assert any(f.rule == "FLX003" for f in lint_file(bad))
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_line_suppression(tmp_path):
+    src = (
+        "import jax.numpy as jnp\n\n"
+        "def f(x):\n"
+        "    return x.astype(jnp.bfloat16)  # floxlint: disable=FLX003\n"
+    )
+    p = tmp_path / "sup_line.py"
+    p.write_text(src)
+    assert lint_file(p) == []
+
+
+def test_file_suppression(tmp_path):
+    src = (
+        "# floxlint: disable-file=FLX003\n"
+        "import jax.numpy as jnp\n\n"
+        "def f(x):\n"
+        "    return x.astype(jnp.bfloat16)\n"
+        "def g(x):\n"
+        "    return x.astype('float16')\n"
+    )
+    p = tmp_path / "sup_file.py"
+    p.write_text(src)
+    assert lint_file(p) == []
+
+
+def test_suppression_is_rule_scoped(tmp_path):
+    # disabling FLX003 must not silence FLX004 on the same line
+    src = (
+        "import jax\n\n"
+        "def f():\n"
+        "    return jax.shard_map  # floxlint: disable=FLX003\n"
+    )
+    p = tmp_path / "sup_scoped.py"
+    p.write_text(src)
+    assert [f.rule for f in lint_file(p)] == ["FLX004"]
+
+
+def test_disable_all(tmp_path):
+    src = (
+        "import jax\n\n"
+        "def f():\n"
+        "    return jax.shard_map  # floxlint: disable=all\n"
+    )
+    p = tmp_path / "sup_all.py"
+    p.write_text(src)
+    assert lint_file(p) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI behavior
+# ---------------------------------------------------------------------------
+
+
+def test_cli_exit_zero_on_clean_package():
+    assert floxlint_main([str(REPO / "flox_tpu")]) == 0
+
+
+def test_cli_exit_one_on_fixtures():
+    assert floxlint_main([str(FIXTURES)]) == 1
+
+
+def test_cli_json_output(capsys):
+    rc = floxlint_main(["--format", "json", str(FIXTURES / "flx003_dtype_policy.py")])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["finding_count"] == len(payload["findings"]) > 0
+    assert set(payload["findings_by_rule"]) == {"FLX003"}
+    first = payload["findings"][0]
+    assert {"path", "line", "col", "rule", "message"} <= set(first)
+
+
+def test_cli_select_and_ignore():
+    only_3 = {
+        f.rule for f in lint_paths([FIXTURES], get_rules(select=["FLX003"]))
+    }
+    assert only_3 == {"FLX003"}
+    without_3 = {
+        f.rule for f in lint_paths([FIXTURES], get_rules(ignore=["FLX003"]))
+    }
+    assert "FLX003" not in without_3 and without_3
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    assert floxlint_main(["--select", "FLX999", str(FIXTURES)]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_cli_missing_path_is_usage_error():
+    assert floxlint_main([]) == 2
+    assert floxlint_main(["/nonexistent/die9ahPh"]) == 2
+
+
+def test_syntax_error_reported_as_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    findings = lint_file(p)
+    assert [f.rule for f in findings] == ["FLX000"]

@@ -10,6 +10,9 @@ Invariants:
 
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 from hypothesis.extra.numpy import arrays
 

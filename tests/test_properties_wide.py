@@ -11,6 +11,9 @@ the property space explores data/labels/dtypes, not trace shapes.
 
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis")
+
 from hypothesis import assume, given, settings, strategies as st
 from hypothesis.extra.numpy import arrays
 

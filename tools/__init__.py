@@ -1,0 +1,1 @@
+"""Developer tooling for the flox_tpu repo (not shipped with the package)."""

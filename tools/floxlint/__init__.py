@@ -1,0 +1,26 @@
+"""floxlint: JAX-hazard static analysis for the flox_tpu codebase.
+
+An AST-based linter for the failure modes that erase TPU performance without
+failing any test:
+
+* FLX001 — host-sync hazard: ``np.*`` / ``float()`` / ``int()`` / ``bool()``
+  / ``.item()`` applied to traced values inside jitted code.
+* FLX002 — recompile trap: unhashable or array-content-derived components in
+  jit/program cache keys.
+* FLX003 — dtype-policy violation: narrow-float (bf16/f16) casts or
+  accumulators outside ``flox_tpu/dtypes.py``, and ``jnp.float64`` use that
+  bypasses the x64 gate.
+* FLX004 — version-gated API access: ``jax.shard_map``-style attributes that
+  must go through the compat shim in ``flox_tpu/parallel/mesh.py``.
+* FLX005 — untyped public API: functions exported from ``__init__.py``
+  missing parameter or return annotations.
+
+Run as ``python -m tools.floxlint flox_tpu/``. Suppress a finding with a
+trailing ``# floxlint: disable=FLX001`` comment (comma-separated rule ids or
+``all``), or a whole file with ``# floxlint: disable-file=FLX001``.
+"""
+
+from .core import Finding, LintError, lint_file, lint_paths
+from .registry import RULES, get_rules
+
+__all__ = ["Finding", "LintError", "RULES", "get_rules", "lint_file", "lint_paths"]

@@ -1,0 +1,66 @@
+"""Command-line entry: ``python -m tools.floxlint flox_tpu/``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/driver error."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Sequence
+
+from .core import LintError, iter_python_files, lint_file
+from .core import _SuppressionIndex  # driver-internal, shared across files
+from .registry import RULES, get_rules
+from .reporting import format_human, format_json
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="floxlint",
+        description="JAX-hazard static analysis for flox_tpu (FLX001-FLX005).",
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories to lint")
+    parser.add_argument(
+        "--format", choices=("human", "json"), default="human", help="output format"
+    )
+    parser.add_argument(
+        "--select", help="comma-separated rule ids to run (default: all)"
+    )
+    parser.add_argument("--ignore", help="comma-separated rule ids to skip")
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule table and exit"
+    )
+    return parser
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule_id, rule in sorted(RULES.items()):
+            print(f"{rule_id}  {rule.name}\n       {rule.description}")
+        return 0
+    if not args.paths:
+        print("floxlint: no paths given (try: python -m tools.floxlint flox_tpu/)", file=sys.stderr)
+        return 2
+    try:
+        rules = get_rules(
+            args.select.split(",") if args.select else None,
+            args.ignore.split(",") if args.ignore else None,
+        )
+    except KeyError as exc:
+        print(f"floxlint: {exc.args[0]}", file=sys.stderr)
+        return 2
+    index = _SuppressionIndex()
+    findings = set()
+    files_checked = 0
+    try:
+        for path, root in iter_python_files(args.paths):
+            files_checked += 1
+            findings.update(lint_file(path, rules, root=root, _index=index))
+    except LintError as exc:
+        print(f"floxlint: {exc}", file=sys.stderr)
+        return 2
+    ordered = sorted(findings)
+    formatter = format_json if args.format == "json" else format_human
+    print(formatter(ordered, files_checked=files_checked))
+    return 1 if ordered else 0

@@ -1,0 +1,176 @@
+"""Lint driver: file discovery, parsing, suppressions, rule dispatch."""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator, Sequence
+
+_SUPPRESS_RE = re.compile(r"#\s*floxlint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_,\s]+)")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One lint finding, addressed by (path, line) so output sorts stably."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format_human(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+
+class LintError(Exception):
+    """Unrecoverable driver error (bad path, unreadable file)."""
+
+
+@dataclass
+class Suppressions:
+    """Per-file suppression comments, parsed from the token stream (not the
+    AST — comments never reach the AST)."""
+
+    file_rules: frozenset[str] = frozenset()
+    line_rules: dict[int, frozenset[str]] = field(default_factory=dict)
+
+    def active(self, rule: str, line: int) -> bool:
+        for ruleset in (self.file_rules, self.line_rules.get(line, frozenset())):
+            if "ALL" in ruleset or rule.upper() in ruleset:
+                return True
+        return False
+
+
+def parse_suppressions(source: str) -> Suppressions:
+    file_rules: set[str] = set()
+    line_rules: dict[int, frozenset[str]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return Suppressions()
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        kind, raw = m.group(1), m.group(2)
+        rules = frozenset(r.strip().upper() for r in raw.split(",") if r.strip())
+        if kind == "disable-file":
+            file_rules |= rules
+        else:
+            line = tok.start[0]
+            line_rules[line] = line_rules.get(line, frozenset()) | rules
+    return Suppressions(file_rules=frozenset(file_rules), line_rules=line_rules)
+
+
+@dataclass
+class FileContext:
+    """Everything a rule needs to analyze one file."""
+
+    path: Path
+    source: str
+    tree: ast.Module
+    #: directory being linted, for package-level rules (FLX005)
+    root: Path | None = None
+
+    @property
+    def display_path(self) -> str:
+        return str(self.path)
+
+
+class _SuppressionIndex:
+    """Lazily-loaded suppression tables keyed by path — findings may point
+    into files other than the one being walked (FLX005 resolves exports to
+    their definition sites)."""
+
+    def __init__(self) -> None:
+        self._cache: dict[str, Suppressions] = {}
+
+    def seed(self, path: str, source: str) -> None:
+        if path not in self._cache:
+            self._cache[path] = parse_suppressions(source)
+
+    def suppressed(self, finding: Finding) -> bool:
+        sup = self._cache.get(finding.path)
+        if sup is None:
+            try:
+                source = Path(finding.path).read_text()
+            except OSError:
+                return False
+            sup = parse_suppressions(source)
+            self._cache[finding.path] = sup
+        return sup.active(finding.rule, finding.line)
+
+
+def iter_python_files(paths: Sequence[str | Path]) -> Iterator[tuple[Path, Path]]:
+    """Yield (file, lint_root) pairs for every .py under ``paths``."""
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                yield f, p
+        elif p.is_file():
+            yield p, p.parent
+        else:
+            raise LintError(f"no such file or directory: {p}")
+
+
+def lint_file(
+    path: str | Path,
+    rules: Iterable | None = None,
+    *,
+    root: Path | None = None,
+    _index: _SuppressionIndex | None = None,
+) -> list[Finding]:
+    """Lint one file; returns findings after suppression filtering."""
+    from .registry import get_rules
+
+    path = Path(path)
+    try:
+        source = path.read_text()
+    except OSError as exc:
+        raise LintError(f"cannot read {path}: {exc}") from exc
+    index = _index if _index is not None else _SuppressionIndex()
+    index.seed(str(path), source)
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:
+        return [
+            Finding(
+                path=str(path),
+                line=exc.lineno or 1,
+                col=(exc.offset or 1) - 1,
+                rule="FLX000",
+                message=f"syntax error: {exc.msg}",
+            )
+        ]
+    ctx = FileContext(path=path, source=source, tree=tree, root=root)
+    findings: list[Finding] = []
+    for rule in rules if rules is not None else get_rules():
+        findings.extend(rule.check(ctx))
+    return sorted(f for f in findings if not index.suppressed(f))
+
+
+def lint_paths(paths: Sequence[str | Path], rules: Iterable | None = None) -> list[Finding]:
+    """Lint files/directories; deduplicates findings (package-level rules can
+    re-derive the same finding from several entry files)."""
+    index = _SuppressionIndex()
+    out: set[Finding] = set()
+    for f, lint_root in iter_python_files(paths):
+        out.update(lint_file(f, rules, root=lint_root, _index=index))
+    return sorted(out)

@@ -1,0 +1,29 @@
+"""Clean fixture: idiomatic flox_tpu-style code — zero findings expected."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_CACHE: dict = {}
+
+
+@jax.jit
+def segment_mean(codes, array, *, size: int = 8):
+    ones = jnp.ones_like(array)
+    totals = jax.ops.segment_sum(array, codes, num_segments=size)
+    counts = jax.ops.segment_sum(ones, codes, num_segments=size)
+    return totals / jnp.where(counts > 0, counts, 1)
+
+
+def cached_program(shape: tuple, dtype: str):
+    cache_key = (shape, dtype)
+    fn = _CACHE.get(cache_key)
+    if fn is None:
+        fn = jax.jit(lambda x: x * 2)
+        _CACHE[cache_key] = fn
+    return fn
+
+
+def host_summary(values) -> float:
+    arr = np.asarray(values)
+    return float(arr.sum())

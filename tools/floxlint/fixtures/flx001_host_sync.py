@@ -1,0 +1,49 @@
+"""FLX001 fixture: host-sync hazards inside traced code.
+
+Each seeded violation carries a trailing ``# expect: FLXnnn`` marker;
+tests/test_floxlint.py parses the markers and asserts the rule reports
+exactly these (rule, line) pairs and nothing else.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def jitted_mean(x):
+    total = jnp.sum(x)
+    return float(total) / x.size  # expect: FLX001
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def jitted_threshold(x, cutoff):
+    mask = x > cutoff
+    if bool(jnp.any(mask)):  # expect: FLX001
+        return x
+    return jnp.zeros_like(x)
+
+
+def _kernel_body(codes, array):
+    partial_sum = jnp.sum(array)
+    host_value = partial_sum.item()  # expect: FLX001
+    rounded = np.round(array)  # expect: FLX001
+    return host_value, rounded
+
+
+compiled_kernel = jax.jit(_kernel_body)
+
+
+def host_side_is_fine(values):
+    # NOT traced: plain helper, never jitted — float()/np.* here is legit
+    arr = np.asarray(values)
+    return float(arr.mean())
+
+
+@jax.jit
+def metadata_access_is_fine(x):
+    # shape/dtype reads are static under trace — no finding
+    scale = 1.0 / x.shape[-1]
+    return jnp.sum(x) * scale

@@ -1,0 +1,44 @@
+"""FLX002 fixture: recompile traps in program-cache keys."""
+
+import jax
+import numpy as np
+
+_PROGRAM_CACHE: dict = {}
+
+
+def lookup_with_list_key(shape, opts):
+    cache_key = (shape, [o for o in opts])  # expect: FLX002
+    return _PROGRAM_CACHE.get(cache_key)
+
+
+def lookup_with_array_key(codes):
+    codes_arr = np.asarray(codes)
+    cache_key = ("reduce", codes_arr)  # expect: FLX002
+    fn = _PROGRAM_CACHE.get(cache_key)
+    if fn is None:
+        fn = jax.jit(lambda x: x)
+        _PROGRAM_CACHE[cache_key] = fn
+    return fn
+
+
+def lookup_with_fstring_key(values):
+    values_arr = np.asarray(values)
+    key = f"program-{values_arr}"  # expect: FLX002
+    return _PROGRAM_CACHE.get(key)
+
+
+def dict_in_subscript(kwargs):
+    return _PROGRAM_CACHE[{"kw": kwargs}]  # expect: FLX002
+
+
+def good_key(codes, method):
+    codes_arr = np.asarray(codes)
+    # static metadata and content-hashing are the sanctioned key material
+    cache_key = (codes_arr.shape, str(codes_arr.dtype), method, codes_arr.tobytes())
+    return _PROGRAM_CACHE.get(cache_key)
+
+
+def good_fstring_key(codes):
+    codes_arr = np.asarray(codes)
+    key = f"program-{codes_arr.dtype}"  # metadata only: fine
+    return _PROGRAM_CACHE.get(key)

@@ -1,0 +1,37 @@
+"""FLX003 fixture: dtype-policy violations (narrow-float accumulators and
+ungated float64)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def bf16_accumulator(x, size):
+    acc = jnp.zeros((size,), dtype=jnp.bfloat16)  # expect: FLX003
+    return acc + x
+
+
+def narrow_cast_by_string(x):
+    return x.astype("float16")  # expect: FLX003
+
+
+def narrow_cast_by_attr(partials):
+    combined = partials.sum(axis=0)
+    return combined.astype(jnp.bfloat16)  # expect: FLX003
+
+
+def ungated_f64(x):
+    return x.astype(jnp.float64)  # expect: FLX003
+
+
+def gated_f64(x, x64_enabled):
+    # the sanctioned spelling: every f64 choice branches on the x64 gate
+    return x.astype(jnp.float64 if x64_enabled() else jnp.float32)
+
+
+def host_f64_is_fine(x):
+    # numpy (host) float64 is not device policy — engine_numpy uses this
+    return np.asarray(x).astype(np.float64)
+
+
+def f32_is_fine(x, size):
+    return jnp.zeros((size,), dtype=jnp.float32) + x

@@ -1,0 +1,24 @@
+"""FLX004 fixture: version-gated jax APIs accessed without the compat shim."""
+
+import jax
+from jax.experimental.shard_map import shard_map as raw_shard_map  # expect: FLX004
+
+
+def build_program(program, mesh, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(  # expect: FLX004
+            program, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        )
+    )
+
+
+def tree_helpers(tree):
+    return jax.tree_map(lambda x: x + 1, tree)  # expect: FLX004
+
+
+def flat_index(axes):
+    return jax.lax.axis_size(axes[0])  # expect: FLX004
+
+
+def modern_tree_is_fine(tree):
+    return jax.tree.map(lambda x: x + 1, tree)

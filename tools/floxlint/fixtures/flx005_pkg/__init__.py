@@ -1,0 +1,8 @@
+"""FLX005 fixture package: exports with and without annotations.
+
+Expected-findings markers live at the definition sites in ``api.py``.
+"""
+
+from .api import annotated_reduce, untyped_reduce, untyped_scan
+
+__all__ = ["annotated_reduce", "untyped_reduce", "untyped_scan", "_private_helper"]

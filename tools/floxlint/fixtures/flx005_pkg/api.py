@@ -1,0 +1,20 @@
+"""Definition site for the FLX005 fixture package exports."""
+
+from typing import Any
+
+
+def untyped_reduce(array, codes, size=8):  # expect: FLX005
+    return array, codes, size
+
+
+def untyped_scan(array, *by, func: str = "cumsum"):  # expect: FLX005
+    return array, by, func
+
+
+def annotated_reduce(array: Any, codes: Any, *, size: int = 8) -> Any:
+    return array, codes, size
+
+
+def _not_exported(a, b):
+    # missing annotations but not in __all__ -> no finding
+    return a + b

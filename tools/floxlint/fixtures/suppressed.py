@@ -1,0 +1,19 @@
+"""Suppression fixture: every hazard here carries a disable comment, so the
+expected finding set for this file is EMPTY."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def deliberate_host_pull(x):
+    total = jnp.sum(x)
+    return float(total)  # floxlint: disable=FLX001
+
+
+def deliberate_narrow_cast(x):
+    return x.astype(jnp.bfloat16)  # floxlint: disable=FLX003
+
+
+def deliberate_compat_probe():
+    return jax.shard_map  # floxlint: disable=FLX004

@@ -1,0 +1,34 @@
+"""Rule registry: the single list the CLI, the tests, and the docs share."""
+
+from __future__ import annotations
+
+from .rules.flx001_host_sync import HostSyncRule
+from .rules.flx002_recompile import RecompileTrapRule
+from .rules.flx003_dtype import DtypePolicyRule
+from .rules.flx004_version import VersionGatedApiRule
+from .rules.flx005_api import UntypedPublicApiRule
+
+#: id -> rule instance, in id order
+RULES = {
+    rule.id: rule
+    for rule in (
+        HostSyncRule(),
+        RecompileTrapRule(),
+        DtypePolicyRule(),
+        VersionGatedApiRule(),
+        UntypedPublicApiRule(),
+    )
+}
+
+
+def get_rules(select: list[str] | None = None, ignore: list[str] | None = None) -> list:
+    """Resolve ``--select`` / ``--ignore`` id lists to rule instances."""
+    chosen = dict(RULES)
+    if select:
+        unknown = [r for r in select if r.upper() not in RULES]
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {', '.join(unknown)}")
+        chosen = {r.upper(): RULES[r.upper()] for r in select}
+    for r in ignore or ():
+        chosen.pop(r.upper(), None)
+    return list(chosen.values())

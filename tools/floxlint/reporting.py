@@ -1,0 +1,36 @@
+"""Output formatting: human (one finding per line) and machine (JSON)."""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Sequence
+
+from .core import Finding
+
+
+def format_human(findings: Sequence[Finding], *, files_checked: int) -> str:
+    lines = [f.format_human() for f in findings]
+    if findings:
+        by_rule = Counter(f.rule for f in findings)
+        breakdown = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
+        lines.append("")
+        lines.append(
+            f"floxlint: {len(findings)} finding(s) in {files_checked} file(s) ({breakdown})"
+        )
+    else:
+        lines.append(f"floxlint: clean — 0 findings in {files_checked} file(s)")
+    return "\n".join(lines)
+
+
+def format_json(findings: Sequence[Finding], *, files_checked: int) -> str:
+    by_rule = Counter(f.rule for f in findings)
+    return json.dumps(
+        {
+            "files_checked": files_checked,
+            "finding_count": len(findings),
+            "findings_by_rule": dict(sorted(by_rule.items())),
+            "findings": [f.as_dict() for f in findings],
+        },
+        indent=2,
+    )

@@ -1,0 +1,15 @@
+"""FLX rule implementations, one module per rule."""
+
+from .flx001_host_sync import HostSyncRule
+from .flx002_recompile import RecompileTrapRule
+from .flx003_dtype import DtypePolicyRule
+from .flx004_version import VersionGatedApiRule
+from .flx005_api import UntypedPublicApiRule
+
+__all__ = [
+    "HostSyncRule",
+    "RecompileTrapRule",
+    "DtypePolicyRule",
+    "VersionGatedApiRule",
+    "UntypedPublicApiRule",
+]

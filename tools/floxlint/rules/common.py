@@ -1,0 +1,209 @@
+"""Shared AST machinery for the FLX rules: alias resolution (what does
+``jnp`` mean in this module?) and a conservative traced-value propagation."""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` Attribute/Name chain -> "a.b.c"; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class ImportMap:
+    """Local alias -> canonical dotted module/object path for one module."""
+
+    aliases: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_tree(cls, tree: ast.Module) -> "ImportMap":
+        aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return cls(aliases)
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Canonical path of a Name/Attribute chain, e.g. ``jnp.sum`` ->
+        "jax.numpy.sum" under ``import jax.numpy as jnp``."""
+        name = dotted_name(node)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        base = self.aliases.get(head)
+        if base is None:
+            return name  # unimported chains resolve to themselves
+        return f"{base}.{rest}" if rest else base
+
+    def resolves_to(self, node: ast.AST, *prefixes: str) -> bool:
+        resolved = self.resolve(node)
+        if resolved is None:
+            return False
+        return any(resolved == p or resolved.startswith(p + ".") for p in prefixes)
+
+
+def names_in(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+
+
+def assigned_names(target: ast.AST) -> Iterator[str]:
+    """Plain-name targets of an assignment (tuples unpacked, no attrs/subs)."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from assigned_names(elt)
+    elif isinstance(target, ast.Starred):
+        yield from assigned_names(target.value)
+
+
+# canonical prefixes whose call results are traced/device values
+TRACED_CALL_PREFIXES = ("jax.numpy", "jax.lax", "jax.nn", "jax.random", "jax.scipy")
+
+
+def collect_traced_names(
+    func: ast.FunctionDef | ast.AsyncFunctionDef, imports: ImportMap
+) -> set[str]:
+    """Names holding (potentially) traced values inside ``func``: every
+    parameter, plus a fixpoint over assignments whose RHS mentions a traced
+    name or calls into jax.numpy/jax.lax. Conservative in the
+    under-approximating direction: attribute stores, globals, and values
+    returned by unknown helpers are NOT considered traced."""
+    traced: set[str] = set()
+    args = func.args
+    for a in args.posonlyargs + args.args + args.kwonlyargs:
+        traced.add(a.arg)
+    if args.vararg:
+        traced.add(args.vararg.arg)
+    if args.kwarg:
+        traced.add(args.kwarg.arg)
+
+    def rhs_traced(value: ast.AST) -> bool:
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Name) and sub.id in traced:
+                return True
+            if isinstance(sub, ast.Call) and imports.resolves_to(sub.func, *TRACED_CALL_PREFIXES):
+                return True
+        return False
+
+    # two passes reach a fixpoint for straight-line + simple loop bodies
+    for _ in range(2):
+        before = len(traced)
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and rhs_traced(node.value):
+                for t in node.targets:
+                    traced.update(assigned_names(t))
+            elif isinstance(node, ast.AugAssign) and (
+                rhs_traced(node.value) or any(n in traced for n in names_in(node.target))
+            ):
+                traced.update(assigned_names(node.target))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None and rhs_traced(node.value):
+                traced.update(assigned_names(node.target))
+            elif isinstance(node, ast.For) and rhs_traced(node.iter):
+                traced.update(assigned_names(node.target))
+        if len(traced) == before:
+            break
+    return traced
+
+
+# call targets that trace their function argument(s)
+TRACING_ENTRYPOINTS = (
+    "jax.jit",
+    "jax.pmap",
+    "jax.vmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.lax.fori_loop",
+    "jax.lax.while_loop",
+    "jax.lax.scan",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.map",
+    "jax.lax.associative_scan",
+    "jax.experimental.pallas.pallas_call",
+)
+# local helper names treated as tracing entrypoints wherever they appear
+TRACING_ENTRYPOINT_BASENAMES = ("shard_map", "pallas_call", "jit", "checkpoint")
+
+
+def _is_tracing_entrypoint(call: ast.Call, imports: ImportMap) -> bool:
+    if imports.resolves_to(call.func, *TRACING_ENTRYPOINTS):
+        return True
+    name = dotted_name(call.func)
+    return name is not None and name.split(".")[-1] in TRACING_ENTRYPOINT_BASENAMES
+
+
+def collect_traced_functions(tree: ast.Module, imports: ImportMap) -> list[ast.FunctionDef]:
+    """Function defs whose bodies run under a JAX trace: decorated with a
+    tracing transform, or referenced by name as an argument to one. Nested
+    defs inside a traced function are traced too."""
+    traced_names: set[str] = set()
+    defs: dict[str, list[ast.FunctionDef]] = {}
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+        if isinstance(node, ast.Call) and _is_tracing_entrypoint(node, imports):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    traced_names.add(arg.id)
+
+    traced: list[ast.FunctionDef] = []
+    seen: set[int] = set()
+
+    def add_with_nested(fn: ast.FunctionDef) -> None:
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        traced.append(fn)
+        for sub in ast.walk(fn):
+            if sub is not fn and isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add_with_nested(sub)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if isinstance(target, ast.Call):  # @partial(jax.jit, ...)
+                target = target.func
+            if _is_tracing_entrypoint_name(target, imports):
+                add_with_nested(node)
+                break
+            if isinstance(dec, ast.Call) and imports.resolves_to(dec.func, "functools.partial"):
+                if dec.args and _is_tracing_entrypoint_name(dec.args[0], imports):
+                    add_with_nested(node)
+                    break
+        if node.name in traced_names:
+            add_with_nested(node)
+    return traced
+
+
+def _is_tracing_entrypoint_name(node: ast.AST, imports: ImportMap) -> bool:
+    if imports.resolves_to(node, *TRACING_ENTRYPOINTS):
+        return True
+    name = dotted_name(node)
+    return name is not None and name.split(".")[-1] in TRACING_ENTRYPOINT_BASENAMES

@@ -1,0 +1,94 @@
+"""FLX001 — host-sync hazard inside traced code.
+
+``float(x)`` / ``int(x)`` / ``bool(x)`` / ``x.item()`` / ``np.anything(x)``
+on a traced value forces a device->host transfer (or a concretization error)
+in the middle of a jitted program — the silent sync stalls the whole XLA
+pipeline the paper's fused-bundle design exists to keep on device
+(flox_tpu/core.py _jitted_bundle)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding
+from .common import ImportMap, collect_traced_functions, collect_traced_names
+
+_HOST_BUILTINS = ("float", "int", "bool", "complex")
+_HOST_METHODS = ("item", "tolist", "to_py", "__array__")
+
+
+class HostSyncRule:
+    id = "FLX001"
+    name = "host-sync-hazard"
+    description = (
+        "np.*/float()/int()/bool()/.item() applied to a traced value inside "
+        "jitted or kernel code forces a device->host sync"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        imports = ImportMap.from_tree(ctx.tree)
+        for fn in collect_traced_functions(ctx.tree, imports):
+            traced = collect_traced_names(fn, imports)
+
+            def is_traced_expr(node: ast.AST) -> bool:
+                return any(
+                    isinstance(sub, ast.Name) and sub.id in traced for sub in ast.walk(node)
+                )
+
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                # float(x) / int(x) / bool(x) / complex(x)
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in _HOST_BUILTINS
+                    and node.func.id not in imports.aliases
+                    and node.args
+                    and is_traced_expr(node.args[0])
+                ):
+                    yield Finding(
+                        path=ctx.display_path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.id,
+                        message=(
+                            f"`{node.func.id}()` on a traced value inside "
+                            f"`{fn.name}` forces a host sync; keep the value on "
+                            "device (jnp ops) or hoist the conversion out of the "
+                            "traced region"
+                        ),
+                    )
+                    continue
+                # x.item() / x.tolist() on a traced root
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _HOST_METHODS
+                    and is_traced_expr(node.func.value)
+                ):
+                    yield Finding(
+                        path=ctx.display_path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.id,
+                        message=(
+                            f"`.{node.func.attr}()` on a traced value inside "
+                            f"`{fn.name}` forces a host sync"
+                        ),
+                    )
+                    continue
+                # np.<func>(traced) — numpy eagerly pulls the array to host
+                if imports.resolves_to(node.func, "numpy") and any(
+                    is_traced_expr(a) for a in node.args
+                ):
+                    yield Finding(
+                        path=ctx.display_path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.id,
+                        message=(
+                            "numpy call on a traced value inside "
+                            f"`{fn.name}` pulls the array to host; use the jnp "
+                            "equivalent so the op stays in the XLA program"
+                        ),
+                    )

@@ -1,0 +1,175 @@
+"""FLX002 — recompile trap: cache keys built from unhashable or
+array-content-dependent components.
+
+The package's speed rests on program caches (``core._jitted_bundle``,
+``parallel.mapreduce._PROGRAM_CACHE``, ``streaming._STEP_CACHE``) keyed by
+hashable, trace-stable tuples. A list/dict in the key raises at runtime; an
+ndarray (or an f-string stringifying its contents) silently gives every call
+a fresh key — one full XLA recompile per call. Static metadata
+(``x.dtype`` / ``x.shape`` / ``x.ndim``) is fine; array *contents* are not
+(hash by ``arr.tobytes()`` when content-keying is really wanted)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..core import FileContext, Finding
+from .common import ImportMap, assigned_names, dotted_name
+
+_KEY_NAME_RE = re.compile(r"(^|_)key$|(^|_)key(_|$)", re.IGNORECASE)
+_CACHE_NAME_RE = re.compile(r"cache", re.IGNORECASE)
+#: attribute reads of an array that are static metadata, not contents
+_STATIC_ATTRS = frozenset({"dtype", "shape", "ndim", "size", "itemsize", "name"})
+_ARRAY_CALL_PREFIXES = (
+    "numpy.array",
+    "numpy.asarray",
+    "numpy.ascontiguousarray",
+    "numpy.arange",
+    "numpy.zeros",
+    "numpy.ones",
+    "numpy.full",
+    "numpy.empty",
+    "numpy.concatenate",
+    "jax.numpy",
+    "jax.device_put",
+)
+
+
+def _collect_array_names(tree: ast.AST, imports: ImportMap) -> set[str]:
+    """Names assigned (anywhere in the module) from array constructors."""
+    names: set[str] = set()
+    for _ in range(2):
+        before = len(names)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            is_array = isinstance(value, ast.Call) and imports.resolves_to(
+                value.func, *_ARRAY_CALL_PREFIXES
+            )
+            if not is_array and isinstance(value, ast.Name) and value.id in names:
+                is_array = True
+            if is_array:
+                for t in node.targets:
+                    names.update(assigned_names(t))
+        if len(names) == before:
+            break
+    return names
+
+
+class RecompileTrapRule:
+    id = "FLX002"
+    name = "recompile-trap"
+    description = (
+        "unhashable (list/dict/set/ndarray) or array-content-derived values "
+        "in a jit/program cache key cause runtime errors or per-call recompiles"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        imports = ImportMap.from_tree(ctx.tree)
+        array_names = _collect_array_names(ctx.tree, imports)
+        for key_expr in self._key_expressions(ctx.tree):
+            yield from self._check_key_expr(ctx, key_expr, array_names)
+
+    # -- key-context discovery ---------------------------------------------
+
+    def _key_expressions(self, tree: ast.AST) -> Iterator[ast.AST]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                # a key-named assignment counts as cache-key context only when
+                # the RHS is tuple- or string-shaped — device values are also
+                # commonly named `key` (sort keys, radix keys)
+                if isinstance(node.value, (ast.Tuple, ast.JoinedStr)) and any(
+                    _KEY_NAME_RE.search(n) for t in node.targets for n in assigned_names(t)
+                ):
+                    yield node.value
+            elif isinstance(node, ast.Subscript):
+                base = dotted_name(node.value)
+                if base and _CACHE_NAME_RE.search(base.split(".")[-1]):
+                    yield node.slice
+            elif isinstance(node, ast.Call):
+                func = dotted_name(node.func)
+                if func is None:
+                    continue
+                tail = func.split(".")[-1]
+                # cache.get(key, ...) / _step_cached((key...), build)
+                if tail in ("get", "setdefault", "pop") and _CACHE_NAME_RE.search(func):
+                    if node.args:
+                        yield node.args[0]
+                elif _CACHE_NAME_RE.search(tail) and node.args:
+                    yield node.args[0]
+
+    # -- component checks ---------------------------------------------------
+
+    def _check_key_expr(
+        self, ctx: FileContext, expr: ast.AST, array_names: set[str]
+    ) -> Iterator[Finding]:
+        components = expr.elts if isinstance(expr, ast.Tuple) else [expr]
+        for comp in components:
+            if isinstance(comp, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp, ast.DictComp)):
+                yield Finding(
+                    path=ctx.display_path,
+                    line=comp.lineno,
+                    col=comp.col_offset,
+                    rule=self.id,
+                    message=(
+                        "unhashable container in a cache key — jit static args "
+                        "and cache keys must be hashable (use a tuple)"
+                    ),
+                )
+            elif isinstance(comp, ast.Name) and comp.id in array_names:
+                yield Finding(
+                    path=ctx.display_path,
+                    line=comp.lineno,
+                    col=comp.col_offset,
+                    rule=self.id,
+                    message=(
+                        f"array `{comp.id}` used directly in a cache key — "
+                        "ndarrays are unhashable and their identity is not "
+                        "trace-stable; key on static metadata (shape/dtype) or "
+                        f"`{comp.id}.tobytes()` if contents must key the cache"
+                    ),
+                )
+            elif isinstance(comp, ast.JoinedStr):
+                yield from self._check_fstring(ctx, comp, array_names)
+
+    def _check_fstring(
+        self, ctx: FileContext, node: ast.JoinedStr, array_names: set[str]
+    ) -> Iterator[Finding]:
+        for part in node.values:
+            if not isinstance(part, ast.FormattedValue):
+                continue
+            # names reached through static metadata (x.dtype, x.shape[0],
+            # x.ndim, ...) are trace-stable and fine, at any nesting depth
+            static_names = {
+                sub2.id
+                for sub in ast.walk(part.value)
+                if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS
+                for sub2 in ast.walk(sub.value)
+                if isinstance(sub2, ast.Name)
+            }
+            bad = next(
+                (
+                    sub.id
+                    for sub in ast.walk(part.value)
+                    if isinstance(sub, ast.Name)
+                    and sub.id in array_names
+                    and sub.id not in static_names
+                ),
+                None,
+            )
+            if bad is not None:
+                yield Finding(
+                    path=ctx.display_path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.id,
+                    message=(
+                        f"f-string cache key stringifies array `{bad}` — that "
+                        "syncs the device AND gives every distinct content a "
+                        "fresh compile; key on static metadata instead"
+                    ),
+                )
+                return

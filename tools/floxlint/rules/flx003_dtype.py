@@ -1,0 +1,130 @@
+"""FLX003 — dtype-policy violation.
+
+The dtype policy lives in ``flox_tpu/dtypes.py`` (promotion / fill
+resolution) and ``flox_tpu/kernels.py::_acc_dtype`` (bf16/f16 accumulate in
+f32 and cast back once, at finalize). Two bug classes bypass it:
+
+* casting to / allocating in a narrow float (bf16, f16): sums saturate at
+  256 in bf16 — the exact bug class behind ``TestBf16Accumulation``;
+* ``jnp.float64`` without the x64 gate: silently downcasts to f32 under
+  default jax config, or flips program caches when ``jax_enable_x64``
+  changes — every f64 choice must branch on ``x64_enabled()`` /
+  ``jax.config.jax_enable_x64``.
+
+Intentional narrowing at an API boundary belongs in ``dtypes.py`` (exempt)
+or behind an explicit ``# floxlint: disable=FLX003``."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding
+from .common import ImportMap
+
+#: modules allowed to spell dtype decisions directly
+_EXEMPT_BASENAMES = ("dtypes.py",)
+
+_NARROW_STRINGS = frozenset({"bfloat16", "float16", "half", "f2", "e"})
+_NARROW_ATTRS = (
+    "jax.numpy.bfloat16",
+    "jax.numpy.float16",
+    "numpy.float16",
+    "numpy.half",
+    "ml_dtypes.bfloat16",
+)
+_F64_ATTRS = ("jax.numpy.float64",)
+_X64_GATE_MARKERS = ("x64_enabled", "jax_enable_x64")
+
+
+class DtypePolicyRule:
+    id = "FLX003"
+    name = "dtype-policy"
+    description = (
+        "narrow-float (bf16/f16) casts or accumulators outside dtypes.py, and "
+        "jnp.float64 use that bypasses the x64 gate"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.path.name in _EXEMPT_BASENAMES:
+            return
+        imports = ImportMap.from_tree(ctx.tree)
+        gated = _gated_nodes(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # x.astype(<dtype>)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("astype", "view")
+                and node.args
+            ):
+                yield from self._check_dtype_value(ctx, imports, node.args[0], gated, "astype")
+            # jnp.zeros(..., dtype=<dtype>) and friends
+            for kw in node.keywords:
+                if kw.arg in ("dtype", "preferred_element_type") and kw.value is not None:
+                    yield from self._check_dtype_value(
+                        ctx, imports, kw.value, gated, f"{kw.arg}="
+                    )
+
+    def _check_dtype_value(
+        self,
+        ctx: FileContext,
+        imports: ImportMap,
+        value: ast.AST,
+        gated: set[int],
+        where: str,
+    ) -> Iterator[Finding]:
+        narrow = False
+        f64 = False
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            narrow = value.value in _NARROW_STRINGS
+        elif imports.resolves_to(value, *_NARROW_ATTRS):
+            narrow = True
+        elif imports.resolves_to(value, *_F64_ATTRS):
+            f64 = id(value) not in gated
+        if narrow:
+            yield Finding(
+                path=ctx.display_path,
+                line=value.lineno,
+                col=value.col_offset,
+                rule=self.id,
+                message=(
+                    f"narrow-float dtype in `{where}` — bf16/f16 accumulators "
+                    "saturate (mantissa cannot count past 256); accumulate via "
+                    "kernels._acc_dtype / the dtypes.py policy and cast back "
+                    "once at finalize"
+                ),
+            )
+        elif f64:
+            yield Finding(
+                path=ctx.display_path,
+                line=value.lineno,
+                col=value.col_offset,
+                rule=self.id,
+                message=(
+                    f"`jnp.float64` in `{where}` without an x64 gate — under "
+                    "default jax config this silently becomes f32; write "
+                    "`jnp.float64 if utils.x64_enabled() else jnp.float32`"
+                ),
+            )
+
+
+def _gated_nodes(tree: ast.Module) -> set[int]:
+    """ids of AST nodes that sit inside an x64-gated conditional (an IfExp or
+    If whose test mentions x64_enabled()/jax_enable_x64)."""
+    gated: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.IfExp, ast.If)) and _mentions_gate(node.test):
+            for sub in ast.walk(node):
+                gated.add(id(sub))
+    return gated
+
+
+def _mentions_gate(test: ast.AST) -> bool:
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Name) and any(m in sub.id for m in _X64_GATE_MARKERS):
+            return True
+        if isinstance(sub, ast.Attribute) and any(m in sub.attr for m in _X64_GATE_MARKERS):
+            return True
+    return False

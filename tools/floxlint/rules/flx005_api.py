@@ -1,0 +1,128 @@
+"""FLX005 — untyped public API.
+
+Every function a package exports through ``__init__.py`` (its ``__all__``,
+falling back to the import list) is a contract surface: annotations are what
+lets mypy — and downstream users embedding groupby_reduce in their own jitted
+training steps — catch shape/dtype plumbing mistakes before they trace.
+Triggered from the package ``__init__.py``; findings point at the definition
+site in the source module."""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterator
+
+from ..core import FileContext, Finding
+
+
+class UntypedPublicApiRule:
+    id = "FLX005"
+    name = "untyped-public-api"
+    description = (
+        "function exported from a package __init__.py is missing parameter "
+        "or return annotations"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.path.name != "__init__.py":
+            return
+        pkg_dir = ctx.path.parent
+        exported = _exported_names(ctx.tree)
+        if not exported:
+            return
+        # exported name -> module file that defines it (relative imports only)
+        sources = _relative_import_sources(ctx.tree, pkg_dir)
+        for name in sorted(exported):
+            target = sources.get(name)
+            if target is None:
+                # defined in __init__ itself?
+                fn = _find_function(ctx.tree, name)
+                if fn is not None:
+                    yield from self._check_function(str(ctx.path), fn)
+                continue
+            mod_file, original = target
+            try:
+                mod_tree = ast.parse(mod_file.read_text(), filename=str(mod_file))
+            except (OSError, SyntaxError):
+                continue
+            fn = _find_function(mod_tree, original)
+            if fn is not None:
+                yield from self._check_function(str(mod_file), fn)
+
+    def _check_function(
+        self, path: str, fn: ast.FunctionDef | ast.AsyncFunctionDef
+    ) -> Iterator[Finding]:
+        args = fn.args
+        missing = [
+            a.arg
+            for a in args.posonlyargs + args.args + args.kwonlyargs
+            if a.annotation is None and a.arg not in ("self", "cls")
+        ]
+        if args.vararg is not None and args.vararg.annotation is None:
+            missing.append(f"*{args.vararg.arg}")
+        if args.kwarg is not None and args.kwarg.annotation is None:
+            missing.append(f"**{args.kwarg.arg}")
+        needs_return = fn.returns is None
+        if not missing and not needs_return:
+            return
+        parts = []
+        if missing:
+            parts.append(f"unannotated parameter(s): {', '.join(missing)}")
+        if needs_return:
+            parts.append("missing return annotation")
+        yield Finding(
+            path=path,
+            line=fn.lineno,
+            col=fn.col_offset,
+            rule=self.id,
+            message=f"exported function `{fn.name}` has {'; '.join(parts)}",
+        )
+
+
+def _exported_names(tree: ast.Module) -> set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        return {
+                            e.value
+                            for e in node.value.elts
+                            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                        }
+    # no __all__: every name imported from a submodule is public API
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.level > 0:
+            names.update(a.asname or a.name for a in node.names if a.name != "*")
+    return names
+
+
+def _relative_import_sources(
+    tree: ast.Module, pkg_dir: Path
+) -> dict[str, tuple[Path, str]]:
+    """local/exported name -> (module file, original name) for level-1
+    relative imports (``from .core import groupby_reduce``)."""
+    sources: dict[str, tuple[Path, str]] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ImportFrom) and node.level == 1 and node.module):
+            continue
+        mod_file = pkg_dir / f"{node.module.replace('.', '/')}.py"
+        if not mod_file.is_file():
+            mod_file = pkg_dir / node.module.replace(".", "/") / "__init__.py"
+            if not mod_file.is_file():
+                continue
+        for a in node.names:
+            if a.name != "*":
+                sources[a.asname or a.name] = (mod_file, a.name)
+    return sources
+
+
+def _find_function(
+    tree: ast.Module, name: str
+) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+    for node in tree.body:  # top-level defs only — methods are not exports
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name == name:
+            return node
+    return None

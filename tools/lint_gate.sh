@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Lint gate for flox_tpu: floxlint (mandatory) + ruff + mypy (best-effort —
+# skipped with a notice when the tool is not installed, so the gate runs in
+# minimal containers that only carry the jax toolchain).
+#
+# Usage: tools/lint_gate.sh  (from the repo root; CI runs it before tier-1 pytest)
+set -u
+
+cd "$(dirname "$0")/.."
+rc=0
+
+echo "== floxlint =="
+python -m tools.floxlint flox_tpu/ || rc=1
+
+echo
+echo "== ruff =="
+if python -c "import ruff" >/dev/null 2>&1; then
+    python -m ruff check flox_tpu/ tools/floxlint/ tests/test_floxlint.py || rc=1
+elif command -v ruff >/dev/null 2>&1; then
+    ruff check flox_tpu/ tools/floxlint/ tests/test_floxlint.py || rc=1
+else
+    echo "ruff not installed — skipping (config lives in [tool.ruff] in pyproject.toml)"
+fi
+
+echo
+echo "== mypy =="
+if python -c "import mypy" >/dev/null 2>&1; then
+    python -m mypy --config-file pyproject.toml || rc=1
+else
+    echo "mypy not installed — skipping (config lives in [tool.mypy] in pyproject.toml)"
+fi
+
+echo
+if [ "$rc" -eq 0 ]; then
+    echo "lint gate: PASS"
+else
+    echo "lint gate: FAIL"
+fi
+exit "$rc"
