@@ -25,6 +25,7 @@ def clear_all() -> None:
     from .factorize import _FACTORIZE_CACHE, _FACTORIZE_CACHE_BYTES
     from .parallel.mapreduce import _PROGRAM_CACHE
     from .parallel.scan import _SCAN_CACHE
+    from .pipeline import _DONATION_OK
     from .streaming import _STEP_CACHE
 
     _COHORTS_CACHE.clear()
@@ -33,4 +34,5 @@ def clear_all() -> None:
     _PROGRAM_CACHE.clear()
     _SCAN_CACHE.clear()
     _STEP_CACHE.clear()
+    _DONATION_OK.clear()
     _jitted_bundle.cache_clear()

@@ -6,7 +6,23 @@ semantics for its resharding analogue and adds device-policy knobs.
 
 from __future__ import annotations
 
+import os
 from typing import Any
+
+
+def _env_int(name: str, default: int, lo: int = 0, hi: int | None = None) -> int:
+    """Env-seeded integer default (CI matrices flip streaming modes this
+    way); a malformed or out-of-bounds value falls back rather than
+    breaking import — the bounds mirror the ``set_options`` validators, so
+    the env cannot seed a value the programmatic API would reject."""
+    try:
+        value = int(os.environ.get(name, default))
+    except ValueError:
+        return default
+    if value < lo or (hi is not None and value > hi):
+        return default
+    return value
+
 
 OPTIONS: dict[str, Any] = {
     # Resharding-for-blockwise is applied automatically only when the change
@@ -69,6 +85,24 @@ OPTIONS: dict[str, Any] = {
     # (..., size/ndev) from the start) or raises with the alternatives.
     # Default 8 GiB: half a v5e chip's HBM, leaving room for the data.
     "dense_intermediate_bytes_max": 8 * 2**30,
+    # Streaming pipeline (flox_tpu/pipeline.py): how many slabs the
+    # background staging pool may hold in flight — slab i+k loads, pads and
+    # device_puts while the device reduces slab i. 0 = synchronous inline
+    # staging (the pre-pipeline loop; staged bytes are identical either
+    # way). Depth > 1 also overlaps the loads themselves, so the loader
+    # must tolerate concurrent (start, stop) calls; a stateful serial
+    # reader should run with 1. Env-seeded (FLOX_TPU_STREAM_PREFETCH) so
+    # CI can sweep both modes without code changes.
+    "stream_prefetch": _env_int("FLOX_TPU_STREAM_PREFETCH", 2, 0, 64),
+    # sync the streaming carry every K dispatched steps so in-flight slabs
+    # (and their staged device copies) cannot pile up unboundedly in HBM
+    # when the host runs ahead of the device; 0 disables the throttle
+    "stream_dispatch_depth": _env_int("FLOX_TPU_STREAM_DISPATCH_DEPTH", 8, 0),
+    # donate the carry state into the jitted streaming steps so accumulator
+    # HBM is reused across slabs: "auto" probes the backend once (platforms
+    # that cannot alias donated buffers fall back to undonated steps),
+    # "on"/"off" force it
+    "stream_donate": "auto",
 }
 
 # single source of truth for the accumulation disciplines — referenced by
@@ -90,6 +124,9 @@ _VALIDATORS = {
     "pallas_scan_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
     "dense_intermediate_bytes_max": lambda x: isinstance(x, int) and x >= 2**20,
     "quantile_impl": lambda x: x in ("auto", "sort", "select"),
+    "stream_prefetch": lambda x: isinstance(x, int) and 0 <= x <= 64,
+    "stream_dispatch_depth": lambda x: isinstance(x, int) and x >= 0,
+    "stream_donate": lambda x: x in ("auto", "on", "off"),
 }
 
 
@@ -110,6 +147,10 @@ def trace_fingerprint() -> tuple:
         OPTIONS["scan_impl"],
         OPTIONS["pallas_scan_num_groups_max"],
         OPTIONS["quantile_impl"],
+        # build-time rather than trace-time, but the same staleness rule
+        # applies: a cached step compiled with donation must not serve a
+        # stream_donate="off" session (and vice versa)
+        OPTIONS["stream_donate"],
     )
 
 

@@ -108,8 +108,9 @@ def build_stream_scan_step(scan: Scan, *, size: int, mesh, axis_name="data",
     Blelloch (identical to the in-memory program) plus the cross-slab
     carry fold. Carry state: (per-group sums, had-NaT int8) for
     cumsum-mode; (per-group edge value, has int8) for ffill/bfill."""
-    import jax
     from jax.sharding import PartitionSpec as P
+
+    from ..pipeline import maybe_donate
 
     axes = _norm_axes(axis_name, mesh)
     program = _build_scan_program(
@@ -117,13 +118,16 @@ def build_stream_scan_step(scan: Scan, *, size: int, mesh, axis_name="data",
     )
     spec_entry = axes if len(axes) > 1 else axes[0]
     arr_spec = P(*([None] * lead_ndim + [spec_entry]))
-    return jax.jit(
+
+    # the cross-slab carry pair is donated: updated in place across slabs
+    return maybe_donate(
         shard_map(
             program, mesh=mesh,
             in_specs=(arr_spec, P(spec_entry), P(), P()),
             out_specs=(arr_spec, P(), P()),
             check_vma=False,
-        )
+        ),
+        donate_argnums=(2, 3),
     )
 
 

@@ -1,0 +1,318 @@
+"""Pipelined streaming executor: prefetched H2D staging + donated carry.
+
+The streaming entry points (`streaming.py`) used to stage every slab inline
+in the Python loop: ``loader(s, e)`` IO, the pad ``np.concatenate``, and the
+``jax.device_put`` all ran on the consumer thread, serialized against each
+other and against the step dispatch. jax's async dispatch hides device
+*compute* behind that staging, but nothing hides the staging itself — at
+ERA5 slab sizes the load+stage wall IS the streaming throughput. This module
+is the explicit pipeline:
+
+* :func:`stream_slabs` is the ONE slab source all three streaming runtimes
+  (reduce, scan, quantile) iterate, on both the single-device and mesh
+  paths. It stages slab ``i+k`` — load, pad, ``device_put`` against the
+  SAME shardings the synchronous path used — while the device reduces slab
+  ``i``. Prefetch changes only WHEN staging happens, never what bytes land
+  on device, so prefetch on/off is bit-identical by construction.
+* The prefetch stage is a bounded pool: at most ``OPTIONS["stream_prefetch"]``
+  slabs in flight, staged by that many background threads. Depth > 1 also
+  overlaps the loads themselves — the realistic win for latency-dominated
+  loaders (zarr/S3 range reads), where a single serial worker could never
+  beat the inline loop by more than the dispatch overhead. Loaders must
+  therefore tolerate concurrent ``(start, stop)`` calls when depth > 1
+  (zarr, memmap, and object-store readers do); a stateful serial reader
+  should run with ``stream_prefetch=1`` (one background worker, loads still
+  strictly ordered) or ``0`` (the original inline loop).
+* A loader exception is captured by the staging pool and re-raised on the
+  consumer thread at the failing slab's position in the stream; in-flight
+  stages are cancelled, nothing hangs.
+* :func:`maybe_donate` jits step programs with ``donate_argnums`` on the
+  carry state so every step reuses the accumulator HBM instead of
+  allocating a fresh dense ``(…, size)`` buffer set per slab — with a
+  probed fallback for platforms/versions that reject donation (the probe
+  result is memoized per backend in ``_DONATION_OK``, cleared by
+  ``cache.clear_all``).
+* :class:`DispatchThrottle` bounds dispatch depth: with prefetch feeding an
+  async device, nothing otherwise stops K slabs (plus their staged copies)
+  from piling up in HBM; every ``OPTIONS["stream_dispatch_depth"]`` steps
+  the throttle blocks on the carry, capping in-flight slabs.
+
+Per-slab load/stage/wait/dispatch timings flow into
+:mod:`flox_tpu.profiling` (``stream_monitor`` / ``StreamReport``), including
+an overlap fraction — the share of staging wall hidden off the consumer's
+critical path.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from time import perf_counter
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+__all__ = ["Slab", "stream_slabs", "maybe_donate", "donation_supported", "DispatchThrottle"]
+
+# backend name -> whether buffer donation actually works there (probed once;
+# a set_options(stream_donate=...) override bypasses it). Registered in
+# cache.clear_all with the other module-level caches.
+_DONATION_OK: dict[str, bool] = {}
+
+
+@dataclass
+class Slab:
+    """One staged slab: device-resident data/codes plus host metadata."""
+
+    index: int
+    start: int
+    stop: int
+    data: Any
+    codes: Any
+    codes_host: np.ndarray
+    offset: Any = None
+    load_ms: float = 0.0
+    stage_ms: float = 0.0
+    wait_ms: float = 0.0
+    dispatch_ms: float = 0.0
+
+
+def stream_slabs(
+    loader: Callable[[int, int], Any],
+    codes: np.ndarray,
+    *,
+    n: int,
+    batch_len: int,
+    lead_shape: tuple,
+    pad: bool = True,
+    reverse: bool = False,
+    slab_shard: Any = None,
+    codes_shard: Any = None,
+    with_offset: bool = False,
+    prefetch: int | None = None,
+    label: str = "",
+) -> Iterator[Slab]:
+    """Yield staged :class:`Slab` objects for every batch of ``[0, n)``.
+
+    ``codes`` must be the full-span contiguous host code array (int32 —
+    the entry points precompute it once, so per-slab slices are zero-copy
+    contiguous views). With ``slab_shard``/``codes_shard`` the device copy
+    is a sharded ``jax.device_put``; otherwise a plain ``jnp.asarray``.
+    ``pad=False`` keeps the tail slab ragged (the single-device scan
+    contract); ``reverse`` streams the slabs back-to-front (bfill).
+    ``prefetch=None`` reads ``OPTIONS["stream_prefetch"]``; ``0`` is the
+    synchronous inline loop, byte-identical staging either way.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .options import OPTIONS
+    from .profiling import StreamReport, record_stream
+
+    depth = OPTIONS["stream_prefetch"] if prefetch is None else prefetch
+    nbatches = math.ceil(n / batch_len) if n else 0
+    order = range(nbatches - 1, -1, -1) if reverse else range(nbatches)
+    lead = tuple(lead_shape)
+
+    def stage(i: int) -> Slab:
+        s, e = i * batch_len, min((i + 1) * batch_len, n)
+        t0 = perf_counter()
+        slab = np.asarray(loader(s, e))
+        chost = codes[s:e]
+        t1 = perf_counter()
+        padn = batch_len - (e - s)
+        if pad and padn:
+            slab = np.concatenate([slab, np.zeros(lead + (padn,), slab.dtype)], axis=-1)
+            cfull = np.concatenate([chost, np.full(padn, -1, dtype=chost.dtype)])
+        else:
+            cfull = chost
+        if slab_shard is not None:
+            # one host->N-device scatter per slab: each chip receives and
+            # reduces its contiguous 1/ndev of the slab
+            data = jax.device_put(slab, slab_shard)
+            cdev = jax.device_put(cfull, codes_shard)
+        else:
+            data, cdev = jnp.asarray(slab), jnp.asarray(cfull)
+        offset = jnp.asarray(np.int64(s)) if with_offset else None
+        t2 = perf_counter()
+        return Slab(
+            index=i, start=s, stop=e, data=data, codes=cdev, codes_host=chost,
+            offset=offset, load_ms=(t1 - t0) * 1e3, stage_ms=(t2 - t1) * 1e3,
+        )
+
+    report = StreamReport(label=label, prefetch=depth, nbatches=nbatches)
+    source: Iterator[Slab]
+    prefetcher = None
+    if depth > 0 and nbatches > 1:
+        prefetcher = _SlabPrefetcher(stage, order, depth)
+        source = iter(prefetcher)
+    else:
+        source = (stage(i) for i in order)
+
+    t_begin = perf_counter()
+    try:
+        while True:
+            t0 = perf_counter()
+            try:
+                slab = next(source)
+            except StopIteration:
+                break
+            # synchronous path: the whole load+stage ran inside next() on
+            # this thread, so wait == the staging cost on the critical path
+            slab.wait_ms = (perf_counter() - t0) * 1e3
+            t_yield = perf_counter()
+            yield slab
+            slab.dispatch_ms = (perf_counter() - t_yield) * 1e3
+            # the report keeps the Slab for its timings only: drop the
+            # device references so finished slabs don't stay pinned in HBM
+            # for the rest of the stream
+            slab.data = slab.codes = slab.offset = None
+            report.slabs.append(slab)
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
+        report.wall_ms = (perf_counter() - t_begin) * 1e3
+        record_stream(report)
+
+
+class _SlabPrefetcher:
+    """Bounded in-order prefetch over a staging function.
+
+    At most ``depth`` slabs are in flight at once (the pool has ``depth``
+    threads and the pending deque never grows past it), delivered strictly
+    in stream order. A staging exception re-raises on the consumer thread
+    at its position in the stream; ``close`` cancels everything pending so
+    an abandoned stream leaves no worker behind.
+    """
+
+    def __init__(self, stage: Callable[[int], Slab], indices: Any, depth: int) -> None:
+        self._stage = stage
+        self._indices = iter(indices)
+        self._pending: deque[Future] = deque()
+        self._pool: ThreadPoolExecutor | None = ThreadPoolExecutor(
+            max_workers=depth, thread_name_prefix="flox-tpu-stage"
+        )
+        for _ in range(depth):
+            self._submit_next()
+
+    def _submit_next(self) -> None:
+        if self._pool is None:
+            return
+        try:
+            i = next(self._indices)
+        except StopIteration:
+            return
+        self._pending.append(self._pool.submit(self._stage, i))
+
+    def __iter__(self) -> "_SlabPrefetcher":
+        return self
+
+    def __next__(self) -> Slab:
+        if not self._pending:
+            self.close()
+            raise StopIteration
+        fut = self._pending.popleft()
+        self._submit_next()
+        try:
+            return fut.result()
+        except BaseException:
+            # the loader (or device_put) failed for this slab: surface it
+            # NOW on the consumer thread and tear the pipeline down
+            self.close()
+            raise
+
+    def close(self) -> None:
+        if self._pool is None:
+            return
+        for fut in self._pending:
+            fut.cancel()
+        self._pending.clear()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        self._pool = None
+
+
+def donation_supported() -> bool:
+    """Whether step programs should donate their carry buffers.
+
+    ``OPTIONS["stream_donate"]``: ``"on"``/``"off"`` force it; ``"auto"``
+    probes the active backend once — a platform that cannot alias donated
+    buffers emits the jax donation warning (older CPU backends) or raises,
+    and the fallback keeps the undonated path.
+    """
+    from .options import OPTIONS
+
+    mode = OPTIONS["stream_donate"]
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    import jax
+
+    backend = jax.default_backend()
+    ok = _DONATION_OK.get(backend)
+    if ok is None:
+        ok = _probe_donation()
+        _DONATION_OK[backend] = ok
+    return ok
+
+
+def _probe_donation() -> bool:
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    probe = jax.jit(lambda acc, x: acc + x, donate_argnums=(0,))
+    try:
+        with warnings.catch_warnings(record=True) as captured:
+            warnings.simplefilter("always")
+            jax.block_until_ready(probe(jnp.zeros(8), jnp.ones(8)))
+        return not any("donat" in str(w.message).lower() for w in captured)
+    except Exception:
+        return False
+
+
+def maybe_donate(fun: Callable, *, donate_argnums: tuple[int, ...]) -> Callable:
+    """``jax.jit(fun, donate_argnums=...)`` when the platform supports
+    donation, plain ``jax.jit(fun)`` otherwise. Streaming step programs
+    thread their carry through a donated argnum so the dense ``(…, size)``
+    accumulators are updated in place across slabs instead of reallocated
+    per step. Callers must treat the passed-in carry as consumed (every
+    streaming loop already rebinds it to the step's return)."""
+    import jax
+
+    if donation_supported():
+        return jax.jit(fun, donate_argnums=donate_argnums)
+    return jax.jit(fun)
+
+
+@dataclass
+class DispatchThrottle:
+    """Bound the number of in-flight slab steps.
+
+    Async dispatch + prefetch means nothing else limits how many dispatched
+    slabs (and their staged device copies) can stack up in HBM when the
+    host runs ahead of the device. Every ``depth`` ticks the throttle
+    blocks until the carry is ready, draining the dispatch queue. ``0``
+    disables it. ``depth=None`` reads ``OPTIONS["stream_dispatch_depth"]``
+    at construction."""
+
+    depth: int | None = None
+    _ticks: int = field(default=0, repr=False)
+
+    def __post_init__(self) -> None:
+        if self.depth is None:
+            from .options import OPTIONS
+
+            self.depth = OPTIONS["stream_dispatch_depth"]
+
+    def tick(self, carry: Any) -> None:
+        if not self.depth or carry is None:
+            return
+        self._ticks += 1
+        if self._ticks % self.depth == 0:
+            import jax
+
+            jax.block_until_ready(carry)

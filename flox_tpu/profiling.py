@@ -3,7 +3,10 @@
 The reference has no built-in profiler beyond debug logging — profiling is
 external (asv, snakeviz). On TPU the native tool is ``jax.profiler``; this
 module provides the thin wrappers so users can capture a trace of a grouped
-reduction without learning the jax API.
+reduction without learning the jax API, plus the streaming-pipeline
+instrumentation (:func:`stream_monitor`): every ``streaming_groupby_*``
+call emits one :class:`StreamReport` of per-slab load/stage/wait/dispatch
+timings from which the prefetch overlap is read directly.
 """
 
 from __future__ import annotations
@@ -11,10 +14,12 @@ from __future__ import annotations
 import contextlib
 import logging
 import time
+from dataclasses import dataclass, field
+from typing import Any, Iterator
 
 logger = logging.getLogger("flox_tpu")
 
-__all__ = ["trace", "annotate", "timed"]
+__all__ = ["trace", "annotate", "timed", "stream_monitor", "StreamReport"]
 
 
 @contextlib.contextmanager
@@ -49,3 +54,86 @@ def timed(label: str):
         yield
     finally:
         logger.info("%s took %.3f ms", label, (time.perf_counter() - t0) * 1e3)
+
+
+@dataclass
+class StreamReport:
+    """Per-slab pipeline timings for one streaming pass.
+
+    ``slabs`` holds the :class:`flox_tpu.pipeline.Slab` records in
+    consumption order; each carries ``load_ms`` (loader IO), ``stage_ms``
+    (pad + device_put), ``wait_ms`` (time the consumer thread was blocked
+    waiting for the slab — with prefetch off this IS load+stage, with
+    prefetch on it is only the unhidden remainder) and ``dispatch_ms``
+    (consumer-side step dispatch, including any throttle sync)."""
+
+    label: str = ""
+    prefetch: int = 0
+    nbatches: int = 0
+    wall_ms: float = 0.0
+    slabs: list = field(default_factory=list)
+
+    @property
+    def load_ms(self) -> float:
+        return sum(s.load_ms for s in self.slabs)
+
+    @property
+    def stage_ms(self) -> float:
+        return sum(s.stage_ms for s in self.slabs)
+
+    @property
+    def wait_ms(self) -> float:
+        return sum(s.wait_ms for s in self.slabs)
+
+    @property
+    def dispatch_ms(self) -> float:
+        return sum(s.dispatch_ms for s in self.slabs)
+
+    @property
+    def overlap_fraction(self) -> float:
+        """Share of the staging wall (load+stage) hidden off the consumer's
+        critical path: 0.0 when every staged byte was waited for inline
+        (prefetch off), approaching 1.0 when the pipeline kept staging
+        entirely behind dispatch/compute."""
+        staged = self.load_ms + self.stage_ms
+        if staged <= 0.0:
+            return 0.0
+        return min(1.0, max(0.0, 1.0 - self.wait_ms / staged))
+
+    def summary(self) -> str:
+        return (
+            f"stream-pipeline [{self.label}] {len(self.slabs)}/{self.nbatches} "
+            f"slab(s) prefetch={self.prefetch}: wall {self.wall_ms:.1f} ms, "
+            f"load {self.load_ms:.1f} ms, stage {self.stage_ms:.1f} ms, "
+            f"wait {self.wait_ms:.1f} ms, dispatch {self.dispatch_ms:.1f} ms, "
+            f"overlap {self.overlap_fraction:.0%}"
+        )
+
+
+# active stream_monitor collectors (consumer-thread only: reports are
+# appended by the stream_slabs generator after each pass completes)
+_MONITORS: list[list[StreamReport]] = []
+
+
+@contextlib.contextmanager
+def stream_monitor() -> Iterator[list[StreamReport]]:
+    """Collect the :class:`StreamReport` of every streaming pass in scope.
+
+    >>> from flox_tpu import profiling, streaming_groupby_reduce
+    >>> with profiling.stream_monitor() as reports:  # doctest: +SKIP
+    ...     streaming_groupby_reduce(loader, by, func="nanmean")
+    >>> reports[0].overlap_fraction  # doctest: +SKIP
+    """
+    reports: list[StreamReport] = []
+    _MONITORS.append(reports)
+    try:
+        yield reports
+    finally:
+        _MONITORS.remove(reports)
+
+
+def record_stream(report: Any) -> None:
+    """Deliver one finished pass to every active monitor (and the log)."""
+    for collector in _MONITORS:
+        collector.append(report)
+    logger.info("%s", report.summary())

@@ -16,8 +16,17 @@ Design notes (TPU-first):
 * The per-slab step is ONE jitted function (chunk kernels + merge fused);
   slabs all share a static shape (the tail slab is padded with ``-1``
   codes), so it compiles once.
-* jax dispatch is async: the host can prepare/copy slab ``i+1`` while the
-  device reduces slab ``i`` — double buffering without explicit machinery.
+* Staging is pipelined (flox_tpu/pipeline.py): a bounded prefetch pool
+  loads, pads, and ``device_put``\\ s slab ``i+k`` while the device reduces
+  slab ``i`` — jax's async dispatch alone hides only *compute* behind the
+  inline staging, not the load+stage wall itself. All three entry points
+  (reduce, scan, quantile) iterate the same :func:`pipeline.stream_slabs`
+  source, single-device and mesh alike; ``OPTIONS["stream_prefetch"]=0``
+  restores the synchronous inline loop (bit-identical results either way).
+* The jitted steps donate their carry (``pipeline.maybe_donate``) so the
+  dense ``(…, size)`` accumulators update in place across slabs, and a
+  dispatch throttle (``OPTIONS["stream_dispatch_depth"]``) syncs the carry
+  every K steps so in-flight slabs cannot pile up unboundedly in HBM.
 * The pairwise variance merge is the reference's ``_var_combine``
   (aggregations.py:392-451) — the Chan update, applied slab-by-slab.
 """
@@ -136,9 +145,6 @@ def streaming_groupby_reduce(
     beyond any single device's ceiling stream too (see
     docs/distributed.md).
     """
-    import jax
-    import jax.numpy as jnp
-
     from . import dtypes as dtps
 
     labels = utils.asarray_host(by)
@@ -189,7 +195,10 @@ def streaming_groupby_reduce(
     codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
         bys, axes=red_axes, expected_groups=expected_idx, sort=sort
     )
-    codes = np.asarray(codes).reshape(-1)
+    # ONE contiguous int32 copy for the whole stream: per-slab slices are
+    # then zero-copy contiguous views, so the loop (and the prefetch
+    # workers) never re-copy or re-cast codes per slab
+    codes = np.ascontiguousarray(np.asarray(codes).reshape(-1), dtype=np.int32)
     if size == 0:
         raise ValueError("No groups to reduce over (empty expected_groups?)")
 
@@ -373,32 +382,22 @@ def streaming_groupby_reduce(
         )
     nbatches = math.ceil(n / batch_len)
 
+    from .pipeline import DispatchThrottle, stream_slabs
     from .profiling import timed
 
     state = None
+    throttle = DispatchThrottle()
     with timed(f"stream [{agg.name}] {nbatches} slab(s) x {batch_len}"):
-        for i in range(nbatches):
-            s, e = i * batch_len, min((i + 1) * batch_len, n)
-            slab = np.asarray(loader(s, e))
-            ccodes = codes[s:e]
-            pad = batch_len - (e - s)
-            if pad:
-                slab = np.concatenate(
-                    [slab, np.zeros(lead_shape + (pad,), slab.dtype)], axis=-1
-                )
-                ccodes = np.concatenate([ccodes, np.full(pad, -1, dtype=ccodes.dtype)])
-            if mesh is not None:
-                import jax
-
-                # one host->N-device scatter per slab: each chip receives and
-                # reduces its contiguous 1/ndev of the slab
-                slab_dev = jax.device_put(slab, slab_shard)
-                ccodes_dev = jax.device_put(np.ascontiguousarray(ccodes), codes_shard)
-            else:
-                slab_dev, ccodes_dev = jnp.asarray(slab), jnp.asarray(ccodes)
-            # async dispatch: this queues on device while the host loads
-            # slab i+1 (the timed block measures dispatch, not device work)
-            state = step(state, slab_dev, ccodes_dev, jnp.asarray(np.int64(s)))
+        # the pipeline stages slab i+k (load, pad, device_put against the
+        # shardings above) while the step for slab i runs; the step itself
+        # dispatches async, and the throttle syncs the carry every K steps
+        for sl in stream_slabs(
+            loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
+            slab_shard=slab_shard, codes_shard=codes_shard, with_offset=True,
+            label=f"reduce[{agg.name}]",
+        ):
+            state = step(state, sl.data, sl.codes, sl.offset)
+            throttle.tick(state)
 
     if mesh is not None:
         result = final(state)
@@ -503,20 +502,38 @@ def _merge_into(agg: Aggregation, state, inters, counts, *, nat: bool):
     return out, acc_counts + counts
 
 
+def _init_state_like_merged(agg: Aggregation, inters, counts, *, nat: bool):
+    """Cast the first slab's state to the dtypes a merge would produce.
+
+    Custom callable combines may promote (``jnp.stack([a, b]).sum(0)``
+    widens int32 chunk counts to int64 under x64), so without this the
+    carry pytree changes dtype between slab 1 and slab 2 — a step retrace,
+    and a donated init buffer that cannot alias its output. The self-merge
+    here is traced only for its (static) output dtypes; XLA DCEs the
+    computation, so the init step costs nothing extra."""
+    import jax
+
+    merged = _merge_into(agg, (inters, counts), inters, counts, nat=nat)
+    return jax.tree.map(lambda x, m: x.astype(m.dtype), (inters, counts), merged)
+
+
 def _build_step(agg: Aggregation, *, size: int, count_skipna: bool,
                 nat: bool = False):
-    """One jitted step: slab -> chunk intermediates -> merge into state."""
-    import jax
+    """One jitted step: slab -> chunk intermediates -> merge into state.
+    The carry is donated (pipeline.maybe_donate) so the dense accumulators
+    update in place across slabs; the first call's ``state=None`` donates
+    an empty pytree, so one jitted function covers both arities."""
+    from .pipeline import maybe_donate
 
     def step(state, slab, ccodes, offset):
         inters, counts = _slab_stats(
             agg, slab, ccodes, offset, size=size, count_skipna=count_skipna, nat=nat
         )
         if state is None:
-            return (inters, counts)
+            return _init_state_like_merged(agg, inters, counts, nat=nat)
         return _merge_into(agg, state, inters, counts, nat=nat)
 
-    jitted = jax.jit(step)
+    jitted = maybe_donate(step, donate_argnums=(0,))
 
     def run(state, slab, ccodes, offset):
         # first call establishes the state pytree; jit caches both arities
@@ -550,6 +567,7 @@ def _build_mesh_step(agg: Aggregation, *, size: int, shard_len: int,
             count_skipna=count_skipna, nat=nat,
         )
         if state is None:
+            inters, counts = _init_state_like_merged(agg, inters, counts, nat=nat)
             return _expand_dev(inters), counts[None]
         st = jax.tree.map(lambda x: x[0], state)
         minters, mcounts = _merge_into(agg, st, inters, counts, nat=nat)
@@ -559,11 +577,14 @@ def _build_mesh_step(agg: Aggregation, *, size: int, shard_len: int,
 
 
 def _mesh_step_runner(local_step, mesh, slab_spec, spec_entry):
-    """Two jitted shard_map programs (first slab has no state yet)."""
+    """Two jitted shard_map programs (first slab has no state yet). The
+    steady-state program donates the per-device carry so every chip's
+    accumulators update in place across slabs."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     from .parallel.mesh import shard_map
+    from .pipeline import maybe_donate
 
     def init_step(slab_sh, codes_sh, offset):
         return local_step(None, slab_sh, codes_sh, offset)
@@ -572,9 +593,9 @@ def _mesh_step_runner(local_step, mesh, slab_spec, spec_entry):
     init_fn = jax.jit(shard_map(
         init_step, in_specs=(slab_spec, P(spec_entry), P()), **common
     ))
-    step_fn = jax.jit(shard_map(
+    step_fn = maybe_donate(shard_map(
         local_step, in_specs=(P(spec_entry), slab_spec, P(spec_entry), P()), **common
-    ))
+    ), donate_argnums=(0,))
 
     def run(state, slab, ccodes, offset):
         if state is None:
@@ -677,6 +698,9 @@ def _build_mesh_step_blocked(agg: Aggregation, *, size_pad: int, ndev: int,
 
         counts_blk, inters_blk = jax.lax.fori_loop(1, ndev, body, carry0)
         if state is None:
+            inters_blk, counts_blk = _init_state_like_merged(
+                agg, inters_blk, counts_blk, nat=nat
+            )
             return _expand_dev(inters_blk), counts_blk[None]
         st = jax.tree.map(lambda x: x[0], state)
         acc_inters, acc_counts = st
@@ -790,7 +814,9 @@ def streaming_groupby_scan(
     codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
         [labels], axes=(0,), expected_groups=expected_idx, sort=True
     )
-    codes = np.asarray(codes).reshape(-1)
+    # ONE contiguous int32 copy for the whole stream (per-slab slices are
+    # zero-copy contiguous views; see streaming_groupby_reduce)
+    codes = np.ascontiguousarray(np.asarray(codes).reshape(-1), dtype=np.int32)
     if size == 0:
         raise ValueError("No groups to scan over (empty expected_groups?)")
 
@@ -915,31 +941,37 @@ def streaming_groupby_scan(
             probe_dtype=np.dtype("int64") if nat else probe.dtype,
         )
 
+    from .pipeline import maybe_donate, stream_slabs
+
     init_fn, step_fn = _step_cached(
         ("scan-step", scan.name, size, nat, str(dtype), has_missing),
         lambda: (
             jax.jit(lambda slab, ccodes: slab_scan(slab, ccodes, None, None)),
-            jax.jit(slab_scan),
+            # the per-group carry (and the sticky NaT/has channel) is
+            # donated: updated in place across slabs
+            maybe_donate(slab_scan, donate_argnums=(2, 3)),
         ),
     )
 
     result_arr = None
-    order = range(nbatches) if not reverse else range(nbatches - 1, -1, -1)
     carry = had = None
     with timed(f"stream-scan [{scan.name}] {nbatches} slab(s)"):
-        for i in order:
-            s, e = i * batch_len, min((i + 1) * batch_len, n)
-            slab = jnp.asarray(np.asarray(loader(s, e)))
-            ccodes_np = np.ascontiguousarray(codes[s:e])
-            ccodes = jnp.asarray(ccodes_np)
+        # prefetch overlaps the next load with this slab's compute+emit
+        # (the emit's host conversion syncs per slab, so no dispatch
+        # throttle is needed here); pad=False keeps the single-device scan
+        # contract of ragged tail slabs
+        for sl in stream_slabs(
+            loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
+            pad=False, reverse=reverse, label=f"scan[{scan.name}]",
+        ):
             if carry is None:
-                out_slab, carry, had = init_fn(slab, ccodes)
+                out_slab, carry, had = init_fn(sl.data, sl.codes)
             else:
-                out_slab, carry, had = step_fn(slab, ccodes, carry, had)
+                out_slab, carry, had = step_fn(sl.data, sl.codes, carry, had)
             result_arr = _emit_scan_slab(
-                out_slab, ccodes_np, s, e, nat=nat, datetime_dtype=datetime_dtype,
-                has_missing=has_missing, out=out, result_arr=result_arr,
-                lead_shape=lead_shape, n=n,
+                out_slab, sl.codes_host, sl.start, sl.stop, nat=nat,
+                datetime_dtype=datetime_dtype, has_missing=has_missing, out=out,
+                result_arr=result_arr, lead_shape=lead_shape, n=n,
             )
     if out is not None:
         return None
@@ -976,7 +1008,6 @@ def _run_mesh_stream_scan(scan, loader, codes, *, size, n, batch_len, lead_shape
     cross-slab carry I/O (parallel.scan.build_stream_scan_step)."""
     import math
 
-    import jax
     import jax.numpy as jnp
 
     from .profiling import timed
@@ -1003,29 +1034,27 @@ def _run_mesh_stream_scan(scan, loader, codes, *, size, n, batch_len, lead_shape
     c0 = jnp.zeros(lead_shape + (size,), work_dtype)
     c1 = jnp.zeros(lead_shape + (size,), jnp.int8)  # had-NaT / has-value
 
+    if dtype is not None:
+        # fold the promotion cast into the (possibly prefetched) staging
+        base_loader = loader
+        loader = lambda s, e: np.asarray(base_loader(s, e)).astype(work_dtype, copy=False)
+
+    from .pipeline import stream_slabs
+
     result_arr = None
-    order = range(nbatches) if not reverse else range(nbatches - 1, -1, -1)
     with timed(f"stream-scan-mesh [{scan.name}] {nbatches} slab(s)"):
-        for i in order:
-            s, e = i * batch_len, min((i + 1) * batch_len, n)
-            slab = np.asarray(loader(s, e))
-            if dtype is not None and slab.dtype != work_dtype:
-                slab = slab.astype(work_dtype)
-            ccodes = codes[s:e]
-            pad = batch_len - (e - s)
-            if pad:
-                slab = np.concatenate(
-                    [slab, np.zeros(lead_shape + (pad,), slab.dtype)], axis=-1
-                )
-                ccodes = np.concatenate([ccodes, np.full(pad, -1, dtype=ccodes.dtype)])
-            slab_dev = jax.device_put(slab, slab_shard)
-            ccodes_np = np.ascontiguousarray(ccodes)
-            codes_dev = jax.device_put(ccodes_np.astype(np.int32), codes_shard)
-            out_sh, c0, c1 = step(slab_dev, codes_dev, c0, c1)
+        # the emit's host conversion syncs per slab (no throttle needed);
+        # prefetch overlaps the next slab's load+scatter with it
+        for sl in stream_slabs(
+            loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
+            slab_shard=slab_shard, codes_shard=codes_shard, reverse=reverse,
+            label=f"scan-mesh[{scan.name}]",
+        ):
+            out_sh, c0, c1 = step(sl.data, sl.codes, c0, c1)
             result_arr = _emit_scan_slab(
-                out_sh, ccodes_np, s, e, nat=nat, datetime_dtype=datetime_dtype,
-                has_missing=has_missing, out=out, result_arr=result_arr,
-                lead_shape=lead_shape, n=n,
+                out_sh, sl.codes_host, sl.start, sl.stop, nat=nat,
+                datetime_dtype=datetime_dtype, has_missing=has_missing, out=out,
+                result_arr=result_arr, lead_shape=lead_shape, n=n,
             )
     if out is not None:
         return None
@@ -1095,24 +1124,16 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
         )
     nbatches = math.ceil(n / batch_len)
 
-    def slabs():
-        for i in range(nbatches):
-            s, e = i * batch_len, min((i + 1) * batch_len, n)
-            slab = np.asarray(loader(s, e))
-            ccodes = codes[s:e]
-            pad = batch_len - (e - s)
-            if pad:
-                slab = np.concatenate(
-                    [slab, np.zeros(lead_shape + (pad,), slab.dtype)], axis=-1
-                )
-                ccodes = np.concatenate([ccodes, np.full(pad, -1, dtype=ccodes.dtype)])
-            if mesh is not None:
-                yield (
-                    jax.device_put(slab, slab_shard),
-                    jax.device_put(np.ascontiguousarray(ccodes), codes_shard),
-                )
-            else:
-                yield jnp.asarray(slab), jnp.asarray(ccodes)
+    from .pipeline import DispatchThrottle, stream_slabs
+
+    def slabs(pass_label):
+        # each counting pass is one full pipelined sweep over the loader:
+        # prefetch restarts per pass (the loader contract is random-access)
+        return stream_slabs(
+            loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
+            slab_shard=slab_shard, codes_shard=codes_shard,
+            label=f"quantile[{agg.name}] {pass_label}",
+        )
 
     # resolved float dtype: same rule as the eager kernel (probe_dtype comes
     # from the caller's one probe — no second remote chunk read). MUST be
@@ -1164,27 +1185,37 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
                 add = jax.lax.psum(add, axes)
             return cnt + add
 
-        if axes is None:
-            return jax.jit(count_pass), jax.jit(bit_pass), jax.jit(_radix_update)
+        # pass accumulators are donated (pipeline.maybe_donate): nn/hasnan
+        # and the per-bit cnt update in place across slabs, and the
+        # bisection state updates in place across bits. prefix/rank are NOT
+        # donated into bit_pass — prefix is re-read for every slab of a pass
+        from .pipeline import maybe_donate
 
-        # mesh: slab/codes sharded in (the SAME sspec/cspec the device_put
-        # above uses); bisection state replicated in AND out
+        if axes is None:
+            return (
+                maybe_donate(count_pass, donate_argnums=(0, 1)),
+                maybe_donate(bit_pass, donate_argnums=(0,)),
+                maybe_donate(_radix_update, donate_argnums=(0, 1)),
+            )
+
+        # mesh: slab/codes sharded in (the SAME sspec/cspec the staging
+        # pipeline uses); bisection state replicated in AND out
         from jax.sharding import PartitionSpec as P
 
         from .parallel.mesh import shard_map
 
         return (
-            jax.jit(shard_map(
+            maybe_donate(shard_map(
                 count_pass, mesh=mesh,
                 in_specs=(P(), P(), sspec, cspec), out_specs=P(),
                 check_vma=False,
-            )),
-            jax.jit(shard_map(
+            ), donate_argnums=(0, 1)),
+            maybe_donate(shard_map(
                 bit_pass, mesh=mesh,
                 in_specs=(P(), P(), sspec, cspec, P()), out_specs=P(),
                 check_vma=False,
-            )),
-            jax.jit(_radix_update),
+            ), donate_argnums=(0,)),
+            maybe_donate(_radix_update, donate_argnums=(0, 1)),
         )
 
     count_pass, bit_pass, update = _step_cached(
@@ -1194,13 +1225,15 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
     )
 
     trail = lead_shape  # leading layout puts the reduce axis first
+    throttle = DispatchThrottle()
     with timed(f"stream-quantile [{agg.name}] {nbits + 1} passes x {nbatches} slab(s)"):
         # counts accumulate EXACTLY in int32 (f32 would drift past 2^24 and
         # shift rank positions — the bit-identity claim rests on this)
         nn = jnp.zeros((size,) + trail, jnp.int32)
         hasnan = jnp.zeros((size,) + trail, jnp.int8)
-        for slab, ccodes in slabs():
-            nn, hasnan = count_pass(nn, hasnan, slab, ccodes)
+        for sl in slabs("count"):
+            nn, hasnan = count_pass(nn, hasnan, sl.data, sl.codes)
+            throttle.tick(nn)
 
         idx_dtype = jnp.float64 if utils.x64_enabled() else jnp.float32
         nnf = nn.astype(idx_dtype)
@@ -1211,8 +1244,9 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
         for i in range(nbits):
             bshift = jnp.asarray(nbits - 1 - i, ut)
             cnt = jnp.zeros((m, size) + trail, jnp.int32)
-            for slab, ccodes in slabs():
-                cnt = bit_pass(cnt, prefix, slab, ccodes, bshift)
+            for sl in slabs(f"bit {i}"):
+                cnt = bit_pass(cnt, prefix, sl.data, sl.codes, bshift)
+                throttle.tick(cnt)
             prefix, rank = update(prefix, rank, cnt, bshift)
 
     selected = _uint_to_value(prefix, fdtype)

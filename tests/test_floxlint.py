@@ -99,6 +99,26 @@ def test_bare_shard_map_reintroduction_fails(tmp_path):
     assert any(f.rule == "FLX004" for f in lint_file(bad))
 
 
+def test_streaming_step_closure_host_sync_fails(tmp_path):
+    # the donation-debugging hazard (ISSUE 2): a host-sync on a traced
+    # value inside a streaming step closure — built by a factory, handed
+    # to jax.jit with a donated carry — must keep firing FLX001
+    bad = tmp_path / "regress_stream_step.py"
+    bad.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
+        "def build_step(size):\n"
+        "    def step(state, slab, codes):\n"
+        "        if bool(jnp.any(jnp.isnan(slab))):\n"
+        "            return state\n"
+        "        return state + jnp.sum(slab)\n"
+        "    return jax.jit(step, donate_argnums=(0,))\n"
+    )
+    rc = floxlint_main([str(bad)])
+    assert rc == 1
+    assert any(f.rule == "FLX001" for f in lint_file(bad))
+
+
 def test_bf16_combine_accumulator_reintroduction_fails(tmp_path):
     bad = tmp_path / "regress_bf16.py"
     bad.write_text(

@@ -1,0 +1,234 @@
+"""Unit tests for the streaming pipeline machinery (flox_tpu/pipeline.py)
+and the cache registry contract (flox_tpu/cache.py).
+
+The streaming-level guarantees (prefetch on/off bit-identity per entry
+point, error propagation through real streams) live in test_streaming.py;
+this file pins the building blocks: in-order bounded prefetch, teardown,
+donation probing, and that ``clear_all`` really empties every module-level
+cache it names.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import inspect
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flox_tpu.pipeline import DispatchThrottle, _SlabPrefetcher, stream_slabs
+
+
+class TestSlabPrefetcher:
+    def test_delivers_in_order_under_concurrency(self):
+        import random
+
+        rng = random.Random(0)
+        delays = [rng.uniform(0, 0.01) for _ in range(40)]
+
+        def stage(i):
+            time.sleep(delays[i])
+            return i
+
+        assert list(_SlabPrefetcher(stage, range(40), depth=4)) == list(range(40))
+
+    def test_bounded_in_flight(self):
+        in_flight = []
+        peak = [0]
+        lock = threading.Lock()
+
+        def stage(i):
+            with lock:
+                in_flight.append(i)
+                peak[0] = max(peak[0], len(in_flight))
+            time.sleep(0.005)
+            with lock:
+                in_flight.remove(i)
+            return i
+
+        consumed = []
+        for item in _SlabPrefetcher(stage, range(20), depth=3):
+            consumed.append(item)
+            time.sleep(0.002)
+        assert consumed == list(range(20))
+        # depth staging threads + nothing runaway
+        assert peak[0] <= 3
+
+    def test_error_surfaces_at_position_and_tears_down(self):
+        def stage(i):
+            if i == 3:
+                raise ValueError("bad slab 3")
+            return i
+
+        pf = _SlabPrefetcher(stage, range(10), depth=2)
+        got = []
+        with pytest.raises(ValueError, match="bad slab 3"):
+            for item in pf:
+                got.append(item)
+        assert got == [0, 1, 2]
+        assert pf._pool is None  # shut down, nothing left staging
+
+    def test_close_midstream_leaves_no_threads(self):
+        def stage(i):
+            time.sleep(0.005)
+            return i
+
+        pf = _SlabPrefetcher(stage, range(100), depth=4)
+        assert next(pf) == 0
+        pf.close()
+        time.sleep(0.1)
+        assert not [t for t in threading.enumerate() if "flox-tpu-stage" in t.name]
+
+
+class TestStreamSlabs:
+    @staticmethod
+    def _materialize(it):
+        # snapshot per-slab state DURING iteration: stream_slabs drops the
+        # device references once the consumer moves on (no HBM pinning)
+        return [
+            (s.start, s.stop, np.asarray(s.data), np.asarray(s.codes),
+             s.codes_host, None if s.offset is None else int(s.offset))
+            for s in it
+        ]
+
+    def test_pad_and_tail(self):
+        codes = np.arange(10, dtype=np.int32)
+        data = np.arange(10.0)
+        slabs = self._materialize(stream_slabs(
+            lambda s, e: data[s:e], codes, n=10, batch_len=4, lead_shape=(),
+            prefetch=0, with_offset=True,
+        ))
+        assert [(s[0], s[1]) for s in slabs] == [(0, 4), (4, 8), (8, 10)]
+        # padded tail: data zero-filled, codes -1-filled, device shape constant
+        assert all(s[2].shape == (4,) for s in slabs)
+        assert slabs[-1][2].tolist() == [8.0, 9.0, 0.0, 0.0]
+        assert slabs[-1][3].tolist() == [8, 9, -1, -1]
+        # codes_host stays the unpadded view
+        assert slabs[-1][4].tolist() == [8, 9]
+        assert slabs[-1][5] == 8
+
+    def test_no_pad_ragged_tail_and_reverse(self):
+        codes = np.arange(10, dtype=np.int32)
+        data = np.arange(10.0)
+        slabs = self._materialize(stream_slabs(
+            lambda s, e: data[s:e], codes, n=10, batch_len=4, lead_shape=(),
+            prefetch=2, pad=False, reverse=True,
+        ))
+        assert [(s[0], s[1]) for s in slabs] == [(8, 10), (4, 8), (0, 4)]
+        assert slabs[0][2].shape == (2,)  # ragged tail, streamed first
+
+    def test_prefetched_matches_sync_bytes(self):
+        rng = np.random.default_rng(0)
+        data = rng.normal(size=(3, 100))
+        codes = rng.integers(0, 5, 100).astype(np.int32)
+
+        def collect(depth):
+            return [
+                (np.asarray(s.data).tobytes(), np.asarray(s.codes).tobytes())
+                for s in stream_slabs(
+                    lambda st, e: data[:, st:e], codes, n=100, batch_len=33,
+                    lead_shape=(3,), prefetch=depth,
+                )
+            ]
+
+        assert collect(0) == collect(3)
+
+
+def test_dispatch_throttle_reads_option_and_syncs():
+    import flox_tpu
+
+    with flox_tpu.set_options(stream_dispatch_depth=3):
+        th = DispatchThrottle()
+    assert th.depth == 3
+    import jax.numpy as jnp
+
+    x = jnp.ones(4)
+    for _ in range(7):
+        th.tick(x)  # must not raise; 0/None carries are ignored
+    DispatchThrottle(depth=0).tick(x)
+    DispatchThrottle(depth=2).tick(None)
+
+
+def test_donation_probe_memoized_and_cleared():
+    import flox_tpu.cache
+    from flox_tpu import pipeline
+
+    flox_tpu.cache.clear_all()
+    assert pipeline._DONATION_OK == {}
+    pipeline.donation_supported()
+    assert len(pipeline._DONATION_OK) == 1  # probed once, memoized
+    flox_tpu.cache.clear_all()
+    assert pipeline._DONATION_OK == {}
+    # forced modes bypass the probe
+    import flox_tpu as ft
+
+    with ft.set_options(stream_donate="off"):
+        assert pipeline.donation_supported() is False
+    with ft.set_options(stream_donate="on"):
+        assert pipeline.donation_supported() is True
+
+
+def test_stream_option_validation():
+    import flox_tpu
+
+    with pytest.raises(ValueError):
+        flox_tpu.set_options(stream_prefetch=-1)
+    with pytest.raises(ValueError):
+        flox_tpu.set_options(stream_dispatch_depth=-2)
+    with pytest.raises(ValueError):
+        flox_tpu.set_options(stream_donate="maybe")
+    with flox_tpu.set_options(stream_prefetch=0, stream_dispatch_depth=0,
+                              stream_donate="off"):
+        pass
+
+
+def test_clear_all_empties_every_named_cache():
+    """Regression (ISSUE 2 satellite): ``clear_all`` must empty every
+    module-level cache it names — introspected from its own source, so a
+    new cache import without the matching ``.clear()`` fails here."""
+    import flox_tpu.cache as cache
+
+    src = textwrap.dedent(inspect.getsource(cache.clear_all))
+    tree = ast.parse(src)
+    named = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = importlib.import_module(
+                ("." * node.level) + (node.module or ""), package="flox_tpu"
+            )
+            for alias in node.names:
+                named.append((mod, alias.asname or alias.name))
+    assert len(named) >= 7, "clear_all no longer names the known caches?"
+
+    # populate what can be populated artificially, then clear
+    for mod, name in named:
+        obj = getattr(mod, name)
+        if isinstance(obj, dict):
+            obj[("__clear_all_probe__", name)] = object()
+        elif isinstance(obj, list):
+            for i in range(len(obj)):
+                obj[i] = 1234
+    cache.clear_all()
+
+    checked = 0
+    for mod, name in named:
+        obj = getattr(mod, name)
+        if isinstance(obj, dict):
+            assert obj == {}, f"{mod.__name__}.{name} not emptied by clear_all"
+            checked += 1
+        elif isinstance(obj, list):
+            assert all(v == 0 for v in obj), f"{mod.__name__}.{name} not reset"
+            checked += 1
+        elif hasattr(obj, "cache_info"):  # functools.lru_cache wrapper
+            assert obj.cache_info().currsize == 0, f"{mod.__name__}.{name} not cleared"
+            checked += 1
+        else:
+            raise AssertionError(
+                f"clear_all names {mod.__name__}.{name} of type {type(obj)!r} "
+                "— teach this test how to verify it empties"
+            )
+    assert checked == len(named)

@@ -47,3 +47,18 @@ def metadata_access_is_fine(x):
     # shape/dtype reads are static under trace — no finding
     scale = 1.0 / x.shape[-1]
     return jnp.sum(x) * scale
+
+
+def _build_streaming_step(size):
+    # the streaming-executor shape: a step closure built by a factory and
+    # handed to jax.jit with a donated carry. Debugging donation ("is the
+    # accumulator still alive?") tends to introduce exactly these
+    # host-syncs INSIDE the traced closure — a device->host pull per slab.
+    def step(state, slab, codes):
+        total = jnp.sum(slab)
+        if float(total) == 0.0:  # expect: FLX001
+            state = jnp.zeros((size,), slab.dtype)
+        snapshot = np.asarray(state)  # expect: FLX001
+        return state + total, snapshot
+
+    return jax.jit(step, donate_argnums=(0,))
