@@ -26,6 +26,7 @@ def clear_all() -> None:
     from .parallel.mapreduce import _PROGRAM_CACHE
     from .parallel.scan import _SCAN_CACHE
     from .pipeline import _DONATION_OK
+    from .resilience import _SNAPSHOTS
     from .streaming import _STEP_CACHE
 
     _COHORTS_CACHE.clear()
@@ -35,4 +36,5 @@ def clear_all() -> None:
     _SCAN_CACHE.clear()
     _STEP_CACHE.clear()
     _DONATION_OK.clear()
+    _SNAPSHOTS.clear()
     _jitted_bundle.cache_clear()

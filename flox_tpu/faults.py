@@ -1,0 +1,208 @@
+"""Deterministic fault injection for the streaming executor (test substrate).
+
+Resilience claims are only as good as the faults they were tested against,
+and real faults (S3 throttling, HBM exhaustion, preemption) are neither
+deterministic nor available on CPU CI. This module injects them exactly
+where the resilience layer must handle them:
+
+* :class:`FlakyLoader` wraps a loader callable and raises a chosen
+  exception for chosen slab start offsets a fixed number of times before
+  recovering — the substrate for the retry/backoff tests (transient
+  ``IOError`` retried; fatal ``ValueError`` surfaced immediately; a fault
+  repeated past ``stream_retries`` surfacing the original).
+* :func:`inject` installs a dispatch-side fault plan consulted by
+  ``resilience.dispatch_slab`` immediately before each slab step runs
+  (:func:`poke`): :class:`SimulatedOOM` at chosen slab starts (exercises
+  the halve-and-re-stage ladder, recursively when ``times > 1``), and
+  :class:`StreamKilled` at a chosen slab start or after a chosen number of
+  dispatches (simulated host preemption — exercises checkpoint/resume).
+
+Everything is index-deterministic: the same plan against the same stream
+fires at the same slabs in the same order, prefetch on or off. The plan
+hook costs one ``is None`` check per slab when no plan is installed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+__all__ = [
+    "SimulatedOOM",
+    "StreamKilled",
+    "FlakyLoader",
+    "inject",
+    "poke",
+    "active",
+    "misshaping_loader",
+]
+
+
+class SimulatedOOM(RuntimeError):
+    """Stands in for jaxlib's ``XlaRuntimeError: RESOURCE_EXHAUSTED``: the
+    message carries the status token, so ``resilience.classify_error``
+    routes it down the same slab-splitting path as the real thing."""
+
+    def __init__(self, where: str = "") -> None:
+        super().__init__(f"RESOURCE_EXHAUSTED (simulated): out of memory {where}".rstrip())
+
+
+class StreamKilled(RuntimeError):
+    """Simulated host preemption: classified fatal (never retried, never
+    split), so the stream dies exactly as a killed process would — leaving
+    only the checkpoint behind."""
+
+    def __init__(self, where: str = "") -> None:
+        super().__init__(f"stream killed (simulated preemption) {where}".rstrip())
+
+
+@dataclass
+class _Fault:
+    exc: type[BaseException]
+    times: int  # remaining firings; -1 = always
+
+
+@dataclass
+class _Plan:
+    """One installed dispatch-fault plan, with an injection log for
+    asserting determinism."""
+
+    at_start: dict[int, _Fault] = field(default_factory=dict)
+    kill_after: int | None = None
+    pokes: int = 0
+    #: (exc name | None, start, stop) per dispatch, in dispatch order
+    log: list = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+
+_PLAN: _Plan | None = None
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def poke(start: int, stop: int) -> None:
+    """Dispatch-side injection hook: ``resilience.dispatch_slab`` calls this
+    immediately before running (or re-running, for split sub-slabs) a slab
+    step. No-op unless a plan is installed via :func:`inject`."""
+    plan = _PLAN
+    if plan is None:
+        return
+    with plan._lock:
+        plan.pokes += 1
+        if plan.kill_after is not None and plan.pokes > plan.kill_after:
+            plan.log.append(("StreamKilled", start, stop))
+            raise StreamKilled(f"at dispatch #{plan.pokes}, slab [{start}:{stop})")
+        fault = plan.at_start.get(start)
+        if fault is not None and fault.times != 0:
+            if fault.times > 0:
+                fault.times -= 1
+            plan.log.append((fault.exc.__name__, start, stop))
+            raise fault.exc(f"at slab [{start}:{stop})")
+        plan.log.append((None, start, stop))
+
+
+@contextlib.contextmanager
+def inject(
+    *,
+    oom_at: tuple[int, ...] | list[int] = (),
+    oom_times: int = 1,
+    kill_at: tuple[int, ...] | list[int] = (),
+    kill_after: int | None = None,
+) -> Iterator[_Plan]:
+    """Install a deterministic dispatch-side fault plan for the scope.
+
+    ``oom_at``: slab START offsets (elements, not indices) whose dispatch
+    raises :class:`SimulatedOOM`, each ``oom_times`` times — ``times > 1``
+    re-fires on the first re-staged sub-slab (same start offset), driving
+    the splitter one rung deeper per firing. ``kill_at``: starts whose
+    dispatch raises :class:`StreamKilled` once. ``kill_after``: kill at
+    dispatch number ``kill_after + 1`` regardless of position (the way to
+    land inside a chosen quantile pass). Yields the plan; its ``log``
+    records every dispatch for determinism assertions.
+    """
+    global _PLAN
+    plan = _Plan(kill_after=kill_after)
+    for s in oom_at:
+        plan.at_start[int(s)] = _Fault(SimulatedOOM, oom_times)
+    for s in kill_at:
+        plan.at_start[int(s)] = _Fault(StreamKilled, 1)
+    prev = _PLAN
+    _PLAN = plan
+    try:
+        yield plan
+    finally:
+        _PLAN = prev
+
+
+class FlakyLoader:
+    """Wrap a loader so chosen slabs fail a fixed number of times.
+
+    ``faults`` maps slab START offsets (the ``start`` argument the stream
+    passes the loader) to the exception to raise — an exception type
+    (instantiated with a descriptive message), an instance (raised as-is),
+    or a zero-arg factory. Each entry fires ``times`` times, then the
+    loader recovers and serves the real bytes — the shape of a transient
+    IO fault. Thread-safe (the prefetch pool loads concurrently);
+    ``calls`` and ``injected`` record every access in call order.
+
+    >>> flaky = FlakyLoader(loader, {2048: IOError}, times=2)  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        loader: Callable[[int, int], Any],
+        faults: dict[int, Any],
+        *,
+        times: int = 1,
+    ) -> None:
+        self._loader = loader
+        self._faults = {int(s): [spec, times] for s, spec in faults.items()}
+        self._lock = threading.Lock()
+        self.calls: list[tuple[int, int]] = []
+        self.injected: list[tuple[int, int, str]] = []
+
+    def _build(self, spec: Any, s: int, e: int) -> BaseException:
+        if isinstance(spec, BaseException):
+            return spec
+        if isinstance(spec, type) and issubclass(spec, BaseException):
+            return spec(f"injected loader fault at slab [{s}:{e})")
+        return spec()
+
+    def __call__(self, s: int, e: int) -> Any:
+        with self._lock:
+            self.calls.append((s, e))
+            entry = self._faults.get(s)
+            if entry is not None and entry[1] != 0:
+                if entry[1] > 0:
+                    entry[1] -= 1
+                exc = self._build(entry[0], s, e)
+                self.injected.append((s, e, type(exc).__name__))
+                raise exc
+        return self._loader(s, e)
+
+    def loads_of(self, start: int) -> int:
+        """How many times the underlying slab at ``start`` was actually
+        requested (fault firings included)."""
+        return sum(1 for (s, _e) in self.calls if s == start)
+
+
+def misshaping_loader(
+    loader: Callable[[int, int], Any], at: int, shape: tuple
+) -> Callable[[int, int], Any]:
+    """A loader that returns a wrong-shaped array for the slab starting at
+    ``at`` — the substrate for the loader-contract check (a clear
+    ``ValueError`` naming the slab range, not a cryptic XLA shape error)."""
+
+    def bad(s: int, e: int) -> Any:
+        out = np.asarray(loader(s, e))
+        if s == at:
+            return np.zeros(shape, out.dtype)
+        return out
+
+    return bad

@@ -24,6 +24,23 @@ def _env_int(name: str, default: int, lo: int = 0, hi: int | None = None) -> int
     return value
 
 
+def _env_float(name: str, default: float, lo: float = 0.0) -> float:
+    """Float analogue of :func:`_env_int` (retry backoff / deadline knobs);
+    same fall-back-not-crash contract for malformed env values. Non-finite
+    values fall back too: ``nan`` would reach ``time.sleep`` mid-retry and
+    ``inf`` would sleep forever — the validators reject both, and the env
+    must not be able to seed what ``set_options`` refuses."""
+    import math
+
+    try:
+        value = float(os.environ.get(name, default))
+    except ValueError:
+        return default
+    if not math.isfinite(value) or value < lo:
+        return default
+    return value
+
+
 OPTIONS: dict[str, Any] = {
     # Resharding-for-blockwise is applied automatically only when the change
     # it would make is small (same spirit as options.py:9-18).
@@ -103,6 +120,27 @@ OPTIONS: dict[str, Any] = {
     # that cannot alias donated buffers fall back to undonated steps),
     # "on"/"off" force it
     "stream_donate": "auto",
+    # Streaming resilience (flox_tpu/resilience.py): how many times a slab's
+    # load+stage is retried after a TRANSIENT failure (IO/RPC hiccups per
+    # resilience.classify_error; programming errors never retry) before the
+    # original exception surfaces. retries + 1 total attempts per slab.
+    "stream_retries": _env_int("FLOX_TPU_STREAM_RETRIES", 2, 0, 1000),
+    # base backoff sleep in seconds between retry attempts, doubled per
+    # attempt (backoff * 2**attempt)
+    "stream_backoff": _env_float("FLOX_TPU_STREAM_BACKOFF", 0.05),
+    # per-slab deadline in seconds across all staging attempts + backoffs of
+    # one slab; a retry that would sleep past it raises TimeoutError instead.
+    # 0 disables the deadline.
+    "stream_slab_timeout": _env_float("FLOX_TPU_STREAM_SLAB_TIMEOUT", 0.0),
+    # device_get the streaming carry to a host-side snapshot every K
+    # processed slabs, so a killed run resumes bit-identically from the last
+    # snapshot instead of restarting an hours-long stream. 0 disables
+    # checkpointing (and its per-stream key fingerprinting) entirely.
+    "stream_checkpoint_every": _env_int("FLOX_TPU_STREAM_CHECKPOINT_EVERY", 0, 0),
+    # optional spill target for snapshots: a directory (one .npz per stream
+    # identity) or a literal .npz path — the cross-process resume path. None
+    # keeps snapshots in the in-process registry only.
+    "stream_checkpoint_path": os.environ.get("FLOX_TPU_STREAM_CHECKPOINT_PATH") or None,
 }
 
 # single source of truth for the accumulation disciplines — referenced by
@@ -124,10 +162,34 @@ _VALIDATORS = {
     "pallas_scan_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
     "dense_intermediate_bytes_max": lambda x: isinstance(x, int) and x >= 2**20,
     "quantile_impl": lambda x: x in ("auto", "sort", "select"),
-    "stream_prefetch": lambda x: isinstance(x, int) and 0 <= x <= 64,
-    "stream_dispatch_depth": lambda x: isinstance(x, int) and x >= 0,
+    # streaming knobs are validated AT SET TIME: a negative depth or retry
+    # count must raise here, not hang or crash slabs into an hours-long
+    # stream (bool is excluded — True/False sneaking in as 1/0 is a bug)
+    "stream_prefetch": lambda x: _is_int(x) and 0 <= x <= 64,
+    "stream_dispatch_depth": lambda x: _is_int(x) and x >= 0,
     "stream_donate": lambda x: x in ("auto", "on", "off"),
+    "stream_retries": lambda x: _is_int(x) and 0 <= x <= 1000,
+    "stream_backoff": lambda x: _is_finite_num(x) and x >= 0,
+    "stream_slab_timeout": lambda x: _is_finite_num(x) and x >= 0,
+    "stream_checkpoint_every": lambda x: _is_int(x) and x >= 0,
+    "stream_checkpoint_path": lambda x: x is None or (
+        isinstance(x, (str, os.PathLike)) and bool(str(x))
+    ),
 }
+
+
+def _is_int(x: Any) -> bool:
+    return isinstance(x, int) and not isinstance(x, bool)
+
+
+def _is_num(x: Any) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def _is_finite_num(x: Any) -> bool:
+    import math
+
+    return _is_num(x) and math.isfinite(x)
 
 
 def trace_fingerprint() -> tuple:

@@ -46,6 +46,7 @@ critical path.
 from __future__ import annotations
 
 import math
+import threading
 from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -54,7 +55,14 @@ from typing import Any, Callable, Iterator
 
 import numpy as np
 
-__all__ = ["Slab", "stream_slabs", "maybe_donate", "donation_supported", "DispatchThrottle"]
+__all__ = [
+    "Slab",
+    "SlabStager",
+    "stream_slabs",
+    "maybe_donate",
+    "donation_supported",
+    "DispatchThrottle",
+]
 
 # backend name -> whether buffer donation actually works there (probed once;
 # a set_options(stream_donate=...) override bypasses it). Registered in
@@ -79,6 +87,119 @@ class Slab:
     dispatch_ms: float = 0.0
 
 
+class SlabStager:
+    """The ONE staging implementation: load an arbitrary ``[s, e)`` range,
+    check the loader contract, pad, and ``device_put`` against the stream's
+    shardings — with transient failures retried under the stream's
+    ``RetryPolicy`` (``stream_retries`` / ``stream_backoff`` /
+    ``stream_slab_timeout``, frozen at stager construction).
+
+    :func:`stream_slabs` stages its batches through this, and
+    ``resilience.dispatch_slab`` re-stages OOM-split sub-slabs through the
+    SAME object — so split staging cannot drift from stream staging.
+    Retries run inside whatever thread stages the slab (the prefetch pool's
+    workers), so a flaky slab never poisons the other queued slabs; a fatal
+    classification, retry exhaustion, or a blown per-slab deadline raises.
+    """
+
+    def __init__(
+        self,
+        loader: Callable[[int, int], Any],
+        codes: np.ndarray,
+        *,
+        n: int,
+        batch_len: int,
+        lead_shape: tuple,
+        pad: bool = True,
+        slab_shard: Any = None,
+        codes_shard: Any = None,
+        with_offset: bool = False,
+        counters: Any = None,
+    ) -> None:
+        from .resilience import RetryPolicy
+
+        self.loader = loader
+        self.codes = codes
+        self.n = n
+        self.batch_len = batch_len
+        self.lead = tuple(lead_shape)
+        self.pad = pad
+        self.slab_shard = slab_shard
+        self.codes_shard = codes_shard
+        self.with_offset = with_offset
+        self.counters = counters
+        self.policy = RetryPolicy.from_options()
+        self._dtype0: Any = None
+        self._lock = threading.Lock()
+
+    def stage_index(self, i: int) -> Slab:
+        s, e = i * self.batch_len, min((i + 1) * self.batch_len, self.n)
+        return self.stage_range(
+            s, e, pad_to=self.batch_len if self.pad else None, index=i
+        )
+
+    def stage_range(self, s: int, e: int, pad_to: int | None = None, index: int = -1) -> Slab:
+        from .resilience import call_with_retry
+
+        return call_with_retry(
+            lambda: self._stage_once(s, e, pad_to, index),
+            policy=self.policy, counters=self.counters, what=f"[{s}:{e})",
+        )
+
+    def _stage_once(self, s: int, e: int, pad_to: int | None, index: int) -> Slab:
+        import jax
+        import jax.numpy as jnp
+
+        t0 = perf_counter()
+        slab = np.asarray(self.loader(s, e))
+        self._check_contract(slab, s, e)
+        chost = self.codes[s:e]
+        t1 = perf_counter()
+        padn = (pad_to - (e - s)) if pad_to else 0
+        if padn:
+            slab = np.concatenate(
+                [slab, np.zeros(self.lead + (padn,), slab.dtype)], axis=-1
+            )
+            cfull = np.concatenate([chost, np.full(padn, -1, dtype=chost.dtype)])
+        else:
+            cfull = chost
+        if self.slab_shard is not None:
+            # one host->N-device scatter per slab: each chip receives and
+            # reduces its contiguous 1/ndev of the slab
+            data = jax.device_put(slab, self.slab_shard)
+            cdev = jax.device_put(cfull, self.codes_shard)
+        else:
+            data, cdev = jnp.asarray(slab), jnp.asarray(cfull)
+        offset = jnp.asarray(np.int64(s)) if self.with_offset else None
+        t2 = perf_counter()
+        return Slab(
+            index=index, start=s, stop=e, data=data, codes=cdev, codes_host=chost,
+            offset=offset, load_ms=(t1 - t0) * 1e3, stage_ms=(t2 - t1) * 1e3,
+        )
+
+    def _check_contract(self, slab: np.ndarray, s: int, e: int) -> None:
+        """Loader-contract check: a drifting shape or dtype raises a clear
+        ValueError naming the slab range HERE, instead of a cryptic XLA
+        shape error (or a silent retrace) deep inside the jitted step.
+        ValueError is classified fatal, so a contract break never burns
+        retries."""
+        want = self.lead + (e - s,)
+        if tuple(slab.shape) != want:
+            raise ValueError(
+                f"loader contract violation for slab [{s}:{e}): returned shape "
+                f"{tuple(slab.shape)}, expected {want} (lead dims {self.lead} "
+                "+ the requested span)"
+            )
+        with self._lock:
+            if self._dtype0 is None:
+                self._dtype0 = slab.dtype
+            elif slab.dtype != self._dtype0:
+                raise ValueError(
+                    f"loader contract violation for slab [{s}:{e}): dtype "
+                    f"{slab.dtype} != {self._dtype0} from the first loaded slab"
+                )
+
+
 def stream_slabs(
     loader: Callable[[int, int], Any],
     codes: np.ndarray,
@@ -93,6 +214,9 @@ def stream_slabs(
     with_offset: bool = False,
     prefetch: int | None = None,
     label: str = "",
+    skip: int = 0,
+    counters: Any = None,
+    stager: SlabStager | None = None,
 ) -> Iterator[Slab]:
     """Yield staged :class:`Slab` objects for every batch of ``[0, n)``.
 
@@ -104,48 +228,43 @@ def stream_slabs(
     contract); ``reverse`` streams the slabs back-to-front (bfill).
     ``prefetch=None`` reads ``OPTIONS["stream_prefetch"]``; ``0`` is the
     synchronous inline loop, byte-identical staging either way.
-    """
-    import jax
-    import jax.numpy as jnp
 
+    ``skip`` drops the first k slabs in STREAM order (checkpoint resume —
+    for a reversed stream that is the last k batches, exactly the ones a
+    resumed bfill already folded). ``counters`` is the run's
+    ``resilience.StreamCounters``, attached to the emitted ``StreamReport``
+    and fed by the staging retries. ``stager`` supplies a pre-built
+    :class:`SlabStager` (the entry points share it with the OOM splitter);
+    when given, its staging parameters win over the ones passed here.
+    """
     from .options import OPTIONS
     from .profiling import StreamReport, record_stream
 
     depth = OPTIONS["stream_prefetch"] if prefetch is None else prefetch
     nbatches = math.ceil(n / batch_len) if n else 0
-    order = range(nbatches - 1, -1, -1) if reverse else range(nbatches)
-    lead = tuple(lead_shape)
+    order_full = range(nbatches - 1, -1, -1) if reverse else range(nbatches)
+    order = order_full[skip:] if skip else order_full
 
-    def stage(i: int) -> Slab:
-        s, e = i * batch_len, min((i + 1) * batch_len, n)
-        t0 = perf_counter()
-        slab = np.asarray(loader(s, e))
-        chost = codes[s:e]
-        t1 = perf_counter()
-        padn = batch_len - (e - s)
-        if pad and padn:
-            slab = np.concatenate([slab, np.zeros(lead + (padn,), slab.dtype)], axis=-1)
-            cfull = np.concatenate([chost, np.full(padn, -1, dtype=chost.dtype)])
-        else:
-            cfull = chost
-        if slab_shard is not None:
-            # one host->N-device scatter per slab: each chip receives and
-            # reduces its contiguous 1/ndev of the slab
-            data = jax.device_put(slab, slab_shard)
-            cdev = jax.device_put(cfull, codes_shard)
-        else:
-            data, cdev = jnp.asarray(slab), jnp.asarray(cfull)
-        offset = jnp.asarray(np.int64(s)) if with_offset else None
-        t2 = perf_counter()
-        return Slab(
-            index=i, start=s, stop=e, data=data, codes=cdev, codes_host=chost,
-            offset=offset, load_ms=(t1 - t0) * 1e3, stage_ms=(t2 - t1) * 1e3,
+    if stager is not None and (n, batch_len, pad) != (stager.n, stager.batch_len, stager.pad):
+        # the stager's staging parameters are the ones that run; a caller
+        # whose explicit arguments drifted from them must hear about it
+        raise ValueError(
+            "stream_slabs staging parameters disagree with the supplied "
+            f"stager: (n, batch_len, pad) = {(n, batch_len, pad)} vs "
+            f"{(stager.n, stager.batch_len, stager.pad)}"
         )
+    if stager is None:
+        stager = SlabStager(
+            loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
+            pad=pad, slab_shard=slab_shard, codes_shard=codes_shard,
+            with_offset=with_offset, counters=counters,
+        )
+    stage = stager.stage_index
 
-    report = StreamReport(label=label, prefetch=depth, nbatches=nbatches)
+    report = StreamReport(label=label, prefetch=depth, nbatches=nbatches, counters=counters)
     source: Iterator[Slab]
     prefetcher = None
-    if depth > 0 and nbatches > 1:
+    if depth > 0 and len(order) > 1:
         prefetcher = _SlabPrefetcher(stage, order, depth)
         source = iter(prefetcher)
     else:

@@ -65,13 +65,20 @@ class StreamReport:
     (pad + device_put), ``wait_ms`` (time the consumer thread was blocked
     waiting for the slab — with prefetch off this IS load+stage, with
     prefetch on it is only the unhidden remainder) and ``dispatch_ms``
-    (consumer-side step dispatch, including any throttle sync)."""
+    (consumer-side step dispatch, including any throttle sync).
+
+    ``counters`` is the run's ``resilience.StreamCounters``: retry /
+    backoff-wait / OOM-split / checkpoint totals, plus the resume cursor
+    when the run restored from a snapshot. A multi-pass run (streaming
+    quantile) shares ONE counters object across its passes, so each pass's
+    report shows the cumulative values."""
 
     label: str = ""
     prefetch: int = 0
     nbatches: int = 0
     wall_ms: float = 0.0
     slabs: list = field(default_factory=list)
+    counters: Any = None
 
     @property
     def load_ms(self) -> float:
@@ -100,14 +107,43 @@ class StreamReport:
             return 0.0
         return min(1.0, max(0.0, 1.0 - self.wait_ms / staged))
 
+    @property
+    def retries(self) -> int:
+        return self.counters.retries if self.counters is not None else 0
+
+    @property
+    def backoff_ms(self) -> float:
+        return self.counters.backoff_ms if self.counters is not None else 0.0
+
+    @property
+    def oom_splits(self) -> int:
+        return self.counters.oom_splits if self.counters is not None else 0
+
+    @property
+    def checkpoints(self) -> int:
+        return self.counters.checkpoints if self.counters is not None else 0
+
+    @property
+    def resumed_at(self):
+        return self.counters.resumed_at if self.counters is not None else None
+
     def summary(self) -> str:
-        return (
+        line = (
             f"stream-pipeline [{self.label}] {len(self.slabs)}/{self.nbatches} "
             f"slab(s) prefetch={self.prefetch}: wall {self.wall_ms:.1f} ms, "
             f"load {self.load_ms:.1f} ms, stage {self.stage_ms:.1f} ms, "
             f"wait {self.wait_ms:.1f} ms, dispatch {self.dispatch_ms:.1f} ms, "
             f"overlap {self.overlap_fraction:.0%}"
         )
+        if self.retries:
+            line += f", retries {self.retries} (backoff {self.backoff_ms:.0f} ms)"
+        if self.oom_splits:
+            line += f", oom-splits {self.oom_splits}"
+        if self.checkpoints:
+            line += f", checkpoints {self.checkpoints}"
+        if self.resumed_at is not None:
+            line += f", resumed@{self.resumed_at}"
+        return line
 
 
 # active stream_monitor collectors (consumer-thread only: reports are

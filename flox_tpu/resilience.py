@@ -1,0 +1,542 @@
+"""Resilient streaming: error taxonomy, retry/backoff, OOM slab-splitting,
+and checkpoint/resume for the out-of-core executor.
+
+The reference gets fault tolerance for free from dask's scheduler — a lost
+worker's chunk reduction is simply re-executed (flox/dask.py tree-combine),
+the classic MapReduce re-execution model. The streaming executor
+(`streaming.py` + `pipeline.py`) has no scheduler to lean on: one transient
+loader ``IOError``, one ``RESOURCE_EXHAUSTED`` on a too-large slab, or one
+host preemption used to kill an hours-long reduction with nothing
+recoverable. This module is the streaming equivalent of re-execution,
+in three layers:
+
+* **Error taxonomy** (:func:`classify_error`): every failure is ``transient``
+  (IO hiccups — retried), ``oom`` (``XlaRuntimeError: RESOURCE_EXHAUSTED`` /
+  ``MemoryError`` — the slab is split), or ``fatal`` (programming errors —
+  surfaced immediately, never retried). The classifier is the single gate
+  every retry path must consult; floxlint FLX006 flags `except Exception:`
+  handlers in retry loops that bypass it.
+* **Retry with exponential backoff + per-slab deadline**
+  (:func:`call_with_retry`): wraps each slab's load+stage attempt
+  (`pipeline.SlabStager`). Retries happen INSIDE the staging worker, so a
+  flaky slab never poisons the other slabs queued in the prefetch pool;
+  when retries exhaust, the ORIGINAL exception surfaces (not a wrapper).
+* **Graceful OOM degradation** (:func:`dispatch_slab`): a slab step that
+  raises a resource-exhausted error is re-staged as sub-slabs of half the
+  span, padded to a power-of-two ladder — so each rung's step program is
+  compiled once and every later split reuses it, and the base (full
+  batch_len) step is never retraced.
+* **Checkpoint/resume** (:class:`StreamCheckpointer`): every
+  ``OPTIONS["stream_checkpoint_every"]`` processed slabs the carry state is
+  ``jax.device_get`` into a host-side :class:`Snapshot` (registry
+  ``_SNAPSHOTS``, cleared by ``cache.clear_all``), optionally spilled to an
+  ``.npz`` under ``OPTIONS["stream_checkpoint_path"]``. A killed run
+  re-invoked with the same arguments restores the snapshot and refolds only
+  the remaining slabs — bit-identical to the uninterrupted run, because the
+  device→host→device round-trip is exact and the remaining slabs fold in
+  the same order.
+
+Counters for all of the above (retries, backoff wall, splits, checkpoints)
+flow into :class:`StreamCounters`, attached to the
+``profiling.StreamReport`` each streaming pass emits.
+
+The deterministic fault-injection harness that exercises every path here
+lives in :mod:`flox_tpu.faults`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+__all__ = [
+    "TRANSIENT",
+    "OOM",
+    "FATAL",
+    "classify_error",
+    "register_transient",
+    "RetryPolicy",
+    "call_with_retry",
+    "StreamCounters",
+    "dispatch_slab",
+    "Snapshot",
+    "StreamCheckpointer",
+    "device_restore",
+]
+
+TRANSIENT = "transient"
+OOM = "oom"
+FATAL = "fatal"
+
+# exception types retried as transient: IO and RPC hiccups. OSError subsumes
+# IOError / TimeoutError / ConnectionError / BrokenPipeError — the loader-IO
+# family (zarr, S3, NFS readers raise these for the recoverable cases).
+# Programming errors (TypeError/ValueError/KeyError/...) are fatal by
+# exclusion and surface immediately.
+_TRANSIENT_TYPES: list[type] = [OSError]
+
+# OSError subclasses that signal a configuration error, not weather: a wrong
+# path or bad permissions can never succeed on retry, so burning the whole
+# backoff budget on them is the exact swallow-a-bug hazard FLX006 polices.
+# A store whose missing-key reads ARE transient (eventual consistency) can
+# opt back in with register_transient(FileNotFoundError).
+_NON_RECOVERABLE_OS: tuple[type, ...] = (
+    FileNotFoundError,
+    PermissionError,
+    IsADirectoryError,
+    NotADirectoryError,
+)
+
+# jaxlib surfaces runtime failures as XlaRuntimeError with a gRPC-style
+# status token; classify by name so no version-pinned import is needed
+_RUNTIME_ERROR_NAMES = ("XlaRuntimeError", "JaxRuntimeError")
+_OOM_TOKENS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+_TRANSIENT_TOKENS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED")
+
+
+def register_transient(exc_type: type) -> None:
+    """Teach the classifier a loader-SDK exception type to retry (e.g. a
+    cloud store's own ``ThrottlingError``). Process-global, additive."""
+    if not (isinstance(exc_type, type) and issubclass(exc_type, BaseException)):
+        raise TypeError(f"register_transient expects an exception type, got {exc_type!r}")
+    if exc_type not in _TRANSIENT_TYPES:
+        _TRANSIENT_TYPES.append(exc_type)
+
+
+def classify_error(exc: BaseException) -> str:
+    """``transient`` | ``oom`` | ``fatal`` for one exception.
+
+    The ONE gate every streaming retry/degradation path consults, so the
+    transient-vs-fatal line cannot drift between them: transient errors are
+    retried with backoff, oom errors trigger the slab split, everything
+    else (programming errors above all) raises immediately.
+    """
+    msg = str(exc)
+    if isinstance(exc, MemoryError):
+        # host-side slab allocation failure: splitting halves that too
+        return OOM
+    if type(exc).__name__ in _RUNTIME_ERROR_NAMES:
+        if any(tok in msg for tok in _OOM_TOKENS):
+            return OOM
+        if any(tok in msg for tok in _TRANSIENT_TOKENS):
+            return TRANSIENT
+        return FATAL
+    if isinstance(exc, RuntimeError) and any(tok in msg for tok in _OOM_TOKENS):
+        # covers faults.SimulatedOOM and any runtime wrapper that kept the
+        # status token in the message
+        return OOM
+    if isinstance(exc, _NON_RECOVERABLE_OS) and not any(
+        t is not OSError and isinstance(exc, t) for t in _TRANSIENT_TYPES
+    ):
+        return FATAL
+    if isinstance(exc, tuple(_TRANSIENT_TYPES)):
+        return TRANSIENT
+    return FATAL
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Retry knobs for one stream, frozen at stream start.
+
+    ``retries`` extra attempts per slab (so ``retries + 1`` total),
+    ``backoff`` base sleep in seconds (doubled per attempt:
+    ``backoff * 2**attempt``), ``timeout`` the per-slab deadline in seconds
+    across ALL attempts+backoffs of that slab (0 = no deadline)."""
+
+    retries: int = 2
+    backoff: float = 0.05
+    timeout: float = 0.0
+
+    @classmethod
+    def from_options(cls) -> "RetryPolicy":
+        from .options import OPTIONS
+
+        return cls(
+            retries=OPTIONS["stream_retries"],
+            backoff=OPTIONS["stream_backoff"],
+            timeout=OPTIONS["stream_slab_timeout"],
+        )
+
+    def delay(self, attempt: int) -> float:
+        return self.backoff * (2.0**attempt)
+
+
+def call_with_retry(
+    fn: Callable[[], Any],
+    *,
+    policy: RetryPolicy,
+    counters: "StreamCounters | None" = None,
+    what: str = "",
+) -> Any:
+    """Run ``fn`` retrying transient failures with exponential backoff.
+
+    Fatal and oom classifications raise immediately (oom belongs to the
+    dispatch-side splitter, not the staging retry). When retries exhaust,
+    the ORIGINAL exception is re-raised unchanged; when the per-slab
+    deadline would be crossed by the next backoff, a ``TimeoutError``
+    chains from it instead of sleeping past the budget.
+    """
+    deadline = time.monotonic() + policy.timeout if policy.timeout > 0 else None
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as exc:
+            if classify_error(exc) != TRANSIENT:
+                raise
+            if attempt >= policy.retries:
+                raise  # retries exhausted: surface the original exception
+            delay = policy.delay(attempt)
+            if deadline is not None and time.monotonic() + delay >= deadline:
+                raise TimeoutError(
+                    f"slab {what}: stream_slab_timeout of {policy.timeout:g}s "
+                    f"exceeded after {attempt + 1} attempt(s)"
+                ) from exc
+            attempt += 1
+            if counters is not None:
+                counters.record_retry(delay)
+            time.sleep(delay)
+
+
+@dataclass
+class StreamCounters:
+    """Resilience counters for one streaming run, shared by the staging
+    workers (retries), the dispatch guard (splits), and the checkpointer —
+    and attached to every ``StreamReport`` the run emits (a multi-pass run
+    like quantile reports the same cumulative object on each pass)."""
+
+    retries: int = 0
+    backoff_ms: float = 0.0
+    oom_splits: int = 0
+    checkpoints: int = 0
+    #: stream-order slab cursor this run resumed from (None = fresh run)
+    resumed_at: int | None = None
+    #: phase resumed into (multi-pass runs: 0 = first pass)
+    resumed_phase: int | None = None
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False, compare=False)
+
+    def record_retry(self, delay_s: float) -> None:
+        with self._lock:
+            self.retries += 1
+            self.backoff_ms += delay_s * 1e3
+
+    def record_split(self) -> None:
+        with self._lock:
+            self.oom_splits += 1
+
+    def record_checkpoint(self) -> None:
+        with self._lock:
+            self.checkpoints += 1
+
+
+# ---------------------------------------------------------------------------
+# graceful OOM degradation: halve + re-stage on a power-of-two ladder
+
+
+def _pow2_ceil(x: int) -> int:
+    return 1 << max(0, int(x) - 1).bit_length() if x > 1 else 1
+
+
+def _ladder_half(length: int, quantum: int) -> int:
+    """Sub-slab span for one split rung: half the span, rounded up to a
+    power of two (so the re-staged shapes form a small reusable ladder —
+    each rung's step program compiles once) and to the shard quantum (mesh
+    slabs must keep equal per-device shards). When the quantum rounding
+    would reach ``length`` itself (non-power-of-two device counts), fall
+    back to the largest quantum multiple strictly below it — the ladder
+    must keep descending as long as a legal split exists."""
+    half = _pow2_ceil((length + 1) // 2)
+    if quantum > 1:
+        half = -(-half // quantum) * quantum
+        if half >= length:
+            half = ((length - 1) // quantum) * quantum
+    return half
+
+
+def dispatch_slab(
+    apply_fn: Callable[[Any, Any], Any],
+    carry: Any,
+    sl: Any,
+    *,
+    stager: Any = None,
+    counters: StreamCounters | None = None,
+    shard_quantum: int = 1,
+    reverse: bool = False,
+) -> Any:
+    """Run one slab step — ``apply_fn(carry, slab) -> carry`` — with the
+    fault-injection hook and graceful OOM degradation.
+
+    On a resource-exhausted classification the slab's span is re-staged
+    through ``stager`` (the same `pipeline.SlabStager` that staged it) as
+    sub-slabs of half the span, padded to the power-of-two ladder, and
+    folded through ``apply_fn`` one by one (in reverse span order for
+    reversed streams, so scan carry semantics hold); a sub-slab that still
+    OOMs splits again, down to single elements. ``stager=None`` disables
+    splitting (the error propagates). Non-oom errors always propagate.
+    """
+    from . import faults
+
+    try:
+        faults.poke(sl.start, sl.stop)
+        return apply_fn(carry, sl)
+    except Exception as exc:
+        if classify_error(exc) != OOM or stager is None:
+            raise
+        return _split_dispatch(
+            apply_fn, carry, sl.start, sl.stop, stager,
+            counters=counters, quantum=shard_quantum, reverse=reverse, cause=exc,
+        )
+
+
+def _split_dispatch(
+    apply_fn, carry, s, e, stager, *, counters, quantum, reverse, cause, depth=0
+):
+    from . import faults
+
+    length = e - s
+    half = _ladder_half(length, quantum)
+    if length <= max(1, quantum) or half >= length or depth >= 48:
+        raise cause  # cannot split further: surface the original OOM
+    if counters is not None:
+        counters.record_split()
+    spans = [(ss, min(ss + half, e)) for ss in range(s, e, half)]
+    for ss, ee in reversed(spans) if reverse else spans:
+        try:
+            # staging inside the try: a sub-slab whose H2D transfer itself
+            # exhausts memory splits again, same as a failing step
+            sub = stager.stage_range(ss, ee, pad_to=half if stager.pad else None)
+            faults.poke(ss, ee)
+            carry = apply_fn(carry, sub)
+        except Exception as exc:
+            if classify_error(exc) != OOM:
+                raise
+            carry = _split_dispatch(
+                apply_fn, carry, ss, ee, stager,
+                counters=counters, quantum=quantum, reverse=reverse,
+                cause=exc, depth=depth + 1,
+            )
+    return carry
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+
+
+@dataclass
+class Snapshot:
+    """One host-side stream checkpoint: the carry pytree (numpy leaves,
+    ``jax.device_get`` of the device state — exact bytes), the stream-order
+    slab cursor it covers, and the phase for multi-pass runs (quantile:
+    0 = count pass, 1+i = bit pass i)."""
+
+    key: tuple
+    phase: int
+    slabs_done: int
+    payload: Any
+
+
+#: in-memory snapshot registry, keyed by the stream identity tuple.
+#: Registered in cache.clear_all with the other module-level caches.
+_SNAPSHOTS: dict[tuple, Snapshot] = {}
+
+
+class StreamCheckpointer:
+    """Periodic host-side snapshots of a streaming run's carry state.
+
+    Disabled (every method a no-op) unless
+    ``OPTIONS["stream_checkpoint_every"] > 0``. The stream identity key is
+    derived from the run's semantic shape (kind, aggregation name, n,
+    batch_len, size, a codes fingerprint, the mesh layout) so a re-invoked
+    identical call finds its predecessor's snapshot; with
+    ``OPTIONS["stream_checkpoint_path"]`` set, snapshots also spill to an
+    ``.npz`` (written atomically via rename) and survive the process — the
+    cross-process resume path. ``done()`` removes the snapshot once the run
+    completes, so a later identical call starts fresh.
+
+    Resume is bit-identical: ``device_get``/``device_put`` round-trips are
+    exact, and the remaining slabs refold from the snapshot in the same
+    stream order as the uninterrupted run.
+    """
+
+    def __init__(
+        self,
+        key: tuple | None,
+        *,
+        every: int | None = None,
+        path: str | None = None,
+        counters: StreamCounters | None = None,
+    ) -> None:
+        from .options import OPTIONS
+
+        self.every = OPTIONS["stream_checkpoint_every"] if every is None else every
+        self.path = OPTIONS["stream_checkpoint_path"] if path is None else path
+        self.key = key
+        self.counters = counters
+        self.enabled = key is not None and self.every > 0
+        self._ticks = 0
+
+    @classmethod
+    def for_stream(
+        cls,
+        *,
+        kind: str,
+        name: str,
+        n: int,
+        batch_len: int,
+        size: int,
+        codes: np.ndarray,
+        lead_shape: tuple = (),
+        mesh_key: Any = None,
+        extra: tuple = (),
+        data_probe: Any = None,
+        counters: StreamCounters | None = None,
+        enabled: bool = True,
+    ) -> "StreamCheckpointer":
+        from .options import OPTIONS
+
+        if not enabled or OPTIONS["stream_checkpoint_every"] <= 0:
+            # the fingerprints are skipped entirely when checkpointing is
+            # off — the disabled path costs nothing per stream
+            return cls(None, counters=counters)
+        fp = hashlib.blake2b(
+            np.ascontiguousarray(codes).tobytes(), digest_size=8
+        ).hexdigest()
+        # data tripwire: the entry points pass their one probe slab (the
+        # loader's first element), so re-running after the data VALUES
+        # changed at position 0 misses the stale snapshot instead of
+        # silently folding old state into new data. A change that leaves
+        # element 0 intact still matches — a cursor checkpoint can only
+        # ever assume the input is immutable for the run's lifetime
+        # (documented); this catches the common fixed-and-reran case.
+        probe_fp = None
+        if data_probe is not None:
+            probe_fp = hashlib.blake2b(
+                np.ascontiguousarray(np.asarray(data_probe)).tobytes(), digest_size=8
+            ).hexdigest()
+        key = (
+            kind, str(name), int(n), int(batch_len), int(size),
+            tuple(lead_shape), fp, probe_fp, mesh_key, tuple(extra),
+        )
+        return cls(key, counters=counters)
+
+    def restore(self) -> Snapshot | None:
+        """The latest snapshot for this stream identity (in-memory registry
+        first, then the spill file), or None for a fresh run."""
+        if not self.enabled:
+            return None
+        snap = _SNAPSHOTS.get(self.key)
+        if snap is None and self.path:
+            snap = _load_snapshot(self._file(), self.key)
+            if snap is not None:
+                _SNAPSHOTS[self.key] = snap
+        if snap is not None and self.counters is not None:
+            self.counters.resumed_at = snap.slabs_done
+            self.counters.resumed_phase = snap.phase
+        return snap
+
+    def tick(
+        self, payload_fn: Callable[[], Any], *, slabs_done: int, phase: int = 0
+    ) -> None:
+        """Count one processed slab; snapshot every ``every`` ticks.
+        ``payload_fn`` is only called when a snapshot is actually taken."""
+        if not self.enabled:
+            return
+        self._ticks += 1
+        if self._ticks % self.every:
+            return
+        self.save(payload_fn(), slabs_done=slabs_done, phase=phase)
+
+    def save(self, payload: Any, *, slabs_done: int, phase: int = 0) -> None:
+        if not self.enabled:
+            return
+        import jax
+
+        host = jax.device_get(payload)
+        snap = Snapshot(key=self.key, phase=phase, slabs_done=slabs_done, payload=host)
+        _SNAPSHOTS[self.key] = snap
+        if self.path:
+            _dump_snapshot(self._file(), snap)
+        if self.counters is not None:
+            self.counters.record_checkpoint()
+
+    def done(self) -> None:
+        """The run completed: drop its snapshot (registry + spill file) so
+        the next identical call starts fresh instead of resuming at the end."""
+        if not self.enabled:
+            return
+        _SNAPSHOTS.pop(self.key, None)
+        if self.path:
+            try:
+                os.unlink(self._file())
+            except OSError:
+                pass
+
+    def _file(self) -> str:
+        path = str(self.path)
+        if path.endswith(".npz"):
+            return path
+        h = hashlib.blake2b(repr(self.key).encode(), digest_size=8).hexdigest()
+        return os.path.join(path, f"flox-tpu-stream-{h}.npz")
+
+
+def _dump_snapshot(path: str, snap: Snapshot) -> None:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(snap.payload)
+    arrays = {f"leaf{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    meta = pickle.dumps((snap.key, snap.phase, snap.slabs_done, treedef))
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, __meta__=np.frombuffer(meta, dtype=np.uint8), **arrays)
+    os.replace(tmp, path)  # atomic: a kill mid-write never corrupts a snapshot
+
+
+def _load_snapshot(path: str, key: tuple) -> Snapshot | None:
+    """Read a spilled snapshot; None when missing, corrupt, or for a
+    different stream identity. The meta block (including the jax treedef)
+    is a pickle WE wrote — the spill path is operator-controlled state, not
+    untrusted input."""
+    import jax
+
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            skey, phase, done, treedef = pickle.loads(z["__meta__"].tobytes())
+            if skey != key:
+                return None
+            leaves = [z[f"leaf{i}"] for i in range(treedef.num_leaves)]
+        payload = jax.tree_util.tree_unflatten(treedef, leaves)
+    except Exception:
+        # the contract is "a corrupt or mismatched spill is ignored, never
+        # trusted": unpickling a stale treedef across a jax upgrade can
+        # raise essentially anything (AttributeError, ModuleNotFoundError,
+        # TypeError, BadZipFile...), and every one of them must mean
+        # "fresh run", not a crash at restore time
+        return None
+    return Snapshot(key=key, phase=phase, slabs_done=done, payload=payload)
+
+
+def device_restore(payload: Any, *, mesh: Any = None, spec_entry: Any = None) -> Any:
+    """Host snapshot payload -> device state, matching the layout the
+    streaming loop would have produced: plain device arrays single-device,
+    ``NamedSharding(mesh, P(spec_entry))`` on the leading axis for the
+    per-device mesh accumulators (replicated state passes ``mesh=None`` —
+    jit re-replicates plain arrays on entry)."""
+    import jax
+    import jax.numpy as jnp
+
+    if mesh is None:
+        return jax.tree.map(jnp.asarray, payload)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(spec_entry))
+    return jax.tree.map(lambda h: jax.device_put(h, sharding), payload)

@@ -284,6 +284,7 @@ def streaming_groupby_reduce(
             lead_shape=tuple(lead_shape), mesh=mesh, axis_name=axis_name,
             # the datetime wrap changes the effective dtype to float64
             probe_dtype=np.float64 if datetime_dtype is not None else probe.dtype,
+            data_probe=probe,
         )
         from .core import _astype_final, _index_values
 
@@ -304,15 +305,18 @@ def streaming_groupby_reduce(
         shift_nat_identity_fills(agg)
 
     slab_shard = codes_shard = None
+    spec_entry = None
+    mesh_key = None
+    shard_quantum = 1
     if mesh is not None:
         from .options import OPTIONS
         from .parallel.mapreduce import _is_additive, dense_intermediate_bytes
         from .utils import fmt_bytes
 
-        axes, ndev, batch_len, _spec_entry, _sspec, _cspec, slab_shard, codes_shard = (
+        axes, ndev, batch_len, spec_entry, _sspec, _cspec, slab_shard, codes_shard = (
             _mesh_stream_layout(mesh, axis_name, batch_len, len(lead_shape))
         )
-        shard_len = batch_len // ndev
+        shard_quantum = ndev
 
         # ceiling routing — the same decision sharded_groupby_reduce makes:
         # per-device accumulators are one dense (..., size) buffer set, so
@@ -362,17 +366,21 @@ def streaming_groupby_reduce(
                 )
             return (
                 _build_mesh_step(
-                    agg, size=size, shard_len=shard_len, count_skipna=count_skipna,
+                    agg, size=size, count_skipna=count_skipna,
                     nat=nat, mesh=mesh, axes=axes, lead_ndim=len(lead_shape),
                 ),
                 _build_mesh_final(agg, mesh=mesh, axes=axes, nat=nat),
             )
 
+        # no shard_len in the key: the step programs are shape-polymorphic
+        # (per-device offsets come from the traced shard width), so streams
+        # that differ only in batch_len share one cached (step, final) pair
         step, final = _step_cached(
-            ("mesh", _agg_cache_key(agg), size, shard_len, axes, mesh, nat,
+            ("mesh", _agg_cache_key(agg), size, axes, mesh, nat,
              blocked, len(lead_shape)),
             _build_mesh_pair,
         )
+        mesh_key = (tuple(axes), ndev, blocked)
     else:
         from .parallel.mapreduce import _agg_cache_key
 
@@ -382,25 +390,71 @@ def streaming_groupby_reduce(
         )
     nbatches = math.ceil(n / batch_len)
 
-    from .pipeline import DispatchThrottle, stream_slabs
+    from .pipeline import DispatchThrottle, SlabStager, stream_slabs
     from .profiling import timed
+    from .resilience import (
+        StreamCheckpointer,
+        StreamCounters,
+        device_restore,
+        dispatch_slab,
+    )
 
+    counters = StreamCounters()
+    stager = SlabStager(
+        loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
+        slab_shard=slab_shard, codes_shard=codes_shard, with_offset=True,
+        counters=counters,
+    )
+    from .parallel.mapreduce import _agg_cache_key
+
+    ckpt = StreamCheckpointer.for_stream(
+        # repr(_agg_cache_key) carries the RESOLVED aggregation identity
+        # (dtype= override, custom chunk/combine, finalize_kwargs) as a
+        # picklable string — a snapshot from a same-named but different
+        # aggregation must miss, not silently fold. Custom-callable ids
+        # differ across processes, so a cross-process .npz resume of a
+        # custom agg misses too: a fresh run, never a mismatched one.
+        kind="reduce", name=repr(_agg_cache_key(agg)), n=n, batch_len=batch_len,
+        size=size, codes=codes, lead_shape=tuple(lead_shape), mesh_key=mesh_key,
+        extra=(nat, count_skipna, str(probe.dtype)), data_probe=probe,
+        counters=counters,
+    )
     state = None
+    skip = 0
+    snap = ckpt.restore()
+    if snap is not None:
+        # bit-identical resume: the carry round-trips host exactly, and the
+        # remaining slabs refold in the same stream order
+        skip = snap.slabs_done
+        state = device_restore(snap.payload, mesh=mesh, spec_entry=spec_entry)
+    done = skip
     throttle = DispatchThrottle()
+
+    def apply_step(st, sb):
+        return step(st, sb.data, sb.codes, sb.offset)
+
     with timed(f"stream [{agg.name}] {nbatches} slab(s) x {batch_len}"):
         # the pipeline stages slab i+k (load, pad, device_put against the
         # shardings above) while the step for slab i runs; the step itself
-        # dispatches async, and the throttle syncs the carry every K steps
+        # dispatches async, and the throttle syncs the carry every K steps.
+        # dispatch_slab adds the fault hook + OOM halve-and-re-stage, and
+        # the checkpointer snapshots the carry every K processed slabs.
         for sl in stream_slabs(
             loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
             slab_shard=slab_shard, codes_shard=codes_shard, with_offset=True,
-            label=f"reduce[{agg.name}]",
+            label=f"reduce[{agg.name}]", skip=skip, counters=counters, stager=stager,
         ):
-            state = step(state, sl.data, sl.codes, sl.offset)
+            state = dispatch_slab(
+                apply_step, state, sl, stager=stager, counters=counters,
+                shard_quantum=shard_quantum,
+            )
             throttle.tick(state)
+            done += 1
+            ckpt.tick(lambda: state, slabs_done=done)
 
     if mesh is not None:
         result = final(state)
+        ckpt.done()
         from .core import _astype_final, _index_values
 
         result = _astype_final(result, agg, datetime_dtype)
@@ -413,6 +467,7 @@ def streaming_groupby_reduce(
     from .parallel.mapreduce import _finalize_combined
 
     result = _finalize_combined(agg, inters, counts)
+    ckpt.done()
     from .core import _astype_final, _index_values
 
     result = _astype_final(result, agg, datetime_dtype)
@@ -539,10 +594,14 @@ def _build_step(agg: Aggregation, *, size: int, count_skipna: bool,
         # first call establishes the state pytree; jit caches both arities
         return jitted(state, slab, ccodes, offset)
 
+    # the OOM-split tests assert compile counts against the underlying jit
+    # cache (the power-of-two ladder claim: splits reuse rungs, the base
+    # step is never retraced)
+    run._jitted = jitted
     return run
 
 
-def _build_mesh_step(agg: Aggregation, *, size: int, shard_len: int,
+def _build_mesh_step(agg: Aggregation, *, size: int,
                      count_skipna: bool, nat: bool, mesh, axes, lead_ndim: int):
     """Per-slab step on the mesh: each device folds its shard of the slab
     into ITS OWN accumulator — zero collectives while streaming. State
@@ -559,9 +618,13 @@ def _build_mesh_step(agg: Aggregation, *, size: int, shard_len: int,
 
     def local_step(state, slab_sh, codes_sh, offset):
         # shard-contiguous layout: device d holds slab[d*L:(d+1)*L], so the
-        # global position of its first element is offset + d*L
+        # global position of its first element is offset + d*L. L comes
+        # from the traced shard's own trailing dim, NOT the batch_len this
+        # builder was keyed on: an OOM-split sub-slab re-enters the same
+        # jitted step at half the span, and a static L would misplace every
+        # position-tracking reduction (argmin/argmax/first/last)
         dev = _flat_axis_index(axes)
-        goff = offset + dev.astype(offset.dtype) * shard_len
+        goff = offset + dev.astype(offset.dtype) * slab_sh.shape[-1]
         inters, counts = _slab_stats(
             agg, slab_sh, codes_sh, goff, size=size,
             count_skipna=count_skipna, nat=nat,
@@ -939,9 +1002,16 @@ def streaming_groupby_scan(
             reverse=reverse, out=out, mesh=mesh, axis_name=axis_name,
             # the wrap views datetimes as int64; no second loader probe
             probe_dtype=np.dtype("int64") if nat else probe.dtype,
+            data_probe=probe,
         )
 
-    from .pipeline import maybe_donate, stream_slabs
+    from .pipeline import SlabStager, maybe_donate, stream_slabs
+    from .resilience import (
+        StreamCheckpointer,
+        StreamCounters,
+        device_restore,
+        dispatch_slab,
+    )
 
     init_fn, step_fn = _step_cached(
         ("scan-step", scan.name, size, nat, str(dtype), has_missing),
@@ -953,29 +1023,80 @@ def streaming_groupby_scan(
         ),
     )
 
-    result_arr = None
+    counters = StreamCounters()
+    stager = SlabStager(
+        loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
+        pad=False, counters=counters,
+    )
+    # checkpointing a scan needs the already-emitted slabs to survive the
+    # kill, which only a writer gives us (the in-memory result array dies
+    # with the run) — so snapshots are taken only on the out= path
+    ckpt = StreamCheckpointer.for_stream(
+        kind="scan", name=_scan_ckpt_id(scan), n=n, batch_len=batch_len, size=size,
+        codes=codes, lead_shape=tuple(lead_shape),
+        extra=(nat, str(dtype), has_missing, reverse), data_probe=probe,
+        counters=counters, enabled=out is not None,
+    )
     carry = had = None
+    skip = 0
+    snap = ckpt.restore()
+    if snap is not None:
+        skip = snap.slabs_done
+        carry, had = device_restore(snap.payload)
+    done = skip
+
+    result_arr = None
+
+    def apply_scan(cur, sb):
+        c, h = cur
+        if c is None:
+            out_slab, c, h = init_fn(sb.data, sb.codes)
+        else:
+            out_slab, c, h = step_fn(sb.data, sb.codes, c, h)
+        nonlocal result_arr
+        result_arr = _emit_scan_slab(
+            out_slab, sb.codes_host, sb.start, sb.stop, nat=nat,
+            datetime_dtype=datetime_dtype, has_missing=has_missing, out=out,
+            result_arr=result_arr, lead_shape=lead_shape, n=n,
+        )
+        return c, h
+
     with timed(f"stream-scan [{scan.name}] {nbatches} slab(s)"):
         # prefetch overlaps the next load with this slab's compute+emit
         # (the emit's host conversion syncs per slab, so no dispatch
         # throttle is needed here); pad=False keeps the single-device scan
-        # contract of ragged tail slabs
+        # contract of ragged tail slabs. An OOM-split sub-slab stays ragged
+        # too, and splits run in reverse span order for bfill so the carry
+        # still flows against the stream.
         for sl in stream_slabs(
             loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
             pad=False, reverse=reverse, label=f"scan[{scan.name}]",
+            skip=skip, counters=counters, stager=stager,
         ):
-            if carry is None:
-                out_slab, carry, had = init_fn(sl.data, sl.codes)
-            else:
-                out_slab, carry, had = step_fn(sl.data, sl.codes, carry, had)
-            result_arr = _emit_scan_slab(
-                out_slab, sl.codes_host, sl.start, sl.stop, nat=nat,
-                datetime_dtype=datetime_dtype, has_missing=has_missing, out=out,
-                result_arr=result_arr, lead_shape=lead_shape, n=n,
+            carry, had = dispatch_slab(
+                apply_scan, (carry, had), sl, stager=stager, counters=counters,
+                reverse=reverse,
             )
+            done += 1
+            ckpt.tick(lambda: (carry, had), slabs_done=done)
+    ckpt.done()
     if out is not None:
         return None
     return result_arr
+
+
+def _scan_ckpt_id(scan) -> str:
+    """Resolved Scan identity for the checkpoint key (the scan-side
+    analogue of the reduce path's ``repr(_agg_cache_key(agg))``): a custom
+    Scan sharing a builtin's name must MISS the builtin's snapshot, never
+    silently fold into it. Callable binary_ops carry id(), so cross-process
+    resume of a custom scan misses too — a fresh run, never a mismatch."""
+    op = scan.binary_op
+    op_id = None if op is None else (getattr(op, "__qualname__", repr(op)), id(op))
+    return repr((
+        scan.name, scan.scan, scan.reduction, op_id, scan.identity,
+        scan.mode, scan.preserves_dtype,
+    ))
 
 
 def _emit_scan_slab(out_slab, ccodes_np, s, e, *, nat, datetime_dtype,
@@ -1003,7 +1124,7 @@ def _emit_scan_slab(out_slab, ccodes_np, s, e, *, nat, datetime_dtype,
 
 def _run_mesh_stream_scan(scan, loader, codes, *, size, n, batch_len, lead_shape,
                           dtype, nat, datetime_dtype, has_missing, reverse,
-                          out, mesh, axis_name, probe_dtype):
+                          out, mesh, axis_name, probe_dtype, data_probe=None):
     """streaming × mesh scan: each slab runs the distributed Blelloch with
     cross-slab carry I/O (parallel.scan.build_stream_scan_step)."""
     import math
@@ -1039,23 +1160,66 @@ def _run_mesh_stream_scan(scan, loader, codes, *, size, n, batch_len, lead_shape
         base_loader = loader
         loader = lambda s, e: np.asarray(base_loader(s, e)).astype(work_dtype, copy=False)
 
-    from .pipeline import stream_slabs
+    from .pipeline import SlabStager, stream_slabs
+    from .resilience import (
+        StreamCheckpointer,
+        StreamCounters,
+        device_restore,
+        dispatch_slab,
+    )
+
+    counters = StreamCounters()
+    stager = SlabStager(
+        loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
+        slab_shard=slab_shard, codes_shard=codes_shard, counters=counters,
+    )
+    # writer-gated for the same reason as the single-device scan; the carry
+    # pair is replicated (out_specs P()), so restore needs no resharding
+    ckpt = StreamCheckpointer.for_stream(
+        kind="scan-mesh", name=_scan_ckpt_id(scan), n=n, batch_len=batch_len, size=size,
+        codes=codes, lead_shape=tuple(lead_shape),
+        extra=(nat, str(dtype), has_missing, reverse, tuple(axes)),
+        data_probe=data_probe, counters=counters, enabled=out is not None,
+    )
+    skip = 0
+    snap = ckpt.restore()
+    if snap is not None:
+        skip = snap.slabs_done
+        c0, c1 = device_restore(snap.payload)
+    done = skip
 
     result_arr = None
+
+    def apply_scan(cur, sb):
+        a, b = cur
+        out_sh, a, b = step(sb.data, sb.codes, a, b)
+        nonlocal result_arr
+        result_arr = _emit_scan_slab(
+            out_sh, sb.codes_host, sb.start, sb.stop, nat=nat,
+            datetime_dtype=datetime_dtype, has_missing=has_missing, out=out,
+            result_arr=result_arr, lead_shape=lead_shape, n=n,
+        )
+        return a, b
+
     with timed(f"stream-scan-mesh [{scan.name}] {nbatches} slab(s)"):
         # the emit's host conversion syncs per slab (no throttle needed);
-        # prefetch overlaps the next slab's load+scatter with it
+        # prefetch overlaps the next slab's load+scatter with it. No OOM
+        # splitting here (stager=None): the distributed Blelloch carry
+        # exchange is not sub-slab associative under padding, so an OOM
+        # surfaces rather than risking a wrong fold — retry, checkpoint,
+        # and the fault hook still apply.
         for sl in stream_slabs(
             loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
             slab_shard=slab_shard, codes_shard=codes_shard, reverse=reverse,
-            label=f"scan-mesh[{scan.name}]",
+            label=f"scan-mesh[{scan.name}]", skip=skip, counters=counters,
+            stager=stager,
         ):
-            out_sh, c0, c1 = step(sl.data, sl.codes, c0, c1)
-            result_arr = _emit_scan_slab(
-                out_sh, sl.codes_host, sl.start, sl.stop, nat=nat,
-                datetime_dtype=datetime_dtype, has_missing=has_missing, out=out,
-                result_arr=result_arr, lead_shape=lead_shape, n=n,
+            c0, c1 = dispatch_slab(
+                apply_scan, (c0, c1), sl, counters=counters, reverse=reverse,
             )
+            done += 1
+            ckpt.tick(lambda: (c0, c1), slabs_done=done)
+    ckpt.done()
     if out is not None:
         return None
     return result_arr
@@ -1063,7 +1227,7 @@ def _run_mesh_stream_scan(scan, loader, codes, *, size, n, batch_len, lead_shape
 
 def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
                      batch_len: int, lead_shape: tuple, probe_dtype,
-                     mesh=None, axis_name="data"):
+                     mesh=None, axis_name="data", data_probe=None):
     """Out-of-core EXACT quantile/median: the radix-select bisection
     (kernels._radix_select) only ever consumes per-group COUNTS, and counts
     accumulate slab by slab — so order statistics stream in ``nbits + 1``
@@ -1114,6 +1278,7 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
 
     axes = None
     slab_shard = codes_shard = None
+    shard_quantum = 1
     if mesh is not None:
         # out-of-core AND distributed: slabs scatter over the mesh and each
         # counting pass psums — the per-group bisection state is replicated,
@@ -1122,17 +1287,33 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
         axes, _ndev, batch_len, _spec_entry, sspec, cspec, slab_shard, codes_shard = (
             _mesh_stream_layout(mesh, axis_name, batch_len, len(lead_shape))
         )
+        shard_quantum = _ndev
     nbatches = math.ceil(n / batch_len)
 
-    from .pipeline import DispatchThrottle, stream_slabs
+    from .pipeline import DispatchThrottle, SlabStager, stream_slabs
+    from .resilience import (
+        StreamCheckpointer,
+        StreamCounters,
+        device_restore,
+        dispatch_slab,
+    )
 
-    def slabs(pass_label):
+    counters = StreamCounters()
+    # ONE stager for all nbits + 1 passes: the retry policy and the loader
+    # dtype contract hold across the whole multi-pass run
+    stager = SlabStager(
+        loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
+        slab_shard=slab_shard, codes_shard=codes_shard, counters=counters,
+    )
+
+    def slabs(pass_label, skip=0):
         # each counting pass is one full pipelined sweep over the loader:
         # prefetch restarts per pass (the loader contract is random-access)
         return stream_slabs(
             loader, codes, n=n, batch_len=batch_len, lead_shape=tuple(lead_shape),
             slab_shard=slab_shard, codes_shard=codes_shard,
             label=f"quantile[{agg.name}] {pass_label}",
+            skip=skip, counters=counters, stager=stager,
         )
 
     # resolved float dtype: same rule as the eager kernel (probe_dtype comes
@@ -1224,30 +1405,81 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
         _build_passes,
     )
 
+    # multi-pass checkpointing: phase 0 = the count pass (payload nn/hasnan),
+    # phase 1+i = bit pass i (payload carries the full bisection state —
+    # nn/hasnan for the finalize, prefix/rank for the bisection, cnt for the
+    # pass in flight). The rank-set meta is NOT checkpointed: it re-derives
+    # deterministically from the restored nn.
+    ckpt = StreamCheckpointer.for_stream(
+        kind="quantile", name=agg.name, n=n, batch_len=batch_len, size=size,
+        codes=codes, lead_shape=tuple(lead_shape),
+        mesh_key=None if axes is None else tuple(axes),
+        extra=(tuple(np.asarray(qs).tolist()), method, str(fdtype)),
+        data_probe=data_probe, counters=counters,
+    )
+    snap = ckpt.restore()
+    phase0, skip0 = (0, 0) if snap is None else (snap.phase, snap.slabs_done)
+
     trail = lead_shape  # leading layout puts the reduce axis first
     throttle = DispatchThrottle()
+
+    def apply_count(st, sb):
+        return count_pass(st[0], st[1], sb.data, sb.codes)
+
     with timed(f"stream-quantile [{agg.name}] {nbits + 1} passes x {nbatches} slab(s)"):
         # counts accumulate EXACTLY in int32 (f32 would drift past 2^24 and
         # shift rank positions — the bit-identity claim rests on this)
-        nn = jnp.zeros((size,) + trail, jnp.int32)
-        hasnan = jnp.zeros((size,) + trail, jnp.int8)
-        for sl in slabs("count"):
-            nn, hasnan = count_pass(nn, hasnan, sl.data, sl.codes)
-            throttle.tick(nn)
+        bit0, bit_skip, cnt0 = 0, 0, None
+        if phase0 == 0:
+            if snap is not None:
+                nn, hasnan = device_restore(snap.payload)
+            else:
+                nn = jnp.zeros((size,) + trail, jnp.int32)
+                hasnan = jnp.zeros((size,) + trail, jnp.int8)
+            done = skip0
+            for sl in slabs("count", skip=skip0):
+                nn, hasnan = dispatch_slab(
+                    apply_count, (nn, hasnan), sl, stager=stager,
+                    counters=counters, shard_quantum=shard_quantum,
+                )
+                throttle.tick(nn)
+                done += 1
+                ckpt.tick(lambda: (nn, hasnan), slabs_done=done, phase=0)
+        else:
+            nn, hasnan, prefix, rank, cnt0 = device_restore(snap.payload)
+            bit0, bit_skip = phase0 - 1, skip0
 
         idx_dtype = jnp.float64 if utils.x64_enabled() else jnp.float32
         nnf = nn.astype(idx_dtype)
         ranks, meta = _quantile_rank_sets(qs, nnf, method, alpha, beta)
         m = ranks.shape[0]
-        prefix = jnp.zeros((m, size) + trail, ut)
-        rank = ranks.astype(jnp.int32)
-        for i in range(nbits):
+        if phase0 == 0:
+            prefix = jnp.zeros((m, size) + trail, ut)
+            rank = ranks.astype(jnp.int32)
+        for i in range(bit0, nbits):
             bshift = jnp.asarray(nbits - 1 - i, ut)
-            cnt = jnp.zeros((m, size) + trail, jnp.int32)
-            for sl in slabs(f"bit {i}"):
-                cnt = bit_pass(cnt, prefix, sl.data, sl.codes, bshift)
+            if i == bit0 and cnt0 is not None:
+                cnt, skip_i = cnt0, bit_skip
+            else:
+                cnt, skip_i = jnp.zeros((m, size) + trail, jnp.int32), 0
+
+            def apply_bit(st, sb):
+                return bit_pass(st, prefix, sb.data, sb.codes, bshift)
+
+            done = skip_i
+            for sl in slabs(f"bit {i}", skip=skip_i):
+                cnt = dispatch_slab(
+                    apply_bit, cnt, sl, stager=stager, counters=counters,
+                    shard_quantum=shard_quantum,
+                )
                 throttle.tick(cnt)
+                done += 1
+                ckpt.tick(
+                    lambda: (nn, hasnan, prefix, rank, cnt),
+                    slabs_done=done, phase=1 + i,
+                )
             prefix, rank = update(prefix, rank, cnt, bshift)
+    ckpt.done()
 
     selected = _uint_to_value(prefix, fdtype)
     group_has_nan = (hasnan > 0) if not skipna else None

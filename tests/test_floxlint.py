@@ -51,7 +51,8 @@ def test_fixture_corpus_is_nonempty():
 @pytest.mark.parametrize(
     "fixture",
     ["flx001_host_sync.py", "flx002_recompile_traps.py", "flx003_dtype_policy.py",
-     "flx004_version_gated.py", "clean_module.py", "suppressed.py"],
+     "flx004_version_gated.py", "flx006_swallow.py", "clean_module.py",
+     "suppressed.py"],
 )
 def test_fixture_findings_match_markers(fixture):
     path = FIXTURES / fixture
@@ -97,6 +98,39 @@ def test_bare_shard_map_reintroduction_fails(tmp_path):
     rc = floxlint_main([str(bad)])
     assert rc == 1
     assert any(f.rule == "FLX004" for f in lint_file(bad))
+
+
+def test_swallowed_retry_exception_fails(tmp_path):
+    # ISSUE 3 satellite: a retry loop that swallows with a broad except —
+    # neither re-raising nor routing through resilience.classify_error —
+    # must fail the lint (the shape that turns a TypeError into an
+    # infinitely-spinning "transient" failure)
+    bad = tmp_path / "regress_retry_swallow.py"
+    bad.write_text(
+        "import time\n\n"
+        "def fetch_with_retry(loader, s, e):\n"
+        "    for attempt in range(5):\n"
+        "        try:\n"
+        "            return loader(s, e)\n"
+        "        except Exception:\n"
+        "            time.sleep(0.1)\n"
+    )
+    rc = floxlint_main([str(bad)])
+    assert rc == 1
+    assert any(f.rule == "FLX006" for f in lint_file(bad))
+    # the sanctioned shape — classify, re-raise the non-transient — is clean
+    good = tmp_path / "clean_retry.py"
+    good.write_text(
+        "from flox_tpu.resilience import classify_error\n\n"
+        "def fetch_with_retry(loader, s, e):\n"
+        "    for attempt in range(5):\n"
+        "        try:\n"
+        "            return loader(s, e)\n"
+        "        except Exception as exc:\n"
+        "            if classify_error(exc) != 'transient':\n"
+        "                raise\n"
+    )
+    assert not [f for f in lint_file(good) if f.rule == "FLX006"]
 
 
 def test_streaming_step_closure_host_sync_fails(tmp_path):

@@ -137,6 +137,55 @@ class TestStreamSlabs:
 
         assert collect(0) == collect(3)
 
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_skip_drops_leading_stream_order(self, depth):
+        # checkpoint resume: skip=k drops the first k slabs in STREAM order
+        codes = np.arange(10, dtype=np.int32)
+        data = np.arange(10.0)
+
+        def starts(**kw):
+            return [
+                s.start for s in stream_slabs(
+                    lambda st, e: data[st:e], codes, n=10, batch_len=4,
+                    lead_shape=(), prefetch=depth, **kw,
+                )
+            ]
+
+        assert starts(skip=1) == [4, 8]
+        # reversed streams: the "first" slabs are the trailing batches
+        assert starts(skip=1, reverse=True, pad=False) == [4, 0]
+        assert starts(skip=3) == []
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_loader_contract_shape_violation(self, depth):
+        # ISSUE 3 satellite: a drifting slab shape raises a clear ValueError
+        # naming the slab range, not a cryptic XLA shape error mid-step
+        codes = np.arange(100, dtype=np.int32)
+        data = np.arange(100.0)
+
+        def bad(s, e):
+            return np.zeros(7) if s == 40 else data[s:e]
+
+        with pytest.raises(ValueError, match=r"loader contract.*\[40:60\)"):
+            for _ in stream_slabs(
+                bad, codes, n=100, batch_len=20, lead_shape=(), prefetch=depth,
+            ):
+                pass
+
+    def test_loader_contract_dtype_violation(self):
+        codes = np.arange(100, dtype=np.int32)
+        data = np.arange(100.0)
+
+        def bad(s, e):
+            sl = data[s:e]
+            return sl.astype(np.float32) if s >= 60 else sl
+
+        with pytest.raises(ValueError, match=r"\[60:80\).*float32.*float64"):
+            for _ in stream_slabs(
+                bad, codes, n=100, batch_len=20, lead_shape=(), prefetch=0,
+            ):
+                pass
+
 
 def test_dispatch_throttle_reads_option_and_syncs():
     import flox_tpu
@@ -181,8 +230,19 @@ def test_stream_option_validation():
         flox_tpu.set_options(stream_dispatch_depth=-2)
     with pytest.raises(ValueError):
         flox_tpu.set_options(stream_donate="maybe")
+    # resilience knobs validate at set time too (the full invalid-value
+    # matrix lives in tests/test_resilience.py::TestOptionValidation)
+    with pytest.raises(ValueError):
+        flox_tpu.set_options(stream_retries=-1)
+    with pytest.raises(ValueError):
+        flox_tpu.set_options(stream_backoff=-0.5)
+    with pytest.raises(ValueError):
+        flox_tpu.set_options(stream_checkpoint_every=-1)
     with flox_tpu.set_options(stream_prefetch=0, stream_dispatch_depth=0,
-                              stream_donate="off"):
+                              stream_donate="off", stream_retries=0,
+                              stream_backoff=0.0, stream_slab_timeout=0.0,
+                              stream_checkpoint_every=0,
+                              stream_checkpoint_path=None):
         pass
 
 

@@ -1,0 +1,87 @@
+"""Seeded FLX006 violations: broad excepts in retry loops that swallow.
+
+Every violating line carries the corpus's trailing expect-marker; the clean
+shapes below them pin the rule's negative space (re-raise, classify,
+specific types, no loop, nested scope).
+"""
+
+import time
+
+
+def retry_swallows_everything(loader):
+    for _attempt in range(3):
+        try:
+            return loader()
+        except Exception:  # expect: FLX006
+            time.sleep(0.1)
+    return None
+
+
+def bare_except_in_while(fetch):
+    result = None
+    while result is None:
+        try:
+            result = fetch()
+        except:  # noqa: E722  # expect: FLX006
+            continue
+    return result
+
+
+def tuple_catch_swallows(fetch, log):
+    for _ in range(5):
+        try:
+            return fetch()
+        except (ValueError, Exception):  # expect: FLX006
+            log("retrying")
+    return None
+
+
+def clean_reraises_on_last_attempt(loader):
+    for attempt in range(3):
+        try:
+            return loader()
+        except Exception:
+            if attempt == 2:
+                raise
+            time.sleep(0.1)
+    return None
+
+
+def clean_routes_through_classifier(loader, sink):
+    from flox_tpu.resilience import classify_error
+
+    for _attempt in range(3):
+        try:
+            return loader()
+        except Exception as exc:
+            sink(classify_error(exc))
+    return None
+
+
+def clean_specific_types(loader):
+    for _attempt in range(3):
+        try:
+            return loader()
+        except (OSError, ConnectionError):
+            time.sleep(0.1)
+    return None
+
+
+def clean_probe_not_in_loop(probe):
+    try:
+        return probe()
+    except Exception:
+        return None
+
+
+def clean_nested_scope_is_not_this_loops_retry_path(items):
+    out = []
+    for item in items:
+        def parse(raw=item):
+            try:
+                return int(raw)
+            except Exception:
+                return None
+
+        out.append(parse())
+    return out

@@ -7,6 +7,7 @@ from .rules.flx002_recompile import RecompileTrapRule
 from .rules.flx003_dtype import DtypePolicyRule
 from .rules.flx004_version import VersionGatedApiRule
 from .rules.flx005_api import UntypedPublicApiRule
+from .rules.flx006_swallow import SwallowedRetryExceptionRule
 
 #: id -> rule instance, in id order
 RULES = {
@@ -17,6 +18,7 @@ RULES = {
         DtypePolicyRule(),
         VersionGatedApiRule(),
         UntypedPublicApiRule(),
+        SwallowedRetryExceptionRule(),
     )
 }
 

@@ -1,0 +1,100 @@
+"""FLX006 — swallowed exception in a retry loop.
+
+A ``try`` inside a ``for``/``while`` whose handler catches ``Exception``
+(or everything, via a bare ``except:``) and neither re-raises nor consults
+the resilience classifier swallows fatal programming errors along with the
+transient ones: the retry loop spins on a ``TypeError`` exactly as happily
+as on an IO hiccup, and the bug surfaces hours later as a hung or silently
+wrong stream. ``flox_tpu.resilience.classify_error`` is the sanctioned
+gate — transient errors retry, everything else must surface — so a broad
+handler in a retry path must either call a classifier or contain a
+``raise``.
+
+Handlers inside nested function definitions are NOT attributed to an outer
+loop (a helper defined inside a loop is not that loop's retry path), and
+handlers for specific exception types are always fine — naming the types
+IS a classification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding
+
+#: calling any of these inside the handler counts as classifying the error
+_CLASSIFIER_NAMES = ("classify_error", "is_transient", "is_fatal", "is_oom")
+
+
+class SwallowedRetryExceptionRule:
+    id = "FLX006"
+    name = "swallowed-retry-exception"
+    description = (
+        "bare `except:`/`except Exception:` inside a retry loop that neither "
+        "re-raises nor classifies the error swallows fatal failures"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        yield from _walk(ctx.tree, False, ctx.display_path)
+
+
+def _walk(node: ast.AST, in_loop: bool, path: str) -> Iterator[Finding]:
+    for child in ast.iter_child_nodes(node):
+        child_in_loop = in_loop
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+            # a new scope: its handlers belong to ITS loops, not ours
+            child_in_loop = False
+        elif isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+            child_in_loop = True
+        if isinstance(child, ast.Try) and child_in_loop:
+            yield from _check_try(child, path)
+        yield from _walk(child, child_in_loop, path)
+
+
+def _check_try(node: ast.Try, path: str) -> Iterator[Finding]:
+    for handler in node.handlers:
+        if not _catches_everything(handler.type):
+            continue
+        if _reraises_or_classifies(handler):
+            continue
+        yield Finding(
+            path=path,
+            line=handler.lineno,
+            col=handler.col_offset,
+            rule="FLX006",
+            message=(
+                "broad except inside a retry loop swallows fatal errors along "
+                "with transient ones; re-raise, or route through "
+                "resilience.classify_error and re-raise the non-transient kinds"
+            ),
+        )
+
+
+def _catches_everything(expr: ast.expr | None) -> bool:
+    if expr is None:  # bare `except:`
+        return True
+    elts = expr.elts if isinstance(expr, ast.Tuple) else [expr]
+    for el in elts:
+        name = None
+        if isinstance(el, ast.Name):
+            name = el.id
+        elif isinstance(el, ast.Attribute):
+            name = el.attr
+        if name in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _reraises_or_classifies(handler: ast.ExceptHandler) -> bool:
+    for sub in ast.walk(handler):
+        if isinstance(sub, ast.Raise):
+            return True
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None
+            )
+            if name in _CLASSIFIER_NAMES:
+                return True
+    return False
