@@ -403,6 +403,29 @@ def main() -> None:
         "prefetch_speedup": round(t_sync / t_pre, 2),
     }
 
+    # --- telemetry profile of the headline reduction (ISSUE 4) ------------
+    # one instrumented pass, OUTSIDE the timed reps so the numbers above
+    # stay clean: compile counts + span-phase breakdown make this round
+    # diagnosable after the fact — above all the CPU-fallback case, where
+    # a low GB/s alone cannot distinguish a retrace storm from a staging
+    # bottleneck from plain host-core arithmetic
+    from flox_tpu import cache as _flox_cache, telemetry as _telemetry
+
+    try:
+        _flox_cache.clear_all()
+        jax.clear_caches()
+        # the full user-facing path (factorize -> dispatch -> combine ->
+        # finalize), not the bare chain kernel: phase spans only exist there
+        telemetry_profile = _telemetry.profile_call(
+            lambda: np.asarray(
+                flox_tpu.groupby_reduce(dev_data, month, func="nanmean")[0]
+            )
+        )
+    except Exception as exc:  # noqa: BLE001 — diagnostics must not kill the bench
+        print(f"flox-tpu bench: telemetry profile failed: {exc}",
+              file=sys.stderr, flush=True)
+        telemetry_profile = None
+
     # one shared field set: the persisted hardware record and the stdout
     # line must never drift apart about what was measured
     core = {
@@ -416,6 +439,7 @@ def main() -> None:
         "impl_sweep_gbps": sweep_gbps,
         "quantile_gbps": quantile_gbps,
         "streaming": streaming,
+        "telemetry": telemetry_profile,
     }
     if on_accel:
         # the round's hardware evidence: persist it so a later capture that

@@ -313,6 +313,27 @@ def bench_scan_blelloch(scale: str):
     return [{"bench": "time_scan[cumsum-blelloch]", "value": round(t * 1e3, 2), "unit": "ms"}]
 
 
+def bench_telemetry(scale: str):
+    """ISSUE 4: one instrumented pass of the ERA5 day-of-year headline so
+    every benchmark round records its compile counts, retrace counts, and
+    span-phase breakdown — the after-the-fact diagnosis BENCH rounds 1-5
+    lacked whenever the accelerator probe fell back to CPU."""
+    from flox_tpu import cache, groupby_reduce, telemetry
+
+    nt, day = _era5_labels(scale)
+    nspace = 72 * 144 if scale == "full" else 24 * 48
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(nspace, nt)).astype(np.float32)
+    cache.clear_all()  # fresh caches: the profile records REAL compile work
+    try:
+        profile = telemetry.profile_call(
+            lambda: _block(groupby_reduce(vals, day, func="nanmean", engine="jax")[0])
+        )
+    except Exception as exc:  # noqa: BLE001 — diagnostics must not kill the sweep
+        profile = {"error": f"{type(exc).__name__}: {exc}"}
+    return [{"bench": "telemetry[era5-nanmean]", "value": profile, "unit": "profile"}]
+
+
 def bench_cohort_detection(scale: str):
     """time_find_group_cohorts + track_num_cohorts parity."""
     from flox_tpu import cache
@@ -382,6 +403,7 @@ def main() -> None:
             results += bench_mesh_methods(args.scale)
             results += bench_scan_blelloch(args.scale)
             results += bench_streaming(args.scale)
+            results += bench_telemetry(args.scale)
         results += bench_cohort_detection(args.scale)
         return results
 

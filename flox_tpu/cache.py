@@ -15,11 +15,41 @@ import functools
 memoize = functools.lru_cache(maxsize=512)
 
 
+def stats() -> dict:
+    """Current entry counts of every named host-side cache (plus the
+    kernel-bundle LRU's hit/miss counters) — the cache panel of the
+    telemetry layer (``telemetry.profile_call`` embeds this, and a bench
+    row showing ``bundle_lru.misses`` climbing across same-shaped calls is
+    a retrace storm caught red-handed)."""
+    from .cohorts import _COHORTS_CACHE
+    from .core import _jitted_bundle
+    from .factorize import _FACTORIZE_CACHE
+    from .parallel.mapreduce import _PROGRAM_CACHE
+    from .parallel.scan import _SCAN_CACHE
+    from .streaming import _STEP_CACHE
+
+    info = _jitted_bundle.cache_info()
+    return {
+        "cohorts": len(_COHORTS_CACHE),
+        "factorize": len(_FACTORIZE_CACHE),
+        "mesh_programs": len(_PROGRAM_CACHE),
+        "scan_programs": len(_SCAN_CACHE),
+        "stream_steps": len(_STEP_CACHE),
+        "bundle_lru": {
+            "size": info.currsize, "hits": info.hits, "misses": info.misses
+        },
+    }
+
+
 def clear_all() -> None:
     """Drop every host-side cache: cohort-detection memos, compiled mesh
-    program/scan caches, and the jitted kernel-bundle LRU. The analogue of
-    the reference's ``flox.cache.cache.clear()`` (its asv benchmarks clear
-    between timing rounds; ``benchmarks.py`` here does the same)."""
+    program/scan caches, and the jitted kernel-bundle LRU — and reset the
+    telemetry metrics registry, whose cache-hit/miss and compile counters
+    describe exactly the state being dropped (a benchmark that clears
+    between timing rounds must not carry stale counts across them). The
+    analogue of the reference's ``flox.cache.cache.clear()`` (its asv
+    benchmarks clear between timing rounds; ``benchmarks.py`` here does the
+    same)."""
     from .cohorts import _COHORTS_CACHE
     from .core import _jitted_bundle
     from .factorize import _FACTORIZE_CACHE, _FACTORIZE_CACHE_BYTES
@@ -28,6 +58,7 @@ def clear_all() -> None:
     from .pipeline import _DONATION_OK
     from .resilience import _SNAPSHOTS
     from .streaming import _STEP_CACHE
+    from .telemetry import METRICS
 
     _COHORTS_CACHE.clear()
     _FACTORIZE_CACHE.clear()
@@ -38,3 +69,4 @@ def clear_all() -> None:
     _DONATION_OK.clear()
     _SNAPSHOTS.clear()
     _jitted_bundle.cache_clear()
+    METRICS.reset()

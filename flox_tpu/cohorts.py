@@ -31,7 +31,7 @@ from typing import Sequence
 import numpy as np
 
 
-logger = logging.getLogger("flox_tpu")
+logger = logging.getLogger("flox_tpu.cohorts")
 
 __all__ = ["find_group_cohorts", "chunks_from_shards", "ownership_permutation"]
 

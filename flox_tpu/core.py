@@ -28,11 +28,11 @@ from typing import Any, Callable, Sequence
 import numpy as np
 import pandas as pd
 
-from . import dtypes, factorize as fct, utils
+from . import dtypes, factorize as fct, telemetry, utils
 from .aggregations import Aggregation, _initialize_aggregation, generic_aggregate, normalize_engine
 from .options import OPTIONS
 
-logger = logging.getLogger("flox_tpu")
+logger = logging.getLogger("flox_tpu.core")
 
 __all__ = ["groupby_reduce", "chunk_reduce"]
 
@@ -119,6 +119,10 @@ def _jitted_bundle(funcs_key, size: int, engine: str, opts_key: tuple = ()):
     """
     import jax
 
+    # body runs only on an lru_cache miss: a fresh jit program is built (it
+    # still traces/compiles per input shape — jax.compiles counts those)
+    telemetry.count("cache.bundle_builds")
+
     specs = funcs_key
 
     def run(codes, array):
@@ -194,22 +198,30 @@ def chunk_reduce(
         )
         from .options import trace_fingerprint
 
+        telemetry.count("cache.bundle_calls")
         bundle = _jitted_bundle(funcs_key, size, engine, trace_fingerprint())
-        results = bundle(utils.asarray_device(codes), utils.asarray_device(array))
+        with telemetry.span(
+            "dispatch", engine=engine, nkernels=len(plan), size=size,
+            funcs=[p[0] for p in plan if isinstance(p[0], str)],
+        ):
+            results = bundle(utils.asarray_device(codes), utils.asarray_device(array))
     else:
-        results = [
-            generic_aggregate(
-                codes,
-                array,
-                engine=engine,
-                func=f,
-                size=size,
-                fill_value=fv,
-                dtype=dt,
-                **kw,
-            )
-            for f, fv, dt, kw in plan
-        ]
+        with telemetry.span(
+            "dispatch", engine=engine, nkernels=len(plan), size=size,
+        ):
+            results = [
+                generic_aggregate(
+                    codes,
+                    array,
+                    engine=engine,
+                    func=f,
+                    size=size,
+                    fill_value=fv,
+                    dtype=dt,
+                    **kw,
+                )
+                for f, fv, dt, kw in plan
+            ]
     return [results[i] for i in positions]
 
 
@@ -380,6 +392,42 @@ def groupby_reduce(
     >>> result
     array([2, 1, 1])
     """
+    with telemetry.span(
+        "groupby_reduce",
+        func=func if isinstance(func, str) else getattr(func, "name", "custom"),
+        method=method,
+    ):
+        return _groupby_reduce_impl(
+            array, *by, func=func, expected_groups=expected_groups, sort=sort,
+            isbin=isbin, axis=axis, fill_value=fill_value, dtype=dtype,
+            min_count=min_count, method=method, engine=engine, reindex=reindex,
+            finalize_kwargs=finalize_kwargs, mesh=mesh, axis_name=axis_name,
+        )
+
+
+def _groupby_reduce_impl(
+    array: Any,
+    *by: Any,
+    func: str | Aggregation,
+    expected_groups: Any,
+    sort: bool,
+    isbin: bool | Sequence[bool],
+    axis: int | Sequence[int] | None,
+    fill_value: Any,
+    dtype: Any,
+    min_count: int | None,
+    method: str | None,
+    engine: str | None,
+    reindex: Any,
+    finalize_kwargs: dict | None,
+    mesh: Any,
+    axis_name: str,
+) -> tuple:
+    """The :func:`groupby_reduce` body, under the public wrapper's root
+    telemetry span (the wrapper exists so the span covers every early
+    dispatch — sparse, non-numeric — without touching their returns).
+    Defaults live ONLY on the public wrapper, which forwards every
+    argument — no defaults here, so signature drift fails loudly."""
     if not by:
         raise TypeError("Must pass at least one `by`")
     if method not in (None, "map-reduce", "blockwise", "cohorts"):
@@ -509,9 +557,11 @@ def groupby_reduce(
     keep_by_shape = tuple(bys[0].shape[:n_keep])
 
     # -- factorize (host) --------------------------------------------------
-    codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_cached(
-        tuple(bys), axes=tuple(range(n_keep, bndim)), expected_groups=expected_idx, sort=sort
-    )
+    with telemetry.span("factorize", nby=nby) as _fsp:
+        codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_cached(
+            tuple(bys), axes=tuple(range(n_keep, bndim)), expected_groups=expected_idx, sort=sort
+        )
+        _fsp.set(ngroups=ngroups, size=size)
     logger.debug(
         "groupby_reduce: func=%s ngroups=%d size=%d offset=%s engine=%s",
         func if isinstance(func, str) else func.name,
@@ -623,17 +673,23 @@ def groupby_reduce(
             )
         from .parallel.mapreduce import sharded_groupby_reduce
 
-        result = sharded_groupby_reduce(
-            arr_flat,
-            codes_flat,
-            agg,
-            size=size,
-            mesh=mesh,
-            axis_name=axis_name,
-            method=method,
-            nat=datetime_dtype is not None,
-        )
-        result = _astype_final(result, agg, datetime_dtype)
+        # "combine" here is the whole SPMD program: per-shard chunk reduce +
+        # the collective tree-combine + on-device finalize, fused in one
+        # shard_map (the program-build / dispatch child spans live in
+        # parallel/mapreduce.py)
+        with telemetry.span("combine", method=method, size=size):
+            result = sharded_groupby_reduce(
+                arr_flat,
+                codes_flat,
+                agg,
+                size=size,
+                mesh=mesh,
+                axis_name=axis_name,
+                method=method,
+                nat=datetime_dtype is not None,
+            )
+        with telemetry.span("finalize"):
+            result = _astype_final(result, agg, datetime_dtype)
     else:
         # -- eager single-device reduction ---------------------------------
         if engine == "jax":
@@ -755,24 +811,29 @@ def _reduce_blockwise(arr_flat, codes_flat, agg: Aggregation, *, size, engine, d
         kwargss=kwargss,
     )
 
-    if agg.min_count > 0:
-        counts = results[-1]
-        results = results[:-1]
-    else:
-        counts = None
+    # "combine" eagerly: fold the per-kernel intermediates into one result
+    # (multi-stage finalize + the min_count mask) — the single-device
+    # analogue of the mesh path's collective combine
+    with telemetry.span("combine", nresults=len(results)):
+        if agg.min_count > 0:
+            counts = results[-1]
+            results = results[:-1]
+        else:
+            counts = None
 
-    if agg.finalize is not None and len(agg.numpy) > 1:
-        # multi-stage custom Aggregation: the eager stages are intermediates
-        # and finalize folds them (parity: _finalize_results, core.py:410-475).
-        # Registry aggs use a single fused eager kernel, already final.
-        result = agg.finalize(*results, **agg.finalize_kwargs)
-    else:
-        result = results[0]
+        if agg.finalize is not None and len(agg.numpy) > 1:
+            # multi-stage custom Aggregation: the eager stages are intermediates
+            # and finalize folds them (parity: _finalize_results, core.py:410-475).
+            # Registry aggs use a single fused eager kernel, already final.
+            result = agg.finalize(*results, **agg.finalize_kwargs)
+        else:
+            result = results[0]
 
-    if counts is not None:
-        result = _where(counts < agg.min_count, agg.final_fill_value, result)
+        if counts is not None:
+            result = _where(counts < agg.min_count, agg.final_fill_value, result)
 
-    result = _astype_final(result, agg, datetime_dtype)
+    with telemetry.span("finalize"):
+        result = _astype_final(result, agg, datetime_dtype)
     return result
 
 

@@ -273,7 +273,7 @@ def _probed_ok(final_memo, compile_memo, exec_probe, compile_probe, label) -> bo
         return final_memo[0]
     import logging
 
-    log = logging.getLogger("flox_tpu")
+    log = logging.getLogger("flox_tpu.kernels")
     try:
         from jax._src import core as _jcore  # jax.core stopped re-exporting it
 
@@ -1536,4 +1536,11 @@ def generic_kernel(func: str, group_idx, array, **kwargs):
         fn = KERNELS[func]
     except KeyError:
         raise NotImplementedError(f"jax engine has no kernel for {func!r}") from None
+    from . import telemetry
+
+    if telemetry.detailed():
+        # under jit this fires at TRACE time, so per-kernel counts are a
+        # retrace signal (executions are fused into compiled programs);
+        # eager (jit=False) calls count once per execution
+        telemetry.METRICS.inc(f"kernel.trace.{func}")
     return fn(group_idx, array, **kwargs)

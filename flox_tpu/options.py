@@ -41,6 +41,14 @@ def _env_float(name: str, default: float, lo: float = 0.0) -> float:
     return value
 
 
+def _env_choice(name: str, default: str, valid: tuple[str, ...]) -> str:
+    """String-enum analogue of :func:`_env_int`: an env value outside the
+    valid set falls back to the default rather than breaking import — the
+    same cannot-seed-what-set_options-refuses contract."""
+    value = os.environ.get(name, default)
+    return value if value in valid else default
+
+
 OPTIONS: dict[str, Any] = {
     # Resharding-for-blockwise is applied automatically only when the change
     # it would make is small (same spirit as options.py:9-18).
@@ -141,6 +149,23 @@ OPTIONS: dict[str, Any] = {
     # identity) or a literal .npz path — the cross-process resume path. None
     # keeps snapshots in the in-process registry only.
     "stream_checkpoint_path": os.environ.get("FLOX_TPU_STREAM_CHECKPOINT_PATH") or None,
+    # Telemetry (flox_tpu/telemetry.py): master switch for the hierarchical
+    # span tracer, the metrics registry, and the jax compile/retrace
+    # listener. Off (the default) is a true no-op — no span objects are
+    # allocated and counters stay untouched. Env-seeded so CI can run the
+    # whole suite instrumented without code changes.
+    "telemetry": bool(_env_int("FLOX_TPU_TELEMETRY", 0, 0, 1)),
+    # "basic" records phase-level spans (factorize/dispatch/combine/
+    # finalize, stream passes); "detailed" adds per-slab staging spans and
+    # per-kernel dispatch counters on the hot paths
+    "telemetry_level": _env_choice(
+        "FLOX_TPU_TELEMETRY_LEVEL", "basic", ("basic", "detailed")
+    ),
+    # stream finished telemetry records to this file: *.jsonl appends
+    # incrementally as spans finish, any other path is written as one
+    # Chrome trace-event JSON (ui.perfetto.dev-loadable) at flush/exit.
+    # None keeps records in the in-process buffer (telemetry.spans()).
+    "telemetry_export_path": os.environ.get("FLOX_TPU_TELEMETRY_EXPORT_PATH") or None,
 }
 
 # single source of truth for the accumulation disciplines — referenced by
@@ -173,6 +198,13 @@ _VALIDATORS = {
     "stream_slab_timeout": lambda x: _is_finite_num(x) and x >= 0,
     "stream_checkpoint_every": lambda x: _is_int(x) and x >= 0,
     "stream_checkpoint_path": lambda x: x is None or (
+        isinstance(x, (str, os.PathLike)) and bool(str(x))
+    ),
+    # telemetry knobs are validated AT SET TIME like the stream knobs: a
+    # bad level or a non-path export target raises here, not mid-trace
+    "telemetry": lambda x: isinstance(x, bool),
+    "telemetry_level": lambda x: x in ("basic", "detailed"),
+    "telemetry_export_path": lambda x: x is None or (
         isinstance(x, (str, os.PathLike)) and bool(str(x))
     ),
 }

@@ -366,7 +366,7 @@ def sharded_groupby_reduce(
             if method == "cohorts":
                 import logging
 
-                logging.getLogger("flox_tpu").debug(
+                logging.getLogger("flox_tpu.parallel.mapreduce").debug(
                     "%s: cohorts has no ownership win for order statistics; "
                     "running the distributed radix-select map-reduce program",
                     agg.name,
@@ -420,7 +420,7 @@ def sharded_groupby_reduce(
             method = "cohorts"  # blocked execution lives in the cohorts program
             import logging
 
-            logging.getLogger("flox_tpu").debug(
+            logging.getLogger("flox_tpu.parallel.mapreduce").debug(
                 "dense intermediates ~%s exceed dense_intermediate_bytes_max"
                 " (%s): using the blocked owner-by-owner program",
                 fmt_bytes(est), fmt_bytes(ceiling),
@@ -486,8 +486,11 @@ def sharded_groupby_reduce(
         mesh, arr.ndim, blocked, trace_fingerprint(),
         None if cohort_perm is None else cohort_perm.tobytes(),
     )
+    from .. import telemetry
+
     fn = _PROGRAM_CACHE.get(cache_key)
     if fn is None:
+        telemetry.count("cache.program_misses")
         program = _build_program(
             agg, size=size, size_pad=size_pad, method=method, axis_name=axes,
             shard_len=shard_len, nat=nat, cohort_perm=cohort_perm,
@@ -509,8 +512,17 @@ def sharded_groupby_reduce(
         # jit/shard_map construction is lazy — trace + XLA compile happen
         # on the first call, so THAT is what the build timer must wrap
         with timed(f"sharded program trace+compile+first-run [{agg.name}/{method}]"):
-            return fn(arr, codes_dev)
-    return fn(arr, codes_dev)
+            with telemetry.span(
+                "program-build", agg=agg.name, method=method, ndev=ndev, size=size
+            ):
+                return fn(arr, codes_dev)
+    telemetry.count("cache.program_hits")
+    # the annotation makes the SPMD dispatch visible inside xprof device
+    # traces (jax.profiler.TraceAnnotation) as well as in our own trace
+    with telemetry.annotated(
+        f"flox:mesh-dispatch[{agg.name}/{method}]", ndev=ndev, size=size
+    ):
+        return fn(arr, codes_dev)
 
 
 _PROGRAM_CACHE: dict = {}

@@ -82,8 +82,11 @@ def sharded_groupby_scan(
     from ..options import trace_fingerprint
 
     cache_key = (scan.name, size, axes, mesh, arr.ndim, str(arr.dtype), method, nat, trace_fingerprint())
+    from .. import telemetry
+
     fn = _SCAN_CACHE.get(cache_key)
     if fn is None:
+        telemetry.count("cache.scan_misses")
         if method == "blockwise":
             program = _build_blockwise_scan_program(scan, size=size, nat=nat)
         else:
@@ -94,7 +97,12 @@ def sharded_groupby_scan(
         if len(_SCAN_CACHE) > 256:
             _SCAN_CACHE.clear()
         _SCAN_CACHE[cache_key] = fn
-    out = fn(arr, codes_dev)
+    else:
+        telemetry.count("cache.scan_hits")
+    with telemetry.annotated(
+        f"flox:mesh-scan[{scan.name}/{method}]", ndev=ndev, size=size
+    ):
+        out = fn(arr, codes_dev)
     if pad:
         out = out[..., :n]
     return out

@@ -172,6 +172,16 @@ class SlabStager:
             data, cdev = jnp.asarray(slab), jnp.asarray(cfull)
         offset = jnp.asarray(np.int64(s)) if self.with_offset else None
         t2 = perf_counter()
+        from . import telemetry
+
+        if telemetry.enabled():
+            telemetry.METRICS.inc("bytes.h2d", int(slab.nbytes) + int(cfull.nbytes))
+            if telemetry.detailed():
+                # staging runs on the prefetch workers: standalone spans,
+                # interleaved with the consumer's stream span by timestamp
+                telemetry.record_span(
+                    "stage", t0, t2, attrs={"start": s, "stop": e, "index": index},
+                )
         return Slab(
             index=index, start=s, stop=e, data=data, codes=cdev, codes_host=chost,
             offset=offset, load_ms=(t1 - t0) * 1e3, stage_ms=(t2 - t1) * 1e3,
@@ -292,8 +302,30 @@ def stream_slabs(
     finally:
         if prefetcher is not None:
             prefetcher.close()
-        report.wall_ms = (perf_counter() - t_begin) * 1e3
+        t_end = perf_counter()
+        report.wall_ms = (t_end - t_begin) * 1e3
         record_stream(report)
+        from . import telemetry
+
+        if telemetry.enabled():
+            # one span per streaming pass, carrying the StreamReport totals
+            # as attributes — the report object stays the programmatic API,
+            # the span is its trace-file view
+            telemetry.record_span(
+                f"stream[{label}]" if label else "stream", t_begin, t_end,
+                attrs={
+                    "slabs": len(report.slabs), "nbatches": nbatches,
+                    "prefetch": depth, "skip": skip,
+                    "load_ms": round(report.load_ms, 3),
+                    "stage_ms": round(report.stage_ms, 3),
+                    "wait_ms": round(report.wait_ms, 3),
+                    "dispatch_ms": round(report.dispatch_ms, 3),
+                    "overlap_fraction": round(report.overlap_fraction, 4),
+                    "retries": report.retries,
+                    "oom_splits": report.oom_splits,
+                    "checkpoints": report.checkpoints,
+                },
+            )
 
 
 class _SlabPrefetcher:

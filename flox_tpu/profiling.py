@@ -17,7 +17,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Iterator
 
-logger = logging.getLogger("flox_tpu")
+logger = logging.getLogger("flox_tpu.profiling")
 
 __all__ = ["trace", "annotate", "timed", "stream_monitor", "StreamReport"]
 

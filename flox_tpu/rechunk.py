@@ -22,7 +22,7 @@ from typing import Any
 
 import numpy as np
 
-logger = logging.getLogger("flox_tpu")
+logger = logging.getLogger("flox_tpu.rechunk")
 
 __all__ = ["reshard_for_blockwise", "BlockwiseLayout", "rechunk_for_blockwise", "rechunk_for_cohorts"]
 

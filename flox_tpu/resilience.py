@@ -202,6 +202,14 @@ def call_with_retry(
             attempt += 1
             if counters is not None:
                 counters.record_retry(delay)
+            from . import telemetry
+
+            if telemetry.enabled():
+                telemetry.METRICS.inc("stream.retries")
+                telemetry.event(
+                    "retry", what=what, attempt=attempt,
+                    delay_ms=round(delay * 1e3, 3), error=type(exc).__name__,
+                )
             time.sleep(delay)
 
 
@@ -306,6 +314,11 @@ def _split_dispatch(
         raise cause  # cannot split further: surface the original OOM
     if counters is not None:
         counters.record_split()
+    from . import telemetry
+
+    if telemetry.enabled():
+        telemetry.METRICS.inc("stream.oom_splits")
+        telemetry.event("oom-split", start=s, stop=e, half=half, depth=depth)
     spans = [(ss, min(ss + half, e)) for ss in range(s, e, half)]
     for ss, ee in reversed(spans) if reverse else spans:
         try:
@@ -439,6 +452,14 @@ class StreamCheckpointer:
         if snap is not None and self.counters is not None:
             self.counters.resumed_at = snap.slabs_done
             self.counters.resumed_phase = snap.phase
+        if snap is not None:
+            from . import telemetry
+
+            if telemetry.enabled():
+                telemetry.METRICS.inc("stream.resumes")
+                telemetry.event(
+                    "stream-resume", slabs_done=snap.slabs_done, phase=snap.phase
+                )
         return snap
 
     def tick(
@@ -465,6 +486,16 @@ class StreamCheckpointer:
             _dump_snapshot(self._file(), snap)
         if self.counters is not None:
             self.counters.record_checkpoint()
+        from . import telemetry
+
+        if telemetry.enabled():
+            d2h = sum(
+                int(np.asarray(leaf).nbytes)
+                for leaf in jax.tree_util.tree_leaves(host)
+            )
+            telemetry.METRICS.inc("stream.checkpoints")
+            telemetry.METRICS.inc("bytes.d2h", d2h)
+            telemetry.event("checkpoint", slabs_done=slabs_done, phase=phase, bytes=d2h)
 
     def done(self) -> None:
         """The run completed: drop its snapshot (registry + spill file) so

@@ -24,12 +24,12 @@ from typing import Any
 import numpy as np
 
 from . import factorize as fct
-from . import utils
+from . import telemetry, utils
 from .aggregations import Scan, _initialize_scan
 from .core import _convert_expected_groups_to_index, _normalize_expected, _normalize_isbin
 from .options import OPTIONS
 
-logger = logging.getLogger("flox_tpu")
+logger = logging.getLogger("flox_tpu.scan")
 
 __all__ = ["groupby_scan"]
 
@@ -62,6 +62,30 @@ def groupby_scan(
     ...              func="ffill", engine="numpy")
     array([ 1., nan,  1.,  8.])
     """
+    with telemetry.span(
+        "groupby_scan",
+        func=func if isinstance(func, str) else getattr(func, "name", "custom"),
+        method=method,
+    ):
+        return _groupby_scan_impl(
+            array, *by, func=func, expected_groups=expected_groups, axis=axis,
+            dtype=dtype, method=method, engine=engine, mesh=mesh,
+        )
+
+
+def _groupby_scan_impl(
+    array: Any,
+    *by: Any,
+    func: str | Scan,
+    expected_groups: Any,
+    axis: int,
+    dtype: Any,
+    method: str | None,
+    engine: str | None,
+    mesh: Any,
+) -> Any:
+    """The :func:`groupby_scan` body, under the public wrapper's root span
+    (defaults live only on the wrapper, which forwards everything)."""
     if not by:
         raise TypeError("Must pass at least one `by`")
     if np.ndim(axis) != 0:
@@ -109,9 +133,11 @@ def groupby_scan(
         arr_order = list(range(first_by_ax)) + [first_by_ax + d for d in by_order]
         arr = arr.transpose(arr_order)
 
-    codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
-        bys, axes=(bndim - 1,), expected_groups=expected_idx, sort=True
-    )
+    with telemetry.span("factorize", nby=nby) as _fsp:
+        codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
+            bys, axes=(bndim - 1,), expected_groups=expected_idx, sort=True
+        )
+        _fsp.set(ngroups=ngroups, size=size)
     # factorize_ offsets codes when bndim > 1 (disjoint ranges per row);
     # codes now flatten alongside the trailing by-span of the array.
     codes_flat = np.asarray(codes).reshape(-1)
@@ -181,28 +207,31 @@ def groupby_scan(
         # without a mesh means "distribute over the default mesh"
         from .parallel.scan import sharded_groupby_scan
 
-        out = sharded_groupby_scan(
-            arr_flat, codes_flat, scan, size=size, dtype=dtype, mesh=mesh,
-            method=method or "blelloch", nat=nat,
-        )
+        with telemetry.span("dispatch", method=method or "blelloch", size=size):
+            out = sharded_groupby_scan(
+                arr_flat, codes_flat, scan, size=size, dtype=dtype, mesh=mesh,
+                method=method or "blelloch", nat=nat,
+            )
     else:
-        out = _apply_scan(
-            scan, arr_flat, codes_flat, size=size, engine=engine, dtype=dtype, nat=nat
-        )
+        with telemetry.span("dispatch", engine=engine, size=size):
+            out = _apply_scan(
+                scan, arr_flat, codes_flat, size=size, engine=engine, dtype=dtype, nat=nat
+            )
 
-    # missing labels scan to NaN (NaT for datetimes — they belong to no group)
-    if (np.asarray(codes_flat) < 0).any():
-        nanmask = codes_flat < 0
-        out = _mask_positions(out, nanmask, nat=nat)
+    with telemetry.span("finalize"):
+        # missing labels scan to NaN (NaT for datetimes — they belong to no group)
+        if (np.asarray(codes_flat) < 0).any():
+            nanmask = codes_flat < 0
+            out = _mask_positions(out, nanmask, nat=nat)
 
-    if datetime_dtype is not None:
-        out = np.asarray(out).astype("int64").view(datetime_dtype)
-    out = out.reshape(arr.shape) if out.shape != arr.shape else out
-    out = out.reshape(lead_shape + bys[0].shape)
-    # undo the axis transpose
-    if rel_axis != bndim - 1:
-        inv = np.argsort(arr_order)
-        out = out.transpose(tuple(inv))
+        if datetime_dtype is not None:
+            out = np.asarray(out).astype("int64").view(datetime_dtype)
+        out = out.reshape(arr.shape) if out.shape != arr.shape else out
+        out = out.reshape(lead_shape + bys[0].shape)
+        # undo the axis transpose
+        if rel_axis != bndim - 1:
+            inv = np.argsort(arr_order)
+            out = out.transpose(tuple(inv))
     return out
 
 

@@ -77,15 +77,19 @@ def _mesh_stream_layout(mesh, axis_name, batch_len: int, lead_ndim: int):
 
 
 def _step_cached(key, build):
+    from . import telemetry
     from .options import trace_fingerprint
 
     key = key + (trace_fingerprint(),)
     fn = _STEP_CACHE.get(key)
     if fn is None:
+        telemetry.count("cache.step_misses")
         fn = build()
         if len(_STEP_CACHE) > 256:
             _STEP_CACHE.clear()
         _STEP_CACHE[key] = fn
+    else:
+        telemetry.count("cache.step_hits")
     return fn
 
 
@@ -145,6 +149,42 @@ def streaming_groupby_reduce(
     beyond any single device's ceiling stream too (see
     docs/distributed.md).
     """
+    from . import telemetry
+
+    with telemetry.span(
+        "streaming_groupby_reduce",
+        func=func if isinstance(func, str) else getattr(func, "name", "custom"),
+        mesh=mesh is not None,
+    ):
+        return _streaming_groupby_reduce_impl(
+            array, by, func=func, batch_len=batch_len, batch_bytes=batch_bytes,
+            expected_groups=expected_groups, isbin=isbin, sort=sort, axis=axis,
+            fill_value=fill_value, dtype=dtype, min_count=min_count,
+            finalize_kwargs=finalize_kwargs, mesh=mesh, axis_name=axis_name,
+        )
+
+
+def _streaming_groupby_reduce_impl(
+    array: Any,
+    by: Any,
+    *,
+    func: str | Aggregation,
+    batch_len: int | None,
+    batch_bytes: int,
+    expected_groups: Any,
+    isbin: Any,
+    sort: bool,
+    axis: Any,
+    fill_value: Any,
+    dtype: Any,
+    min_count: int | None,
+    finalize_kwargs: dict | None,
+    mesh: Any,
+    axis_name: str | tuple[str, ...],
+) -> tuple:
+    """The :func:`streaming_groupby_reduce` body, under the public
+    wrapper's root telemetry span (per-pass ``stream[...]`` spans come from
+    ``pipeline.stream_slabs``; defaults live only on the wrapper)."""
     from . import dtypes as dtps
 
     labels = utils.asarray_host(by)
@@ -192,9 +232,13 @@ def streaming_groupby_reduce(
 
     expected = _normalize_expected(expected_groups, 1)
     expected_idx = _convert_expected_groups_to_index(expected, _normalize_isbin(isbin, 1), sort)
-    codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
-        bys, axes=red_axes, expected_groups=expected_idx, sort=sort
-    )
+    from . import telemetry
+
+    with telemetry.span("factorize") as _fsp:
+        codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
+            bys, axes=red_axes, expected_groups=expected_idx, sort=sort
+        )
+        _fsp.set(ngroups=ngroups, size=size)
     # ONE contiguous int32 copy for the whole stream: per-slab slices are
     # then zero-copy contiguous views, so the loop (and the prefetch
     # workers) never re-copy or re-cast codes per slab
@@ -453,29 +497,31 @@ def streaming_groupby_reduce(
             ckpt.tick(lambda: state, slabs_done=done)
 
     if mesh is not None:
-        result = final(state)
+        with telemetry.span("finalize", mesh=True):
+            result = final(state)
+            ckpt.done()
+            from .core import _astype_final, _index_values
+
+            result = _astype_final(result, agg, datetime_dtype)
+            out_shape = tuple(lead_shape) + tuple(keep_by_shape) + grp_shape
+            if result.shape != out_shape:
+                result = result.reshape(out_shape)
+        return (result,) + tuple(_index_values(g) for g in found_groups)
+
+    with telemetry.span("finalize"):
+        inters, counts = state
+        from .parallel.mapreduce import _finalize_combined
+
+        result = _finalize_combined(agg, inters, counts)
         ckpt.done()
         from .core import _astype_final, _index_values
 
         result = _astype_final(result, agg, datetime_dtype)
+        # (..., size) -> (..., *keep_by, *groups): kept by-dims ride the group
+        # axis as disjoint code ranges (factorize_ offsetting) and unfold here
         out_shape = tuple(lead_shape) + tuple(keep_by_shape) + grp_shape
         if result.shape != out_shape:
             result = result.reshape(out_shape)
-        return (result,) + tuple(_index_values(g) for g in found_groups)
-
-    inters, counts = state
-    from .parallel.mapreduce import _finalize_combined
-
-    result = _finalize_combined(agg, inters, counts)
-    ckpt.done()
-    from .core import _astype_final, _index_values
-
-    result = _astype_final(result, agg, datetime_dtype)
-    # (..., size) -> (..., *keep_by, *groups): kept by-dims ride the group
-    # axis as disjoint code ranges (factorize_ offsetting) and unfold here
-    out_shape = tuple(lead_shape) + tuple(keep_by_shape) + grp_shape
-    if result.shape != out_shape:
-        result = result.reshape(out_shape)
     return (result,) + tuple(_index_values(g) for g in found_groups)
 
 
@@ -841,12 +887,37 @@ def streaming_groupby_scan(
     with the cross-slab carry folded at the slab boundary — out-of-core
     AND multi-chip scans, results still streamable through ``out=``.
     """
+    from . import telemetry
+
+    with telemetry.span("streaming_groupby_scan", func=func, mesh=mesh is not None):
+        return _streaming_groupby_scan_impl(
+            array, by, func=func, batch_len=batch_len, batch_bytes=batch_bytes,
+            expected_groups=expected_groups, dtype=dtype, out=out,
+            mesh=mesh, axis_name=axis_name,
+        )
+
+
+def _streaming_groupby_scan_impl(
+    array: Any,
+    by: Any,
+    *,
+    func: str,
+    batch_len: int | None,
+    batch_bytes: int,
+    expected_groups: Any,
+    dtype: Any,
+    out: Callable[[int, int, Any], None] | None,
+    mesh: Any,
+    axis_name: str | tuple[str, ...],
+) -> Any:
+    """The :func:`streaming_groupby_scan` body, under the public wrapper's
+    root telemetry span (defaults live only on the wrapper)."""
     import math
 
     import jax
     import jax.numpy as jnp
 
-    from . import dtypes as dtps
+    from . import dtypes as dtps, telemetry
     from .aggregations import _initialize_scan
     from .core import _convert_expected_groups_to_index, _normalize_expected, _normalize_isbin
     from .kernels import _nan_mask, generic_kernel
@@ -874,9 +945,11 @@ def streaming_groupby_scan(
 
     expected = _normalize_expected(expected_groups, 1)
     expected_idx = _convert_expected_groups_to_index(expected, _normalize_isbin(False, 1), True)
-    codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
-        [labels], axes=(0,), expected_groups=expected_idx, sort=True
-    )
+    with telemetry.span("factorize") as _fsp:
+        codes, found_groups, grp_shape, ngroups, size, props = fct.factorize_(
+            [labels], axes=(0,), expected_groups=expected_idx, sort=True
+        )
+        _fsp.set(ngroups=ngroups, size=size)
     # ONE contiguous int32 copy for the whole stream (per-slab slices are
     # zero-copy contiguous views; see streaming_groupby_reduce)
     codes = np.ascontiguousarray(np.asarray(codes).reshape(-1), dtype=np.int32)

@@ -1,0 +1,709 @@
+"""End-to-end telemetry: hierarchical spans, a metrics registry, and
+compile/retrace tracking for every execution path.
+
+The streaming executor already reported per-slab timings (``StreamReport``),
+but the non-streaming core, mesh, and cohort paths were dark: there was no
+way to answer "where did this groupby spend its time, how many times did it
+compile, and how many bytes crossed H2D". This module is the cross-cutting
+observability layer:
+
+* **Hierarchical spans** (:func:`span`): a contextvar-based tracer. Every
+  execution path opens a root span (``groupby_reduce``, ``groupby_scan``,
+  ``streaming_groupby_reduce``, ...) whose children are the pipeline phases —
+  ``factorize`` / ``dispatch`` / ``combine`` / ``finalize`` eagerly,
+  ``program-build`` / ``dispatch`` on the mesh, per-pass ``stream[...]``
+  spans for the streaming runtimes. Disabled (the default) it is a true
+  no-op: :func:`span` returns one shared singleton, no objects are
+  allocated, no clocks are read.
+* **Metrics registry** (:data:`METRICS`): process-wide counters and gauges —
+  compilations, program-cache hits/misses, retrace events (the runtime
+  complement to floxlint FLX002's static analysis), H2D/D2H bytes, retries,
+  OOM splits, checkpoints. ``cache.clear_all`` resets it with the other
+  process-wide state.
+* **Compile tracking**: a ``jax.monitoring`` listener counts every backend
+  compile and jaxpr trace the process performs (``jax.compiles`` /
+  ``jax.traces`` counters, ``jax.compile_ms`` gauge), so a retrace storm is
+  a number in the report, not a hunch.
+* **Exporters**: JSON-lines (:func:`export_jsonl`) and Chrome trace-event
+  format (:func:`export_chrome_trace`) — the latter loads directly in
+  ``ui.perfetto.dev`` / ``chrome://tracing``. With
+  ``set_options(telemetry_export_path=...)`` (env
+  ``FLOX_TPU_TELEMETRY_EXPORT_PATH``) finished records stream to the path:
+  ``*.jsonl`` appends incrementally, anything else is written as one Chrome
+  trace JSON at :func:`flush` / process exit.
+* **Report CLI**: ``python -m flox_tpu.telemetry report <file>`` prints a
+  per-phase summary table (count / total / mean / max ms) plus the counter
+  snapshot embedded in the export — either format.
+
+Knobs (all validated at set time, mirrored from the environment):
+
+* ``telemetry`` (``FLOX_TPU_TELEMETRY``): master switch, default off.
+* ``telemetry_level`` (``FLOX_TPU_TELEMETRY_LEVEL``): ``"basic"`` records
+  phase spans; ``"detailed"`` adds per-slab staging spans and per-kernel
+  dispatch counters on the hot paths.
+* ``telemetry_export_path`` (``FLOX_TPU_TELEMETRY_EXPORT_PATH``): stream
+  finished records to a file; ``None`` keeps them in the in-process buffer
+  (read with :func:`drain` / :func:`spans`).
+
+Instrumentation never changes results: CI runs the tier-1 suite once with
+``FLOX_TPU_TELEMETRY=1`` and the enabled/disabled bit-identity is asserted
+in tests/test_telemetry.py.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Iterable
+
+__all__ = [
+    "METRICS",
+    "MetricsRegistry",
+    "annotated",
+    "count",
+    "detailed",
+    "drain",
+    "enabled",
+    "event",
+    "export_chrome_trace",
+    "export_jsonl",
+    "flush",
+    "profile_call",
+    "record_span",
+    "reset",
+    "span",
+    "spans",
+]
+
+# perf_counter origin for span timestamps; the wall anchor lets exports
+# carry an absolute start time without re-reading two clocks per span
+_EPOCH = time.perf_counter()
+_WALL0 = time.time()
+
+_PID = os.getpid()
+
+#: buffer cap — a runaway instrumented loop must degrade (drop + count),
+#: never hold the process's memory hostage
+_MAX_RECORDS = 200_000
+
+
+def enabled() -> bool:
+    """Whether telemetry is on (``OPTIONS["telemetry"]``)."""
+    from .options import OPTIONS
+
+    return bool(OPTIONS["telemetry"])
+
+
+def detailed() -> bool:
+    """Whether per-slab / per-kernel detail is on (level ``"detailed"``)."""
+    from .options import OPTIONS
+
+    return bool(OPTIONS["telemetry"]) and OPTIONS["telemetry_level"] == "detailed"
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+class MetricsRegistry:
+    """Process-wide counters and gauges, thread-safe.
+
+    Counters only ever increase (``inc``); gauges hold the latest value
+    (``set_gauge``) or a running max (``max_gauge``). ``snapshot`` returns a
+    plain dict for exports and the bench rows; ``reset`` zeroes everything
+    (wired into ``cache.clear_all``).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+
+    def inc(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def max_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            if value > self._gauges.get(name, float("-inf")):
+                self._gauges[name] = value
+
+    def get(self, name: str, default: float = 0) -> float:
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges.get(name, default)
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            return {**self._counters, **self._gauges}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+METRICS = MetricsRegistry()
+
+
+def count(name: str, value: float = 1) -> None:
+    """Increment a counter — only when telemetry is enabled, so the
+    disabled mode leaves the registry untouched (asserted in tests)."""
+    if enabled():
+        METRICS.inc(name, value)
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+_CURRENT: contextvars.ContextVar["_Span | None"] = contextvars.ContextVar(
+    "flox_tpu_span", default=None
+)
+_IDS = itertools.count(1)
+
+# finished records (span + event dicts) pending export/drain
+_RECORDS: list[dict] = []
+_RECORDS_LOCK = threading.Lock()
+# serializes file appends: concurrent batch flushes from prefetch-worker
+# and consumer threads must not interleave mid-line in the export file
+_EXPORT_LOCK = threading.Lock()
+_EXPORT_STATE: dict[str, Any] = {"atexit": False, "listener": False}
+
+
+class _NoopSpan:
+    """The disabled-mode span: one shared instance, every method a no-op —
+    ``span()`` allocates nothing when telemetry is off."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+    def set(self, **attrs: Any) -> "_NoopSpan":
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    """One live span. Context-manager protocol; ``set`` attaches attributes
+    any time before exit. Finished spans append a plain-dict record to the
+    buffer (and stream to the export path, if configured)."""
+
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "_t0", "_token", "_tid")
+
+    def __init__(self, name: str, attrs: dict) -> None:
+        self.name = name
+        self.attrs = attrs
+        self.span_id = next(_IDS)
+        self.parent_id: int | None = None
+        self._t0 = 0.0
+        self._token: contextvars.Token | None = None
+        self._tid = threading.get_ident()
+
+    def __enter__(self) -> "_Span":
+        parent = _CURRENT.get()
+        self.parent_id = parent.span_id if parent is not None else None
+        self._token = _CURRENT.set(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        t1 = time.perf_counter()
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        _emit(
+            {
+                "type": "span",
+                "name": self.name,
+                "id": self.span_id,
+                "parent": self.parent_id,
+                "tid": self._tid,
+                "ts_us": round((self._t0 - _EPOCH) * 1e6, 1),
+                "dur_us": round((t1 - self._t0) * 1e6, 1),
+                "attrs": self.attrs,
+            }
+        )
+        return False
+
+    def set(self, **attrs: Any) -> "_Span":
+        self.attrs.update(attrs)
+        return self
+
+
+def span(name: str, **attrs: Any):
+    """Open a hierarchical span: ``with telemetry.span("factorize"): ...``.
+
+    Returns the shared no-op singleton when telemetry is disabled — no
+    allocation, no clock read. Nesting is tracked through a contextvar, so
+    spans opened on worker threads become roots of their own stacks (they
+    still interleave correctly by timestamp in the trace view).
+    """
+    if not enabled():
+        return _NOOP
+    _bootstrap()
+    return _Span(name, attrs)
+
+
+def annotated(name: str, **attrs: Any):
+    """A span that ALSO opens a ``jax.profiler.TraceAnnotation``, so the
+    region shows up inside xprof/TensorBoard device traces next to the XLA
+    ops it covers (the mesh dispatch paths use this). Falls back to a plain
+    span if the profiler API is unavailable."""
+    if not enabled():
+        return _NOOP
+    try:
+        import jax
+
+        annotation = jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 — profiling must never break execution
+        return span(name, **attrs)
+    return _AnnotatedSpan(span(name, **attrs), annotation)
+
+
+class _AnnotatedSpan:
+    __slots__ = ("_span", "_annotation")
+
+    def __init__(self, sp: Any, annotation: Any) -> None:
+        self._span = sp
+        self._annotation = annotation
+
+    def __enter__(self) -> Any:
+        self._span.__enter__()
+        self._annotation.__enter__()
+        return self._span
+
+    def __exit__(self, *exc: Any) -> bool:
+        self._annotation.__exit__(*exc)
+        return self._span.__exit__(*exc)
+
+
+def record_span(
+    name: str,
+    t0: float,
+    t1: float,
+    attrs: dict | None = None,
+    parent_id: int | None = None,
+) -> None:
+    """Record an already-timed span (``t0``/``t1`` from ``perf_counter``).
+
+    For code that cannot hold a ``with`` block open across its timing — the
+    streaming generator records one span per finished pass this way, with
+    the ``StreamReport`` totals as attributes."""
+    if not enabled():
+        return
+    _bootstrap()
+    if parent_id is None:
+        parent = _CURRENT.get()
+        parent_id = parent.span_id if parent is not None else None
+    _emit(
+        {
+            "type": "span",
+            "name": name,
+            "id": next(_IDS),
+            "parent": parent_id,
+            "tid": threading.get_ident(),
+            "ts_us": round((t0 - _EPOCH) * 1e6, 1),
+            "dur_us": round((t1 - t0) * 1e6, 1),
+            "attrs": attrs or {},
+        }
+    )
+
+
+def event(name: str, **attrs: Any) -> None:
+    """Record an instant event (retry, OOM split, checkpoint, resume).
+
+    Events are standalone records — resilience events fire on prefetch
+    worker threads where no span context exists, and an instant mark at the
+    right timestamp is exactly what the trace view needs there."""
+    if not enabled():
+        return
+    _bootstrap()
+    parent = _CURRENT.get()
+    _emit(
+        {
+            "type": "event",
+            "name": name,
+            "id": next(_IDS),
+            "parent": parent.span_id if parent is not None else None,
+            "tid": threading.get_ident(),
+            "ts_us": round((time.perf_counter() - _EPOCH) * 1e6, 1),
+            "attrs": attrs,
+        }
+    )
+
+
+def current_set(**attrs: Any) -> None:
+    """Attach attributes to the innermost live span, if any."""
+    sp = _CURRENT.get() if enabled() else None
+    if sp is not None:
+        sp.attrs.update(attrs)
+
+
+#: jsonl streaming appends in batches of this many records — one
+#: open/write/close per span would compete with the prefetch workers the
+#: pipeline exists to keep busy (flush() and atexit drain the remainder)
+_JSONL_BATCH = 64
+
+
+def _emit(record: dict) -> None:
+    from .options import OPTIONS
+
+    path = OPTIONS["telemetry_export_path"]
+    with _RECORDS_LOCK:
+        if len(_RECORDS) >= _MAX_RECORDS:
+            METRICS.inc("telemetry.dropped_records")
+            return
+        _RECORDS.append(record)
+        stream_now = (
+            path is not None
+            and str(path).endswith(".jsonl")
+            and len(_RECORDS) >= _JSONL_BATCH
+        )
+        batch = list(_RECORDS) if stream_now else None
+        if stream_now:
+            _RECORDS.clear()
+    if stream_now and batch:
+        _append_jsonl(str(path), batch)
+
+
+def _bootstrap() -> None:
+    """One-time side wiring for an enabled session: the atexit flush and the
+    jax.monitoring compile listener."""
+    if not _EXPORT_STATE["atexit"]:
+        _EXPORT_STATE["atexit"] = True
+        import atexit
+
+        atexit.register(flush)
+    if not _EXPORT_STATE["listener"]:
+        _EXPORT_STATE["listener"] = True
+        _install_jax_listener()
+
+
+def _install_jax_listener() -> None:
+    """Count every backend compile / jaxpr trace the process performs.
+
+    The listener registers once and gates on :func:`enabled` per event, so a
+    later ``set_options(telemetry=False)`` stops the counting without
+    needing (unsupported) listener removal. A jax without ``monitoring``
+    degrades to the cache-layer counters only."""
+    try:
+        from jax import monitoring
+    except Exception:  # noqa: BLE001 — version drift must not break import
+        return
+
+    def _on_duration(name: str, duration_s: float, **kw: Any) -> None:
+        if not enabled():
+            return
+        if name.endswith("backend_compile_duration"):
+            METRICS.inc("jax.compiles")
+            METRICS.inc("jax.compile_ms", duration_s * 1e3)
+        elif name.endswith("jaxpr_trace_duration"):
+            # every trace counts; re-traces of an already-compiled program
+            # show up as traces in excess of compiles — the runtime
+            # complement to floxlint FLX002's static recompile-trap analysis
+            METRICS.inc("jax.traces")
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # noqa: BLE001
+        return
+
+
+# ---------------------------------------------------------------------------
+# buffer access + exporters
+# ---------------------------------------------------------------------------
+
+
+def spans() -> list[dict]:
+    """A copy of the buffered records (spans + events), oldest first."""
+    with _RECORDS_LOCK:
+        return list(_RECORDS)
+
+
+def drain() -> list[dict]:
+    """Remove and return all buffered records."""
+    with _RECORDS_LOCK:
+        out = list(_RECORDS)
+        _RECORDS.clear()
+    return out
+
+
+def reset() -> None:
+    """Clear the record buffer AND the metrics registry (tests;
+    ``cache.clear_all`` calls :meth:`MetricsRegistry.reset` too)."""
+    with _RECORDS_LOCK:
+        _RECORDS.clear()
+    METRICS.reset()
+
+
+def _counters_record() -> dict:
+    return {"type": "counters", "counters": METRICS.snapshot(), "wall0": _WALL0}
+
+
+def export_jsonl(path: str, records: Iterable[dict] | None = None) -> None:
+    """Write records as JSON-lines: one record object per line, with a final
+    ``{"type": "counters", ...}`` snapshot line."""
+    records = spans() if records is None else list(records)
+    parent = os.path.dirname(str(path))
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+        f.write(json.dumps(_counters_record()) + "\n")
+
+
+def _append_jsonl(path: str, records: list[dict]) -> None:
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with _EXPORT_LOCK, open(path, "a") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def to_chrome_trace(records: Iterable[dict] | None = None) -> dict:
+    """Records -> one Chrome trace-event JSON object (Perfetto-loadable).
+
+    Spans become complete (``"ph": "X"``) events, instants become
+    thread-scoped instant (``"ph": "i"``) events; the counter snapshot rides
+    the top-level ``floxTpuCounters`` key (the trace-event format allows
+    extra top-level metadata keys)."""
+    records = spans() if records is None else list(records)
+    trace_events = []
+    for rec in records:
+        if rec.get("type") == "span":
+            trace_events.append(
+                {
+                    "name": rec["name"],
+                    "ph": "X",
+                    "ts": rec["ts_us"],
+                    "dur": rec["dur_us"],
+                    "pid": _PID,
+                    "tid": rec["tid"],
+                    "args": rec.get("attrs") or {},
+                }
+            )
+        elif rec.get("type") == "event":
+            trace_events.append(
+                {
+                    "name": rec["name"],
+                    "ph": "i",
+                    "s": "t",
+                    "ts": rec["ts_us"],
+                    "pid": _PID,
+                    "tid": rec["tid"],
+                    "args": rec.get("attrs") or {},
+                }
+            )
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "floxTpuCounters": METRICS.snapshot(),
+        "floxTpuWall0": _WALL0,
+    }
+
+
+def export_chrome_trace(path: str, records: Iterable[dict] | None = None) -> None:
+    """Write a Chrome trace-event JSON file — open it in ``ui.perfetto.dev``
+    (Open trace file) or ``chrome://tracing``."""
+    payload = to_chrome_trace(records)
+    parent = os.path.dirname(str(path))
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)  # a crash mid-write never leaves a truncated trace
+
+
+def flush() -> None:
+    """Write buffered records to ``OPTIONS["telemetry_export_path"]``.
+
+    ``*.jsonl`` paths stream incrementally as spans finish, so flush only
+    appends the final counters line; any other path is (re)written as one
+    Chrome trace JSON. No export path -> records stay in the buffer. Runs
+    at process exit for enabled sessions."""
+    from .options import OPTIONS
+
+    path = OPTIONS["telemetry_export_path"]
+    if path is None:
+        return
+    path = str(path)
+    if path.endswith(".jsonl"):
+        pending = drain()
+        _append_jsonl(path, pending + [_counters_record()])
+    else:
+        export_chrome_trace(path)
+
+
+def profile_call(fn: Any) -> dict:
+    """Run ``fn()`` once with telemetry enabled and return a compact profile:
+    compile/trace counts, compile wall, H2D bytes, and the span-phase
+    breakdown in ms. The bench harnesses embed this in their JSON rows so a
+    benchmark round is diagnosable after the fact (was it a retrace storm? a
+    numpy-engine fallback? staging-bound?) — including on CPU fallback,
+    where the throughput number alone says nothing."""
+    from .options import set_options
+
+    base = METRICS.snapshot()
+    with _RECORDS_LOCK:
+        mark = len(_RECORDS)
+    # export_path=None for the call: a configured .jsonl path would stream
+    # records OUT of the buffer as they finish and the slice below would
+    # see nothing — the profile must capture its own spans
+    with set_options(telemetry=True, telemetry_export_path=None):
+        _bootstrap()  # the compile listener must be live before fn traces
+        fn()
+    with _RECORDS_LOCK:
+        records = list(_RECORDS[mark:])
+    after = METRICS.snapshot()
+    delta = {k: after[k] - base.get(k, 0) for k in after}
+    from . import cache
+
+    return {
+        "compile_count": int(delta.get("jax.compiles", 0)),
+        "trace_count": int(delta.get("jax.traces", 0)),
+        "compile_ms": round(delta.get("jax.compile_ms", 0.0), 1),
+        "h2d_bytes": int(delta.get("bytes.h2d", 0)),
+        "phase_ms": {
+            row["name"]: round(row["total_ms"], 3) for row in summarize(records)
+        },
+        "cache_sizes": cache.stats(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# report CLI: python -m flox_tpu.telemetry report <file>
+# ---------------------------------------------------------------------------
+
+
+def _load_export(path: str) -> tuple[list[dict], dict]:
+    """Parse either export format back to (span records, counters).
+
+    Format detection is by content, not extension: a Chrome trace is ONE
+    JSON document with a ``traceEvents`` key; anything that fails a
+    whole-file parse (or parses to a non-trace object) is read as
+    JSON-lines — every record line there is an object too, so peeking at
+    the first byte cannot distinguish them."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        payload = json.loads(text)
+    except json.JSONDecodeError:
+        payload = None
+    if isinstance(payload, dict) and "traceEvents" in payload:
+        counters = payload.get("floxTpuCounters", {})
+        spans_ = [
+            {
+                "type": "span" if ev.get("ph") == "X" else "event",
+                "name": ev.get("name", "?"),
+                "ts_us": ev.get("ts", 0.0),
+                "dur_us": ev.get("dur", 0.0),
+                "attrs": ev.get("args", {}),
+            }
+            for ev in payload.get("traceEvents", [])
+        ]
+        return spans_, counters
+    counters: dict = {}
+    spans_ = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        rec = json.loads(line)
+        if rec.get("type") == "counters":
+            # later snapshots supersede earlier ones (append-mode files
+            # may carry one per flush)
+            counters = rec.get("counters", {})
+        else:
+            spans_.append(rec)
+    return spans_, counters
+
+
+def summarize(records: list[dict]) -> list[dict]:
+    """Aggregate span records per name: count / total / mean / max ms,
+    sorted by total descending."""
+    agg: dict[str, dict] = {}
+    for rec in records:
+        if rec.get("type") != "span":
+            continue
+        row = agg.setdefault(
+            rec["name"], {"name": rec["name"], "count": 0, "total_ms": 0.0, "max_ms": 0.0}
+        )
+        dur_ms = rec.get("dur_us", 0.0) / 1e3
+        row["count"] += 1
+        row["total_ms"] += dur_ms
+        row["max_ms"] = max(row["max_ms"], dur_ms)
+    out = sorted(agg.values(), key=lambda r: -r["total_ms"])
+    for row in out:
+        row["mean_ms"] = row["total_ms"] / row["count"] if row["count"] else 0.0
+    return out
+
+
+def _report_lines(path: str) -> list[str]:
+    records, counters = _load_export(path)
+    rows = summarize(records)
+    nevents = sum(1 for r in records if r.get("type") == "event")
+    lines = [
+        f"telemetry report — {path}",
+        f"{len(records) - nevents} span(s), {nevents} event(s)",
+        "",
+        f"{'phase':<40} {'count':>7} {'total ms':>12} {'mean ms':>10} {'max ms':>10}",
+        "-" * 82,
+    ]
+    for row in rows:
+        lines.append(
+            f"{row['name'][:40]:<40} {row['count']:>7} {row['total_ms']:>12.2f} "
+            f"{row['mean_ms']:>10.3f} {row['max_ms']:>10.2f}"
+        )
+    if counters:
+        lines += ["", "counters/gauges:"]
+        for name in sorted(counters):
+            value = counters[name]
+            shown = f"{value:.2f}" if isinstance(value, float) and value % 1 else f"{int(value)}"
+            lines.append(f"  {name:<40} {shown:>14}")
+    return lines
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m flox_tpu.telemetry",
+        description="Inspect flox_tpu telemetry exports.",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    rep = sub.add_parser("report", help="per-phase summary table of an export file")
+    rep.add_argument("file", help="a .jsonl or Chrome-trace .json telemetry export")
+    args = parser.parse_args(argv)
+    try:
+        lines = _report_lines(args.file)
+    except OSError as exc:
+        parser.error(f"cannot read {args.file}: {exc}")
+    except (json.JSONDecodeError, KeyError, TypeError) as exc:
+        parser.error(f"{args.file} is not a telemetry export: {exc}")
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

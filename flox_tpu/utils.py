@@ -79,6 +79,12 @@ def asarray_device(x: Any):
     x = np.asarray(x)
     if dtypes.is_datetime_like(x.dtype):
         x = x.view("int64")
+    from . import telemetry
+
+    if telemetry.enabled():
+        # host -> device staging bytes (the streaming pipeline's device_put
+        # counts its own in pipeline.SlabStager)
+        telemetry.METRICS.inc("bytes.h2d", int(x.nbytes))
     return jnp.asarray(x)
 
 

@@ -51,8 +51,8 @@ def test_fixture_corpus_is_nonempty():
 @pytest.mark.parametrize(
     "fixture",
     ["flx001_host_sync.py", "flx002_recompile_traps.py", "flx003_dtype_policy.py",
-     "flx004_version_gated.py", "flx006_swallow.py", "clean_module.py",
-     "suppressed.py"],
+     "flx004_version_gated.py", "flx006_swallow.py", "flx007_eager_logging.py",
+     "clean_module.py", "suppressed.py"],
 )
 def test_fixture_findings_match_markers(fixture):
     path = FIXTURES / fixture
@@ -131,6 +131,35 @@ def test_swallowed_retry_exception_fails(tmp_path):
         "                raise\n"
     )
     assert not [f for f in lint_file(good) if f.rule == "FLX006"]
+
+
+def test_eager_logging_reintroduction_fails(tmp_path):
+    # ISSUE 4 satellite: hot-path logging that formats eagerly (f-string)
+    # or prints straight to stdout must fail the lint; the lazy %-style
+    # spelling and CLI-surface prints stay clean
+    bad = tmp_path / "regress_eager_log.py"
+    bad.write_text(
+        "import logging\n\n"
+        "logger = logging.getLogger('flox_tpu.regress')\n\n"
+        "def hot_path(ngroups, result):\n"
+        "    logger.debug(f'ngroups={ngroups}')\n"
+        "    print(result)\n"
+    )
+    rc = floxlint_main([str(bad)])
+    assert rc == 1
+    assert sum(f.rule == "FLX007" for f in lint_file(bad)) == 2
+    good = tmp_path / "clean_log.py"
+    good.write_text(
+        "import logging\n\n"
+        "logger = logging.getLogger('flox_tpu.regress')\n\n"
+        "def hot_path(ngroups):\n"
+        "    logger.debug('ngroups=%d', ngroups)\n\n"
+        "def main():\n"
+        "    print('cli output is fine here')\n\n"
+        "if __name__ == '__main__':\n"
+        "    main()\n"
+    )
+    assert not [f for f in lint_file(good) if f.rule == "FLX007"]
 
 
 def test_streaming_step_closure_host_sync_fails(tmp_path):

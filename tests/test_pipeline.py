@@ -272,6 +272,10 @@ def test_clear_all_empties_every_named_cache():
         elif isinstance(obj, list):
             for i in range(len(obj)):
                 obj[i] = 1234
+        elif hasattr(obj, "inc") and hasattr(obj, "snapshot"):
+            # telemetry.MetricsRegistry (ISSUE 4): bypasses the enabled()
+            # gate on purpose — we are testing the reset, not the gate
+            obj.inc("__clear_all_probe__")
     cache.clear_all()
 
     checked = 0
@@ -285,6 +289,9 @@ def test_clear_all_empties_every_named_cache():
             checked += 1
         elif hasattr(obj, "cache_info"):  # functools.lru_cache wrapper
             assert obj.cache_info().currsize == 0, f"{mod.__name__}.{name} not cleared"
+            checked += 1
+        elif hasattr(obj, "inc") and hasattr(obj, "snapshot"):
+            assert obj.snapshot() == {}, f"{mod.__name__}.{name} not reset"
             checked += 1
         else:
             raise AssertionError(

@@ -1,0 +1,71 @@
+"""Seeded FLX007 violations: eager-formatted logging and bare print in
+library code.
+
+Every violating line carries the corpus's trailing expect-marker; the clean
+shapes below pin the rule's negative space (lazy %-args, constant messages,
+prints inside main()/__main__ guards, non-logger .debug attributes).
+"""
+
+import logging
+
+logger = logging.getLogger("flox_tpu.fixture")
+log = logging.getLogger("flox_tpu.fixture.child")
+
+
+def eager_fstring(ngroups):
+    logger.debug(f"ngroups={ngroups}")  # expect: FLX007
+
+
+def eager_percent(size):
+    logger.info("size=%d" % size)  # expect: FLX007
+
+
+def eager_concat(name):
+    logger.warning("failed for " + name)  # expect: FLX007
+
+
+def eager_format(path):
+    log.error("cannot read {}".format(path))  # expect: FLX007
+
+
+def eager_log_method(level, n):
+    logger.log(level, f"slabs={n}")  # expect: FLX007
+
+
+def eager_inline_getlogger(x):
+    logging.getLogger("flox_tpu").debug(f"x={x}")  # expect: FLX007
+
+
+def bare_print(result):
+    print(result)  # expect: FLX007
+
+
+def clean_lazy_args(ngroups, size):
+    logger.debug("ngroups=%d size=%d", ngroups, size)
+
+
+def clean_constant_message():
+    logger.info("stream finished")
+
+
+def clean_exception_lazy(exc):
+    logger.warning("retrying after %s", exc)
+
+
+def clean_not_a_logger(tracer, x):
+    # .debug on a non-logger receiver is not a logging call
+    tracer.debug(f"x={x}")
+
+
+def clean_numeric_binop(a, b):
+    logger.debug("%s", a + b)
+
+
+def main(argv=None):
+    # the CLI surface: print IS the output channel here
+    print("report follows")
+    return 0
+
+
+if __name__ == "__main__":
+    print("running fixture as a script")

@@ -8,6 +8,7 @@ from .rules.flx003_dtype import DtypePolicyRule
 from .rules.flx004_version import VersionGatedApiRule
 from .rules.flx005_api import UntypedPublicApiRule
 from .rules.flx006_swallow import SwallowedRetryExceptionRule
+from .rules.flx007_logging import EagerLoggingRule
 
 #: id -> rule instance, in id order
 RULES = {
@@ -19,6 +20,7 @@ RULES = {
         VersionGatedApiRule(),
         UntypedPublicApiRule(),
         SwallowedRetryExceptionRule(),
+        EagerLoggingRule(),
     )
 }
 

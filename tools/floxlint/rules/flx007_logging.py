@@ -1,0 +1,157 @@
+"""FLX007 — eager-formatted logging and bare ``print`` in library code.
+
+``logger.debug(f"ngroups={ngroups}")`` formats its message on EVERY call,
+whether or not the debug level is enabled — on a hot path (per-slab, per
+kernel dispatch) that is real work burned for messages nobody sees. The
+lazy form, ``logger.debug("ngroups=%d", ngroups)``, defers formatting to
+the logging framework, which skips it when the level is off. The same
+applies to ``%``-interpolated, concatenated, and ``str.format`` message
+arguments. ``logging.Logger`` supports exactly this, so the eager spellings
+are always avoidable.
+
+Bare ``print()`` in library code bypasses the logging tree entirely: users
+cannot filter, redirect, or silence it, and on a worker thread it interleaves
+arbitrarily. Library modules must log (or go through the telemetry layer);
+``print`` belongs to CLI entry points only — calls inside a function named
+``main`` (the sanctioned CLI entry convention, e.g.
+``flox_tpu.telemetry.main``) or under ``if __name__ == "__main__":`` are
+exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding
+
+#: logging method names whose first positional argument is a message
+_LOG_METHODS = frozenset(
+    {"debug", "info", "warning", "warn", "error", "exception", "critical", "log"}
+)
+
+#: receiver names that mark the call as a logging call (logger.debug /
+#: log.warning / logging.info); anything else named .debug() is not ours
+_LOGGER_NAMES = frozenset({"logger", "log", "logging"})
+
+
+class EagerLoggingRule:
+    id = "FLX007"
+    name = "eager-logging"
+    description = (
+        "f-string/%/.format()-formatted logging calls (formatted even when the "
+        "level is off) and bare print() in library code"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        exempt = _cli_exempt_nodes(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            finding = self._check_print(ctx, node, exempt) or self._check_log(ctx, node)
+            if finding is not None:
+                yield finding
+
+    def _check_print(
+        self, ctx: FileContext, node: ast.Call, exempt: set[int]
+    ) -> Finding | None:
+        if not (isinstance(node.func, ast.Name) and node.func.id == "print"):
+            return None
+        if id(node) in exempt:
+            return None
+        return Finding(
+            path=ctx.display_path,
+            line=node.lineno,
+            col=node.col_offset,
+            rule=self.id,
+            message=(
+                "bare print() in library code cannot be filtered or redirected; "
+                "log through the module's `flox_tpu.*` child logger (print is "
+                "fine in `main()` CLI entry points and under "
+                '`if __name__ == "__main__":`)'
+            ),
+        )
+
+    def _check_log(self, ctx: FileContext, node: ast.Call) -> Finding | None:
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr in _LOG_METHODS):
+            return None
+        receiver = func.value
+        recv_name = None
+        if isinstance(receiver, ast.Name):
+            recv_name = receiver.id
+        elif isinstance(receiver, ast.Attribute):
+            recv_name = receiver.attr
+        elif isinstance(receiver, ast.Call):
+            # logging.getLogger(...).debug(...)
+            inner = receiver.func
+            if isinstance(inner, ast.Attribute) and inner.attr == "getLogger":
+                recv_name = "logger"
+        if recv_name is None or recv_name.lower() not in _LOGGER_NAMES:
+            return None
+        # .log(level, msg, ...) carries the message second
+        args = node.args[1:] if func.attr == "log" else node.args
+        if not args:
+            return None
+        msg = args[0]
+        how = _eager_kind(msg)
+        if how is None:
+            return None
+        return Finding(
+            path=ctx.display_path,
+            line=msg.lineno,
+            col=msg.col_offset,
+            rule=self.id,
+            message=(
+                f"{how} logging message is formatted even when the level is "
+                'off; use lazy %-style args: logger.debug("x=%s", x)'
+            ),
+        )
+
+
+def _eager_kind(msg: ast.AST) -> str | None:
+    """The eager-formatting kind of a message argument, or None if lazy."""
+    if isinstance(msg, ast.JoinedStr):
+        return "f-string"
+    if isinstance(msg, ast.BinOp) and isinstance(msg.op, (ast.Mod, ast.Add)):
+        # "x=%s" % x  /  "x=" + str(x): only flag when a string literal is
+        # visibly involved — arithmetic between names is not a message build
+        for side in (msg.left, msg.right):
+            if isinstance(side, ast.Constant) and isinstance(side.value, str):
+                return "%-interpolated" if isinstance(msg.op, ast.Mod) else "concatenated"
+        return None
+    if (
+        isinstance(msg, ast.Call)
+        and isinstance(msg.func, ast.Attribute)
+        and msg.func.attr == "format"
+    ):
+        return ".format()-built"
+    return None
+
+
+def _cli_exempt_nodes(tree: ast.Module) -> set[int]:
+    """ids of Call nodes inside a ``main`` function or an
+    ``if __name__ == "__main__":`` block — the CLI surface where print IS
+    the output channel."""
+    exempt: set[int] = set()
+    for node in ast.walk(tree):
+        is_main_fn = (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name in ("main", "_main")
+        )
+        is_main_guard = isinstance(node, ast.If) and _is_name_main_test(node.test)
+        if is_main_fn or is_main_guard:
+            for sub in ast.walk(node):
+                exempt.add(id(sub))
+    return exempt
+
+
+def _is_name_main_test(test: ast.AST) -> bool:
+    if not (isinstance(test, ast.Compare) and len(test.comparators) == 1):
+        return False
+    sides = (test.left, test.comparators[0])
+    has_name = any(isinstance(s, ast.Name) and s.id == "__name__" for s in sides)
+    has_main = any(
+        isinstance(s, ast.Constant) and s.value == "__main__" for s in sides
+    )
+    return has_name and has_main
