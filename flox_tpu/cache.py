@@ -53,6 +53,14 @@ def clear_all() -> None:
     from .cohorts import _COHORTS_CACHE
     from .core import _jitted_bundle
     from .factorize import _FACTORIZE_CACHE, _FACTORIZE_CACHE_BYTES
+    from .kernels import (
+        _PALLAS_COMPILE_PROBE,
+        _PALLAS_MINMAX_COMPILE_PROBE,
+        _PALLAS_MINMAX_PROBE_RESULT,
+        _PALLAS_PROBE_RESULT,
+        _PALLAS_SCAN_COMPILE_PROBE,
+        _PALLAS_SCAN_PROBE_RESULT,
+    )
     from .parallel.mapreduce import _PROGRAM_CACHE
     from .parallel.scan import _SCAN_CACHE
     from .pipeline import _DONATION_OK
@@ -68,5 +76,15 @@ def clear_all() -> None:
     _STEP_CACHE.clear()
     _DONATION_OK.clear()
     _SNAPSHOTS.clear()
+    # pallas one-time probe memos (floxlint FLX008: every runtime-accreted
+    # module-level cache must be reachable from here) — the next reduction
+    # after a clear re-validates the backend, which is exactly the fresh
+    # state a between-rounds clear promises
+    _PALLAS_PROBE_RESULT.clear()
+    _PALLAS_COMPILE_PROBE.clear()
+    _PALLAS_MINMAX_PROBE_RESULT.clear()
+    _PALLAS_MINMAX_COMPILE_PROBE.clear()
+    _PALLAS_SCAN_PROBE_RESULT.clear()
+    _PALLAS_SCAN_COMPILE_PROBE.clear()
     _jitted_bundle.cache_clear()
     METRICS.reset()

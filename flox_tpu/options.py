@@ -24,19 +24,28 @@ def _env_int(name: str, default: int, lo: int = 0, hi: int | None = None) -> int
     return value
 
 
-def _env_float(name: str, default: float, lo: float = 0.0) -> float:
+def _env_float(
+    name: str,
+    default: float,
+    lo: float = 0.0,
+    hi: float | None = None,
+    lo_open: bool = False,
+) -> float:
     """Float analogue of :func:`_env_int` (retry backoff / deadline knobs);
     same fall-back-not-crash contract for malformed env values. Non-finite
     values fall back too: ``nan`` would reach ``time.sleep`` mid-retry and
     ``inf`` would sleep forever — the validators reject both, and the env
-    must not be able to seed what ``set_options`` refuses."""
+    must not be able to seed what ``set_options`` refuses. ``hi`` and
+    ``lo_open`` mirror validator bounds of the ``0 < x <= 1`` shape."""
     import math
 
     try:
         value = float(os.environ.get(name, default))
     except ValueError:
         return default
-    if not math.isfinite(value) or value < lo:
+    if not math.isfinite(value):
+        return default
+    if value < lo or (lo_open and value == lo) or (hi is not None and value > hi):
         return default
     return value
 
@@ -52,22 +61,28 @@ def _env_choice(name: str, default: str, valid: tuple[str, ...]) -> str:
 OPTIONS: dict[str, Any] = {
     # Resharding-for-blockwise is applied automatically only when the change
     # it would make is small (same spirit as options.py:9-18).
-    "rechunk_blockwise_num_chunks_threshold": 0.25,
-    "rechunk_blockwise_chunk_size_threshold": 1.5,
+    "rechunk_blockwise_num_chunks_threshold": _env_float(
+        "FLOX_TPU_RECHUNK_BLOCKWISE_NUM_CHUNKS_THRESHOLD", 0.25, 0.0, 1.0, lo_open=True
+    ),
+    "rechunk_blockwise_chunk_size_threshold": _env_float(
+        "FLOX_TPU_RECHUNK_BLOCKWISE_CHUNK_SIZE_THRESHOLD", 1.5, 1.0
+    ),
     # TPU policy knobs (no reference analogue):
     # default engine for device arrays
-    "default_engine": "jax",
+    "default_engine": _env_choice("FLOX_TPU_DEFAULT_ENGINE", "jax", ("jax", "numpy")),
     # additive segment reductions with at most this many groups may use the
     # one-hot matmul (MXU) or Pallas path instead of scatter-add
-    "matmul_num_groups_max": 384,
+    "matmul_num_groups_max": _env_int("FLOX_TPU_MATMUL_NUM_GROUPS_MAX", 384, 0),
     # segment-sum implementation: "auto" on TPU tries pallas (after a
     # one-time runtime validation), then the one-hot GEMM (matmul) when its
     # footprint guards pass, then scatter; off-TPU auto is always scatter.
     # Explicit "scatter" | "matmul" | "pallas" override.
-    "segment_sum_impl": "auto",
+    "segment_sum_impl": _env_choice(
+        "FLOX_TPU_SEGMENT_SUM_IMPL", "auto", ("auto", "scatter", "matmul", "pallas")
+    ),
     # group-count ceiling for the Pallas path (VMEM-bounded; independent of
     # the matmul knob so disabling one path does not disable the other)
-    "pallas_num_groups_max": 512,
+    "pallas_num_groups_max": _env_int("FLOX_TPU_PALLAS_NUM_GROUPS_MAX", 512, 0, 512),
     # Cross-tile accumulation discipline for the Pallas segment-sum, on
     # hardware without float64:
     #   "plain" — a bare f32 running sum (fastest, drifts over many tiles)
@@ -76,40 +91,46 @@ OPTIONS: dict[str, Any] = {
     #   "dd"    — double-double (2×f32 hi/lo carry) with Dekker-split
     #             contractions, for strict-parity users chasing the
     #             float64 oracle (BASELINE "bit-exact float64 means")
-    "pallas_accum": "kahan",
+    "pallas_accum": _env_choice("FLOX_TPU_PALLAS_ACCUM", "kahan", ("plain", "kahan", "dd")),
     # per-block budget for the GEMM path's (N, 4*kb) marker stacking; wide-K
     # inputs loop column blocks of this many bytes instead of materializing
     # the whole stacking (256 MB default: big enough to keep the MXU fed,
     # small next to HBM)
-    "matmul_block_bytes": 2**28,
+    "matmul_block_bytes": _env_int("FLOX_TPU_MATMUL_BLOCK_BYTES", 2**28, 2**20),
     # segment-min/max implementation: "auto" on TPU uses the Pallas VPU
     # select-reduce kernel (after runtime validation) instead of scatter,
     # which serializes; off-TPU auto is scatter. Explicit override as above.
-    "segment_minmax_impl": "auto",
+    "segment_minmax_impl": _env_choice(
+        "FLOX_TPU_SEGMENT_MINMAX_IMPL", "auto", ("auto", "scatter", "pallas")
+    ),
     # the min/max kernel's VPU work grows linearly with the group count
     # (one select+reduce pass per group per tile); past this many groups the
     # kernel is no longer clearly ahead of scatter
-    "pallas_minmax_num_groups_max": 128,
+    "pallas_minmax_num_groups_max": _env_int(
+        "FLOX_TPU_PALLAS_MINMAX_NUM_GROUPS_MAX", 128, 0, 512
+    ),
     # grouped cumulative scans: "auto" on TPU uses the Pallas triangular-
     # matmul kernel (one HBM pass) instead of the sort + log-depth
     # segmented scan; off-TPU auto stays on the segmented path.
-    "scan_impl": "auto",
+    "scan_impl": _env_choice("FLOX_TPU_SCAN_IMPL", "auto", ("auto", "segmented", "pallas")),
     # the scan kernel's carry gather/update matmuls scale with the group
     # count; past ~the lane-tile width they dominate the triangular matmul
-    "pallas_scan_num_groups_max": 128,
+    "pallas_scan_num_groups_max": _env_int("FLOX_TPU_PALLAS_SCAN_NUM_GROUPS_MAX", 128, 0, 512),
     # grouped order statistics: "sort" = two-key lexicographic lax.sort;
     # "select" = sort-free MSB radix bisection — nbits counting passes,
     # each a segment-sum riding the MXU one-hot GEMM / Pallas path. "auto"
     # currently resolves to sort; the bench sweep measures both on chip
     # (VERDICT r3 #3) and auto flips when hardware numbers justify it.
-    "quantile_impl": "auto",
+    "quantile_impl": _env_choice("FLOX_TPU_QUANTILE_IMPL", "auto", ("auto", "sort", "select")),
     # HBM ceiling for dense (..., size) device intermediates (VERDICT r3 #6:
     # a ~10^6-label run used to OOM with no guard). Estimated footprint
     # above this either auto-routes map-reduce/cohorts to the blocked
     # psum-per-owner-block program (additive combines: intermediates are
     # (..., size/ndev) from the start) or raises with the alternatives.
     # Default 8 GiB: half a v5e chip's HBM, leaving room for the data.
-    "dense_intermediate_bytes_max": 8 * 2**30,
+    "dense_intermediate_bytes_max": _env_int(
+        "FLOX_TPU_DENSE_INTERMEDIATE_BYTES_MAX", 8 * 2**30, 2**20
+    ),
     # Streaming pipeline (flox_tpu/pipeline.py): how many slabs the
     # background staging pool may hold in flight — slab i+k loads, pads and
     # device_puts while the device reduces slab i. 0 = synchronous inline
@@ -127,7 +148,7 @@ OPTIONS: dict[str, Any] = {
     # HBM is reused across slabs: "auto" probes the backend once (platforms
     # that cannot alias donated buffers fall back to undonated steps),
     # "on"/"off" force it
-    "stream_donate": "auto",
+    "stream_donate": _env_choice("FLOX_TPU_STREAM_DONATE", "auto", ("auto", "on", "off")),
     # Streaming resilience (flox_tpu/resilience.py): how many times a slab's
     # load+stage is retried after a TRANSIENT failure (IO/RPC hiccups per
     # resilience.classify_error; programming errors never retry) before the

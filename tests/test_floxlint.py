@@ -52,7 +52,8 @@ def test_fixture_corpus_is_nonempty():
     "fixture",
     ["flx001_host_sync.py", "flx002_recompile_traps.py", "flx003_dtype_policy.py",
      "flx004_version_gated.py", "flx006_swallow.py", "flx007_eager_logging.py",
-     "clean_module.py", "suppressed.py"],
+     "flx007_print.py", "flx009_donation.py", "flx010_options_drift.py",
+     "flx011_helper_sync.py", "clean_module.py", "suppressed.py"],
 )
 def test_fixture_findings_match_markers(fixture):
     path = FIXTURES / fixture
@@ -64,6 +65,20 @@ def test_flx005_package_fixture():
     expected = expected_findings(pkg / "api.py")
     assert expected  # the fixture seeds at least one violation
     assert actual_findings([pkg]) == expected
+
+
+def test_flx008_package_fixture():
+    # FLX008 is a whole-package contract (clear_all lives in one module, the
+    # orphan cache in another), so like FLX005 it is asserted at package
+    # granularity; file:line must point at the orphan's definition site
+    pkg = FIXTURES / "flx008_pkg"
+    expected = expected_findings(pkg / "registries.py")
+    assert expected
+    assert actual_findings([pkg]) == expected
+    findings = [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+    assert len(findings) == 1
+    assert findings[0].path.endswith("registries.py")
+    assert "_ORPHAN_CACHE" in findings[0].message
 
 
 def test_every_rule_has_fixture_coverage():
@@ -80,6 +95,19 @@ def test_every_rule_has_fixture_coverage():
 def test_flox_tpu_package_is_clean():
     findings = lint_paths([REPO / "flox_tpu"])
     assert findings == [], "\n".join(f.format_human() for f in findings)
+
+
+def test_tools_and_tests_tpu_are_clean():
+    # the gate lints beyond flox_tpu/ (ISSUE 5 satellite); the seeded
+    # fixture corpus under tools/floxlint/fixtures is auto-pruned
+    findings = lint_paths([REPO / "tools", REPO / "tests_tpu"])
+    assert findings == [], "\n".join(f.format_human() for f in findings)
+
+
+def test_fixture_corpus_is_not_pruned_when_passed_explicitly():
+    # pruning only applies while recursing into a root — the corpus itself
+    # stays lintable, which is what every fixture test here relies on
+    assert lint_paths([FIXTURES])
 
 
 # ---------------------------------------------------------------------------
@@ -297,3 +325,635 @@ def test_syntax_error_reported_as_finding(tmp_path):
     p.write_text("def f(:\n")
     findings = lint_file(p)
     assert [f.rule for f in findings] == ["FLX000"]
+
+
+def test_cli_description_derives_rule_range_from_registry():
+    # ISSUE 5 satellite: the stale hardcoded "FLX001-FLX005" is gone — the
+    # blurb derives from the registry and tracks new rules automatically
+    from tools.floxlint.cli import build_parser
+    from tools.floxlint.registry import rule_id_range
+
+    ids = sorted(RULES)
+    assert rule_id_range() == f"{ids[0]}-{ids[-1]}"
+    description = build_parser().description
+    assert rule_id_range() in description
+    assert "FLX001-FLX005" not in description
+
+
+# ---------------------------------------------------------------------------
+# semantic-rule regressions: reintroducing the fixed hazards must fail
+# ---------------------------------------------------------------------------
+
+
+def test_uncleared_cache_reintroduction_fails(tmp_path):
+    # ISSUE 5 tentpole (FLX008): a new runtime cache without the matching
+    # clear_all entry — the shape the PR 2 runtime introspection test could
+    # only catch for caches clear_all already names — fails statically
+    pkg = tmp_path / "minipkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "cache.py").write_text(
+        "def clear_all():\n"
+        "    from .state import _GOOD_CACHE\n"
+        "    _GOOD_CACHE.clear()\n"
+    )
+    (pkg / "state.py").write_text(
+        "_GOOD_CACHE: dict = {}\n"
+        "_NEW_CACHE: dict = {}\n\n"
+        "def put(k, v):\n"
+        "    _GOOD_CACHE[k] = v\n"
+        "    _NEW_CACHE[k] = v\n"
+    )
+    findings = [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+    assert len(findings) == 1 and "_NEW_CACHE" in findings[0].message
+
+
+def test_mutation_through_helper_param_is_detected(tmp_path):
+    # the flox_tpu probe-memo shape: the cache is only ever mutated through
+    # a helper that appends to its *parameter* — one-level interprocedural
+    pkg = tmp_path / "minipkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "cache.py").write_text("def clear_all():\n    pass\n")
+    (pkg / "state.py").write_text(
+        "_PROBE_MEMO: list = []\n\n"
+        "def _memoize(memo, value):\n"
+        "    memo.append(value)\n"
+        "    return memo[0]\n\n"
+        "def probe():\n"
+        "    return _memoize(_PROBE_MEMO, True)\n"
+    )
+    findings = [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+    assert len(findings) == 1 and "_PROBE_MEMO" in findings[0].message
+
+
+def test_static_registry_is_exempt_from_flx008(tmp_path):
+    # import-time-populated tables (AGGREGATIONS/KERNELS shape) are not
+    # caches: mutated only at module top level -> no finding
+    pkg = tmp_path / "minipkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "cache.py").write_text("def clear_all():\n    pass\n")
+    (pkg / "state.py").write_text(
+        "KERNEL_REGISTRY: dict = {}\n"
+        "KERNEL_REGISTRY['sum'] = sum\n"
+        "KERNEL_REGISTRY['max'] = max\n"
+    )
+    assert [f for f in lint_paths([pkg]) if f.rule == "FLX008"] == []
+
+
+def test_donation_after_use_reintroduction_fails(tmp_path):
+    bad = tmp_path / "regress_donation.py"
+    bad.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
+        "def reduce_slabs(state, slabs):\n"
+        "    step = jax.jit(lambda acc, x: acc + x, donate_argnums=(0,))\n"
+        "    out = step(state, slabs[0])\n"
+        "    return out + jnp.sum(state)\n"
+    )
+    assert any(f.rule == "FLX009" for f in lint_paths([bad]))
+    # the carry idiom must stay clean
+    good = tmp_path / "clean_donation.py"
+    good.write_text(
+        "import jax\n\n"
+        "def reduce_slabs(state, slabs):\n"
+        "    step = jax.jit(lambda acc, x: acc + x, donate_argnums=(0,))\n"
+        "    for slab in slabs:\n"
+        "        state = step(state, slab)\n"
+        "    return state\n"
+    )
+    assert not [f for f in lint_paths([good]) if f.rule == "FLX009"]
+
+
+def test_options_drift_reintroduction_fails(tmp_path):
+    bad = tmp_path / "regress_options.py"
+    bad.write_text(
+        "import os\n\n"
+        "OPTIONS = {\n"
+        "    'new_knob': 3,\n"
+        "}\n\n"
+        "_VALIDATORS = {}\n"
+    )
+    rules = {f.rule for f in lint_paths([bad])}
+    assert "FLX010" in rules
+    messages = [f.message for f in lint_paths([bad]) if f.rule == "FLX010"]
+    assert any("env mirror" in m for m in messages)
+    assert any("_VALIDATORS" in m for m in messages)
+
+
+def test_helper_host_sync_reintroduction_fails(tmp_path):
+    bad = tmp_path / "regress_helper_sync.py"
+    bad.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
+        "def _snapshot(arr):\n"
+        "    return arr.item()\n\n"
+        "@jax.jit\n"
+        "def step(state, slab):\n"
+        "    if _snapshot(jnp.sum(slab)) == 0:\n"
+        "        return state\n"
+        "    return state + jnp.sum(slab)\n"
+    )
+    findings = [f for f in lint_paths([bad]) if f.rule == "FLX011"]
+    assert findings and "_snapshot" in findings[0].message
+
+
+def test_flx011_resolves_through_import_alias(tmp_path):
+    # the interprocedural point: the helper lives in ANOTHER module and is
+    # re-exported under an alias; the project index follows the chain
+    pkg = tmp_path / "aliaspkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "hostutils.py").write_text(
+        "import numpy as np\n\n"
+        "def pull(block):\n"
+        "    return np.asarray(block)\n"
+    )
+    (pkg / "exports.py").write_text("from .hostutils import pull as to_host\n")
+    (pkg / "kernelmod.py").write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
+        "from .exports import to_host\n\n"
+        "@jax.jit\n"
+        "def step(state, slab):\n"
+        "    host = to_host(slab)\n"
+        "    return state + jnp.sum(slab)\n"
+    )
+    findings = [f for f in lint_paths([pkg]) if f.rule == "FLX011"]
+    assert len(findings) == 1
+    assert findings[0].path.endswith("kernelmod.py")
+
+
+# ---------------------------------------------------------------------------
+# SARIF output (--format sarif)
+# ---------------------------------------------------------------------------
+
+
+def _validate_sarif(doc):
+    """Structural SARIF 2.1.0 validation: the required-property subset of
+    the OASIS schema that code scanning actually consumes."""
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    assert isinstance(doc["runs"], list) and len(doc["runs"]) == 1
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "floxlint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert rule_ids == sorted(rule_ids)
+    for rule in driver["rules"]:
+        assert rule["shortDescription"]["text"]
+    assert isinstance(run["results"], list)
+    for result in run["results"]:
+        assert result["ruleId"] in rule_ids
+        assert result["level"] in ("none", "note", "warning", "error")
+        assert result["message"]["text"]
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"]
+        assert "\\" not in loc["artifactLocation"]["uri"]
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+        assert driver["rules"][result["ruleIndex"]]["id"] == result["ruleId"]
+    return run
+
+
+def test_sarif_output_on_findings(capsys):
+    rc = floxlint_main(["--format", "sarif", str(FIXTURES / "flx003_dtype_policy.py")])
+    assert rc == 1
+    run = _validate_sarif(json.loads(capsys.readouterr().out))
+    assert run["results"]
+    expected = expected_findings(FIXTURES / "flx003_dtype_policy.py")
+    got = {
+        (r["ruleId"], r["locations"][0]["physicalLocation"]["region"]["startLine"])
+        for r in run["results"]
+    }
+    assert got == expected
+
+
+def test_acceptance_sarif_clean_tree(capsys):
+    # the ISSUE 5 acceptance command: schema-valid SARIF, exit 0, no results
+    rc = floxlint_main([str(REPO / "flox_tpu"), str(REPO / "tools"), "--format", "sarif"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    run = _validate_sarif(json.loads(out))
+    assert run["results"] == []
+    # the full rule catalog rides along even with zero results
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == sorted(RULES)
+
+
+# ---------------------------------------------------------------------------
+# baseline (--baseline / --update-baseline)
+# ---------------------------------------------------------------------------
+
+
+def _seed_violation(tmp_path, name="bad.py"):
+    p = tmp_path / name
+    p.write_text(
+        "import jax.numpy as jnp\n\n"
+        "def f(x):\n"
+        "    return x.astype(jnp.bfloat16)\n"
+    )
+    return p
+
+
+def test_baseline_write_then_check(tmp_path, capsys):
+    bad = _seed_violation(tmp_path)
+    baseline = tmp_path / "baseline.json"
+    assert floxlint_main([str(bad), "--baseline", str(baseline), "--update-baseline"]) == 0
+    payload = json.loads(baseline.read_text())
+    assert payload["version"] == 1 and len(payload["findings"]) == 1
+    entry = payload["findings"][0]
+    assert entry["rule"] == "FLX003" and entry["count"] == 1 and entry["fingerprint"]
+    capsys.readouterr()
+    # check mode: the baselined finding is absorbed, exit 0
+    assert floxlint_main([str(bad), "--baseline", str(baseline)]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_baseline_new_findings_still_fail(tmp_path, capsys):
+    bad = _seed_violation(tmp_path)
+    baseline = tmp_path / "baseline.json"
+    floxlint_main([str(bad), "--baseline", str(baseline), "--update-baseline"])
+    bad.write_text(
+        bad.read_text() + "\ndef g(x):\n    return x.astype('float16')\n"
+    )
+    capsys.readouterr()
+    rc = floxlint_main(
+        [str(bad), "--baseline", str(baseline), "--format", "json"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    payload = json.loads(out)
+    # only the NEW finding is reported; the baselined one stays absorbed
+    assert payload["finding_count"] == 1
+    assert payload["findings"][0]["rule"] == "FLX003"
+
+
+def test_baseline_drift_fails(tmp_path, capsys):
+    # stale suppressions — entries whose finding was fixed — fail the gate
+    bad = _seed_violation(tmp_path)
+    baseline = tmp_path / "baseline.json"
+    floxlint_main([str(bad), "--baseline", str(baseline), "--update-baseline"])
+    bad.write_text("def f(x):\n    return x\n")  # hazard fixed, entry now stale
+    capsys.readouterr()
+    rc = floxlint_main([str(bad), "--baseline", str(baseline)])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "baseline drift" in captured.err
+    assert "FLX003" in captured.err
+
+
+def test_baseline_is_line_number_stable(tmp_path):
+    # shifting a baselined finding down a file must not invalidate the entry
+    bad = _seed_violation(tmp_path)
+    baseline = tmp_path / "baseline.json"
+    floxlint_main([str(bad), "--baseline", str(baseline), "--update-baseline"])
+    bad.write_text("# a new leading comment\n# another\n" + bad.read_text())
+    assert floxlint_main([str(bad), "--baseline", str(baseline)]) == 0
+
+
+def test_baseline_partially_fixed_entry_is_drift(tmp_path, capsys):
+    # an entry with count=2 where only one occurrence still fires leaves a
+    # silent absorption budget for a reintroduced finding — the baseline
+    # can only shrink, so the surplus is drift
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n\n"
+        "def f(x):\n"
+        "    return x.astype(jnp.bfloat16)\n"
+        "def g(x):\n"
+        "    return x.astype(jnp.bfloat16)\n"
+    )
+    baseline = tmp_path / "baseline.json"
+    floxlint_main([str(bad), "--baseline", str(baseline), "--update-baseline"])
+    assert json.loads(baseline.read_text())["findings"][0]["count"] == 2
+    # fix ONE of the two occurrences
+    bad.write_text(
+        "import jax.numpy as jnp\n\n"
+        "def f(x):\n"
+        "    return x.astype(jnp.bfloat16)\n"
+        "def g(x):\n"
+        "    return x\n"
+    )
+    capsys.readouterr()
+    rc = floxlint_main([str(bad), "--baseline", str(baseline)])
+    captured = capsys.readouterr()
+    assert rc == 1 and "baseline drift" in captured.err
+
+
+def test_baseline_stable_for_interprocedural_rules(tmp_path):
+    # FLX009/FLX011 messages must not embed line numbers, or the
+    # line-number-free fingerprint promise breaks for exactly the rules the
+    # baseline exists to stage in
+    bad = tmp_path / "regress_helper_sync.py"
+    bad.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
+        "def _snapshot(arr):\n"
+        "    return arr.item()\n\n"
+        "@jax.jit\n"
+        "def step(state, slab):\n"
+        "    return state + _snapshot(slab)\n"
+    )
+    baseline = tmp_path / "baseline.json"
+    floxlint_main([str(bad), "--baseline", str(baseline), "--update-baseline"])
+    bad.write_text("# shifted\n# down\n" + bad.read_text())
+    assert floxlint_main([str(bad), "--baseline", str(baseline)]) == 0
+
+
+def test_update_baseline_requires_baseline_path():
+    assert floxlint_main(["--update-baseline", str(FIXTURES)]) == 2
+
+
+def test_shipped_baseline_is_empty_and_tree_is_clean():
+    # the repo ships a clean tree: the gate's baseline must stay empty (the
+    # baseline can only shrink — see docs), and check mode must exit 0
+    payload = json.loads((REPO / "tools" / "floxlint" / "baseline.json").read_text())
+    assert payload["findings"] == []
+
+
+# ---------------------------------------------------------------------------
+# autofix (--fix)
+# ---------------------------------------------------------------------------
+
+
+def test_fix_flx007_fixture_relints_clean_and_is_byte_stable(tmp_path, capsys):
+    # ISSUE 5 acceptance: --fix on the FLX007 fixture produces output that
+    # re-lints clean and is byte-stable on a second pass
+    import shutil
+
+    target = tmp_path / "flx007_eager_logging.py"
+    shutil.copy(FIXTURES / "flx007_eager_logging.py", target)
+    rc = floxlint_main([str(target), "--fix"])
+    capsys.readouterr()
+    assert rc == 0  # everything in this fixture is mechanically fixable
+    fixed_once = target.read_text()
+    assert lint_paths([target]) == []
+    assert "logger.debug('ngroups=%s', ngroups)" in fixed_once
+    assert "logger.log(level, 'slabs=%s', n)" in fixed_once
+    assert "log.error('cannot read %s', path)" in fixed_once
+    # the clean non-logger shape keeps its f-string (not a logging call)
+    assert 'tracer.debug(f"x={x}")' in fixed_once
+    rc2 = floxlint_main([str(target), "--fix"])
+    capsys.readouterr()
+    assert rc2 == 0
+    assert target.read_text() == fixed_once  # byte-stable second pass
+
+
+def test_fix_flx004_rewrites_to_compat_spellings(tmp_path, capsys):
+    import shutil
+
+    target = tmp_path / "flx004_version_gated.py"
+    shutil.copy(FIXTURES / "flx004_version_gated.py", target)
+    floxlint_main([str(target), "--fix"])
+    capsys.readouterr()
+    fixed = target.read_text()
+    assert "jax.tree.map(lambda x: x + 1, tree)" in fixed
+    assert "from flox_tpu.parallel.mesh import axis_size, shard_map" in fixed
+    assert "jax.lax.axis_size" not in fixed
+    # the structural ImportFrom violation has no mechanical fix and remains
+    remaining = [f for f in lint_paths([target]) if f.rule == "FLX004"]
+    assert len(remaining) == 1 and remaining[0].line == 4
+    # second pass: nothing left to fix, bytes stable
+    floxlint_main([str(target), "--fix"])
+    capsys.readouterr()
+    assert target.read_text() == fixed
+
+
+def test_fix_adds_missing_shim_name_to_partial_import(tmp_path, capsys):
+    # a pre-existing mesh-shim import must not suppress the insert a NEW
+    # bare name still needs (per-name check, not a substring check)
+    p = tmp_path / "partial.py"
+    p.write_text(
+        "import jax\n"
+        "from flox_tpu.parallel.mesh import shard_map\n\n"
+        "def f(axes):\n"
+        "    return jax.lax.axis_size(axes[0])\n"
+    )
+    floxlint_main([str(p), "--fix"])
+    capsys.readouterr()
+    fixed = p.read_text()
+    assert "return axis_size(axes[0])" in fixed
+    assert "from flox_tpu.parallel.mesh import axis_size" in fixed
+    compile(fixed, str(p), "exec")  # the rewritten module must stay valid
+
+
+def test_fix_preserves_format_spec_fstrings(tmp_path, capsys):
+    # f"{x:.3f}" carries load-bearing formatting %s would lose — not fixed
+    p = tmp_path / "spec.py"
+    src = (
+        "import logging\n\n"
+        "logger = logging.getLogger('flox_tpu.x')\n\n"
+        "def f(ms):\n"
+        "    logger.debug(f'took {ms:.3f} ms')\n"
+    )
+    p.write_text(src)
+    floxlint_main([str(p), "--fix"])
+    capsys.readouterr()
+    assert p.read_text() == src  # untouched (still a finding, but not broken)
+
+
+def test_fix_skips_suppressed_lines(tmp_path, capsys):
+    p = tmp_path / "sup.py"
+    src = (
+        "import logging\n\n"
+        "logger = logging.getLogger('flox_tpu.x')\n\n"
+        "def f(n):\n"
+        "    logger.debug(f'n={n}')  # floxlint: disable=FLX007\n"
+    )
+    p.write_text(src)
+    assert floxlint_main([str(p), "--fix"]) == 0
+    capsys.readouterr()
+    assert p.read_text() == src
+
+
+# ---------------------------------------------------------------------------
+# docs drift: the rule tables must list exactly the registry
+# ---------------------------------------------------------------------------
+
+
+def test_implementation_md_rule_table_matches_registry():
+    # ISSUE 5 satellite: the docs table and the registry cannot drift
+    text = (REPO / "docs" / "implementation.md").read_text()
+    section = text.split("## Static analysis")[1]
+    table_ids = set(re.findall(r"^\|\s*(FLX\d{3})", section, re.MULTILINE))
+    assert table_ids == set(RULES), (
+        f"docs/implementation.md rule table drifted: "
+        f"missing {set(RULES) - table_ids}, extra {table_ids - set(RULES)}"
+    )
+
+
+def test_readme_lint_section_matches_registry():
+    text = (REPO / "README.md").read_text()
+    section = text.split("## Lint gate")[1].split("\n## ")[0]
+    readme_ids = {m for m in re.findall(r"FLX\d{3}", section)}
+    assert readme_ids == set(RULES), (
+        f"README lint-gate section drifted: "
+        f"missing {set(RULES) - readme_ids}, extra {readme_ids - set(RULES)}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# get_rules select/ignore edge cases (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_get_rules_lowercase_ids():
+    rules = get_rules(select=["flx003"])
+    assert [r.id for r in rules] == ["FLX003"]
+    rules = get_rules(ignore=["flx003"])
+    assert "FLX003" not in {r.id for r in rules}
+
+
+def test_get_rules_unknown_select_raises():
+    with pytest.raises(KeyError, match="FLX999"):
+        get_rules(select=["FLX999"])
+    with pytest.raises(KeyError, match="flx000"):
+        get_rules(select=["FLX003", "flx000"])
+
+
+def test_get_rules_unknown_ignore_is_silent():
+    # ignoring a rule that does not exist is a no-op, not an error (the id
+    # may belong to a newer floxlint; --ignore must stay forward-compatible)
+    assert {r.id for r in get_rules(ignore=["FLX999"])} == set(RULES)
+
+
+def test_get_rules_select_ignore_overlap_is_empty():
+    assert get_rules(select=["FLX003"], ignore=["flx003"]) == []
+
+
+def test_get_rules_duplicate_select_dedupes():
+    rules = get_rules(select=["FLX003", "flx003", "FLX003"])
+    assert [r.id for r in rules] == ["FLX003"]
+
+
+# ---------------------------------------------------------------------------
+# suppression-index behavior on multi-finding lines + noqa alias
+# ---------------------------------------------------------------------------
+
+
+def test_multi_rule_line_disable_both(tmp_path):
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
+        "def f(x):\n"
+        "    return jax.shard_map, x.astype(jnp.bfloat16)  # floxlint: disable=FLX003,FLX004\n"
+    )
+    p = tmp_path / "multi_both.py"
+    p.write_text(src)
+    assert lint_file(p) == []
+
+
+def test_multi_rule_line_disable_one_keeps_other(tmp_path):
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
+        "def f(x):\n"
+        "    return jax.shard_map, x.astype(jnp.bfloat16)  # floxlint: disable=FLX004\n"
+    )
+    p = tmp_path / "multi_one.py"
+    p.write_text(src)
+    assert [f.rule for f in lint_file(p)] == ["FLX003"]
+
+
+def test_noqa_alias_suppresses(tmp_path):
+    src = (
+        "import jax.numpy as jnp\n\n"
+        "def f(x):\n"
+        "    return x.astype(jnp.bfloat16)  # noqa: FLX003\n"
+    )
+    p = tmp_path / "noqa_ok.py"
+    p.write_text(src)
+    assert lint_file(p) == []
+
+
+def test_noqa_multi_ids_on_multi_finding_line(tmp_path):
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
+        "def f(x):\n"
+        "    return jax.shard_map, x.astype(jnp.bfloat16)  # noqa: FLX003, FLX004\n"
+    )
+    p = tmp_path / "noqa_multi.py"
+    p.write_text(src)
+    assert lint_file(p) == []
+
+
+def test_bare_noqa_does_not_suppress(tmp_path):
+    # ruff-style bare `# noqa` (or foreign codes) must NOT silence floxlint:
+    # floxlint suppressions are always rule-scoped
+    src = (
+        "import jax.numpy as jnp\n\n"
+        "def f(x):\n"
+        "    return x.astype(jnp.bfloat16)  # noqa\n"
+        "def g(x):\n"
+        "    return x.astype(jnp.bfloat16)  # noqa: E501\n"
+    )
+    p = tmp_path / "noqa_bare.py"
+    p.write_text(src)
+    assert [f.rule for f in lint_file(p)] == ["FLX003", "FLX003"]
+
+
+# ---------------------------------------------------------------------------
+# project-index cache (--index-cache)
+# ---------------------------------------------------------------------------
+
+
+def test_index_cache_roundtrip(tmp_path, capsys):
+    from tools.floxlint.index import ProjectIndex, load_cached
+
+    pkg = tmp_path / "cachedpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text("def f():\n    return 1\n")
+    cache = tmp_path / "index.pickle"
+    rc = floxlint_main([str(pkg), "--index-cache", str(cache)])
+    capsys.readouterr()
+    assert rc == 0 and cache.exists()
+    files = sorted(pkg.rglob("*.py"))
+    restored = load_cached(cache, files, pkg)
+    assert isinstance(restored, ProjectIndex)
+    assert "cachedpkg.mod" in restored.modules
+    # an edit invalidates the fingerprint -> cache miss, not stale reuse
+    (pkg / "mod.py").write_text("def f():\n    return 2\n")
+    assert load_cached(cache, sorted(pkg.rglob("*.py")), pkg) is None
+    # and the CLI transparently rebuilds + re-saves
+    rc = floxlint_main([str(pkg), "--index-cache", str(cache)])
+    capsys.readouterr()
+    assert rc == 0
+    assert load_cached(cache, sorted(pkg.rglob("*.py")), pkg) is not None
+
+
+def test_index_resolves_reexport_chain(tmp_path):
+    # the symbol table follows `from x import y as z` through a package
+    # __init__ re-export to the defining module
+    from tools.floxlint.index import ProjectIndex
+
+    pkg = tmp_path / "chainpkg"
+    (pkg / "sub").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("from .sub import helper as h\n")
+    (pkg / "sub" / "__init__.py").write_text("from .impl import helper\n")
+    (pkg / "sub" / "impl.py").write_text("def helper():\n    return 1\n")
+    (pkg / "user.py").write_text("from chainpkg import h\n\ndef g():\n    return h()\n")
+    files = sorted(pkg.rglob("*.py"))
+    index = ProjectIndex.build(files, pkg)
+    assert (
+        index.resolve_symbol("chainpkg.user", "h") == "chainpkg.sub.impl.helper"
+    )
+
+
+def test_callgraph_edges(tmp_path):
+    from tools.floxlint.callgraph import CallGraph
+    from tools.floxlint.index import ProjectIndex
+
+    p = tmp_path / "graphmod.py"
+    p.write_text(
+        "def a():\n    return b() + 1\n\n"
+        "def b():\n    return c()\n\n"
+        "def c():\n    return 0\n"
+    )
+    index = ProjectIndex.build([p], tmp_path)
+    graph = CallGraph.build(index)
+    assert graph.callees("graphmod.a") == {"graphmod.b"}
+    assert graph.reachable("graphmod.a") == {"graphmod.b", "graphmod.c"}
+    assert graph.reachable("graphmod.a", max_depth=1) == {"graphmod.b"}

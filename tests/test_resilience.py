@@ -673,6 +673,58 @@ class TestOptionValidation:
         finally:
             del os.environ["_FLOX_TEST_ENV"]
 
+    def test_env_float_open_and_upper_bounds(self):
+        # ISSUE 5 (FLX010): every OPTIONS field now has an env mirror, which
+        # needs _env_float to express `0 < x <= 1`-shaped validator bounds
+        from flox_tpu.options import _env_float
+
+        try:
+            os.environ["_FLOX_TEST_ENV"] = "0"
+            assert _env_float("_FLOX_TEST_ENV", 0.25, 0.0, 1.0, lo_open=True) == 0.25
+            os.environ["_FLOX_TEST_ENV"] = "1.5"
+            assert _env_float("_FLOX_TEST_ENV", 0.25, 0.0, 1.0, lo_open=True) == 0.25
+            os.environ["_FLOX_TEST_ENV"] = "0.75"
+            assert _env_float("_FLOX_TEST_ENV", 0.25, 0.0, 1.0, lo_open=True) == 0.75
+            os.environ["_FLOX_TEST_ENV"] = "1.0"
+            assert _env_float("_FLOX_TEST_ENV", 0.25, 0.0, 1.0, lo_open=True) == 1.0
+        finally:
+            del os.environ["_FLOX_TEST_ENV"]
+
+    def test_every_option_has_env_mirror(self):
+        # the static FLX010 contract, asserted at runtime too: re-importing
+        # options with a mirror set must seed the field; invalid values fall
+        # back (the cannot-seed-what-set_options-refuses contract)
+        import importlib
+        import flox_tpu.options as options_mod
+
+        probes = {
+            "FLOX_TPU_DEFAULT_ENGINE": ("default_engine", "numpy", "bogus"),
+            "FLOX_TPU_QUANTILE_IMPL": ("quantile_impl", "select", "bogus"),
+            "FLOX_TPU_MATMUL_NUM_GROUPS_MAX": ("matmul_num_groups_max", 77, "junk"),
+            "FLOX_TPU_STREAM_DONATE": ("stream_donate", "off", "maybe"),
+        }
+        saved = {k: os.environ.get(k) for k in probes}
+        try:
+            for env, (field, good, _bad) in probes.items():
+                os.environ[env] = str(good)
+            mod = importlib.reload(options_mod)
+            for env, (field, good, _bad) in probes.items():
+                assert mod.OPTIONS[field] == good, field
+            for env, (field, _good, bad) in probes.items():
+                os.environ[env] = str(bad)
+            defaults = {"default_engine": "jax", "quantile_impl": "auto",
+                        "matmul_num_groups_max": 384, "stream_donate": "auto"}
+            mod = importlib.reload(options_mod)
+            for field, expected in defaults.items():
+                assert mod.OPTIONS[field] == expected, field
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            importlib.reload(options_mod)
+
 
 # ---------------------------------------------------------------------------
 # the harness itself

@@ -120,7 +120,8 @@ def test_bf16_accumulates_f32(tpu):
     from flox_tpu.kernels import generic_kernel
 
     n = 4096
-    vals = jnp.asarray(np.linspace(0, 1, n, dtype=np.float32)).astype(jnp.bfloat16)
+    # the bf16 input is the point of the test (saturation regression)
+    vals = jnp.asarray(np.linspace(0, 1, n, dtype=np.float32)).astype(jnp.bfloat16)  # floxlint: disable=FLX003
     codes = np.zeros(n, dtype=np.int32)
     got = float(np.asarray(generic_kernel("nanmean", codes, vals, size=1))[0])
     assert abs(got - 0.5) < 0.01, got

@@ -1,7 +1,7 @@
 """floxlint: JAX-hazard static analysis for the flox_tpu codebase.
 
-An AST-based linter for the failure modes that erase TPU performance without
-failing any test:
+An AST-based linter for the failure modes that erase TPU performance (or
+corrupt results) without failing any test. The per-file rules:
 
 * FLX001 — host-sync hazard: ``np.*`` / ``float()`` / ``int()`` / ``bool()``
   / ``.item()`` applied to traced values inside jitted code.
@@ -14,13 +14,46 @@ failing any test:
   must go through the compat shim in ``flox_tpu/parallel/mesh.py``.
 * FLX005 — untyped public API: functions exported from ``__init__.py``
   missing parameter or return annotations.
+* FLX006 — swallowed retry exception: broad ``except`` in retry loops that
+  neither re-raises nor routes through ``resilience.classify_error``.
+* FLX007 — eager logging: f-string/%/.format-built log messages and bare
+  ``print()`` in library code.
 
-Run as ``python -m tools.floxlint flox_tpu/``. Suppress a finding with a
-trailing ``# floxlint: disable=FLX001`` comment (comma-separated rule ids or
-``all``), or a whole file with ``# floxlint: disable-file=FLX001``.
+The semantic rules run over a **project index** (the whole lint tree parsed
+once, imports and package re-exports resolved, plus a call graph) instead
+of file-at-a-time:
+
+* FLX008 — cache-registry completeness: every module-level mutable cache
+  that accretes at runtime must be reachable from ``cache.clear_all``.
+* FLX009 — donation-after-use: a value dispatched through a
+  ``donate_argnums``/``maybe_donate`` path must not be referenced
+  afterwards in the caller (tracked through one level of step factories).
+* FLX010 — OPTIONS/env drift: every ``options.OPTIONS`` field needs its
+  ``FLOX_TPU_*`` env mirror, a ``_VALIDATORS`` entry, and a docs/ mention.
+* FLX011 — host-sync through helpers: interprocedural FLX001 — a traced
+  function calling a local helper that ``.item()``s / ``np.*``s its traced
+  argument.
+
+Run as ``python -m tools.floxlint flox_tpu/ tools/``. Output formats:
+``human`` (default), ``json``, and ``sarif`` (SARIF 2.1.0 for GitHub code
+scanning). ``--baseline FILE`` suppresses known findings and fails on
+baseline drift (stale entries); ``--update-baseline`` writes the file.
+``--fix`` applies the mechanical rewrites (FLX007 eager logging -> lazy
+%-args, FLX004 version-gate wrapping). Suppress a finding with a trailing
+``# floxlint: disable=FLX001`` comment (comma-separated rule ids or
+``all``), the ``# noqa: FLX001`` alias, or a whole file with
+``# floxlint: disable-file=FLX001``.
 """
 
 from .core import Finding, LintError, lint_file, lint_paths
-from .registry import RULES, get_rules
+from .registry import RULES, get_rules, rule_id_range
 
-__all__ = ["Finding", "LintError", "RULES", "get_rules", "lint_file", "lint_paths"]
+__all__ = [
+    "Finding",
+    "LintError",
+    "RULES",
+    "get_rules",
+    "lint_file",
+    "lint_paths",
+    "rule_id_range",
+]

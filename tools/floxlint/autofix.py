@@ -1,0 +1,312 @@
+"""``--fix`` autofixes for the mechanical rules.
+
+Two rule families have a single sanctioned rewrite, so the linter applies
+it instead of just complaining:
+
+* **FLX007** eager logging -> lazy ``%``-args: ``logger.debug(f"n={n}")``
+  becomes ``logger.debug('n=%s', n)``; ``%``-interpolated, concatenated and
+  ``str.format``-built messages get the equivalent treatment. Bare
+  ``print()`` has no mechanical fix (it needs a logger decision) and is
+  left alone.
+* **FLX004** version-gated API -> compat wrapping: ``jax.tree_map`` /
+  ``jax.tree_multimap`` / ``jax.tree_util.tree_multimap`` rewrite to
+  ``jax.tree.map``; ``jax.shard_map`` and ``jax.lax.axis_size`` rewrite to
+  the ``flox_tpu.parallel.mesh`` shim names, inserting the import after the
+  last top-level import if missing. Gated *imports* (``from
+  jax.experimental.shard_map import ...``) are structural and stay manual.
+
+Fixes are pure source-span replacements computed from AST positions and
+applied back-to-front, so a file the fixer cannot fully fix is still left
+syntactically intact. A second ``--fix`` pass over fixed output finds no
+eager patterns and must therefore be byte-stable — the self-tests pin that.
+Suppressed lines (``# floxlint: disable=...`` / ``# noqa: FLXnnn``) are
+never rewritten.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, Sequence
+
+from .core import parse_suppressions
+from .rules.common import ImportMap
+from .rules.flx007_logging import _eager_kind, log_message_arg
+
+#: rules --fix knows how to rewrite
+FIXABLE_RULES = frozenset({"FLX004", "FLX007"})
+
+_MESH_SHIM = "flox_tpu.parallel.mesh"
+#: gated attribute chain (resolved) -> shim name imported from _MESH_SHIM
+_SHIM_NAMES = {"jax.shard_map": "shard_map", "jax.lax.axis_size": "axis_size"}
+_TREE_MAP_APIS = ("jax.tree_map", "jax.tree_multimap", "jax.tree_util.tree_multimap")
+
+
+def fix_source(source: str) -> tuple[str, int]:
+    """Apply every available fix to ``source``; returns (new_source, n)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return source, 0
+    suppressions = parse_suppressions(source)
+    imports = ImportMap.from_tree(tree)
+    edits: list[tuple[int, int, str]] = []
+    needed_shim_imports: set[str] = set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            edit = _logging_edit(source, node, suppressions)
+            if edit is not None:
+                edits.append(edit)
+        elif isinstance(node, ast.Attribute):
+            edit = _version_edit(source, node, imports, suppressions, needed_shim_imports)
+            if edit is not None:
+                edits.append(edit)
+
+    if not edits:
+        return source, 0
+    new_source = _apply_edits(source, edits)
+    missing = needed_shim_imports - _imported_shim_names(new_source)
+    if missing:
+        new_source = _insert_import(
+            new_source,
+            f"from {_MESH_SHIM} import {', '.join(sorted(missing))}",
+        )
+    return new_source, len(edits)
+
+
+def fix_paths(paths: Iterable[str | Path]) -> dict[str, int]:
+    """Fix files in place; returns {path: edit count} for changed files."""
+    out: dict[str, int] = {}
+    for raw in paths:
+        path = Path(raw)
+        try:
+            source = path.read_text()
+        except OSError:
+            continue
+        fixed, n = fix_source(source)
+        if n and fixed != source:
+            path.write_text(fixed)
+            out[str(path)] = n
+    return out
+
+
+# -- FLX007: eager logging -> lazy %-args -----------------------------------
+
+
+def _logging_edit(
+    source: str, call: ast.Call, suppressions
+) -> tuple[int, int, str] | None:
+    msg = log_message_arg(call)
+    if msg is None or _eager_kind(msg) is None:
+        return None
+    if suppressions.active("FLX007", msg.lineno):
+        return None
+    if call.args and call.args[-1] is not msg:
+        return None  # eager message followed by positional args: not ours
+    rewritten = _lazy_message(source, msg)
+    if rewritten is None:
+        return None
+    fmt, args = rewritten
+    replacement = ", ".join([repr(fmt), *args]) if args else repr(fmt)
+    span = _span(source, msg)
+    return (*span, replacement) if span else None
+
+
+def _lazy_message(source: str, msg: ast.AST) -> tuple[str, list[str]] | None:
+    """(format string, arg source texts) for an eager message, or None when
+    the shape is too clever to rewrite mechanically."""
+    if isinstance(msg, ast.JoinedStr):
+        fmt, args = "", []
+        for value in msg.values:
+            if isinstance(value, ast.Constant) and isinstance(value.value, str):
+                fmt += value.value.replace("%", "%%")
+            elif isinstance(value, ast.FormattedValue):
+                if value.conversion != -1 or value.format_spec is not None:
+                    return None  # f"{x!r}" / f"{x:.3f}": formatting is load-bearing
+                seg = ast.get_source_segment(source, value.value)
+                if seg is None:
+                    return None
+                fmt += "%s"
+                args.append(seg)
+            else:
+                return None
+        return fmt, args
+    if isinstance(msg, ast.BinOp) and isinstance(msg.op, ast.Mod):
+        if not (isinstance(msg.left, ast.Constant) and isinstance(msg.left.value, str)):
+            return None
+        right = msg.right
+        elts = right.elts if isinstance(right, ast.Tuple) else [right]
+        args = []
+        for elt in elts:
+            seg = ast.get_source_segment(source, elt)
+            if seg is None:
+                return None
+            args.append(seg)
+        return msg.left.value, args
+    if isinstance(msg, ast.BinOp) and isinstance(msg.op, ast.Add):
+        terms = _flatten_concat(msg)
+        if terms is None:
+            return None
+        fmt, args = "", []
+        saw_literal = False
+        for term in terms:
+            if isinstance(term, ast.Constant) and isinstance(term.value, str):
+                fmt += term.value.replace("%", "%%")
+                saw_literal = True
+                continue
+            # "x=" + str(x): unwrap the str() — %s stringifies anyway
+            inner = term
+            if (
+                isinstance(term, ast.Call)
+                and isinstance(term.func, ast.Name)
+                and term.func.id == "str"
+                and len(term.args) == 1
+                and not term.keywords
+            ):
+                inner = term.args[0]
+            seg = ast.get_source_segment(source, inner)
+            if seg is None:
+                return None
+            fmt += "%s"
+            args.append(seg)
+        return (fmt, args) if saw_literal else None
+    if (
+        isinstance(msg, ast.Call)
+        and isinstance(msg.func, ast.Attribute)
+        and msg.func.attr == "format"
+        and isinstance(msg.func.value, ast.Constant)
+        and isinstance(msg.func.value.value, str)
+        and not msg.keywords
+    ):
+        template = msg.func.value.value
+        stripped = template.replace("{}", "")
+        if "{" in stripped or "}" in stripped:
+            return None  # {0} / {name} / {{ }}: positional mapping is not mechanical
+        if template.count("{}") != len(msg.args):
+            return None
+        args = []
+        for a in msg.args:
+            seg = ast.get_source_segment(source, a)
+            if seg is None:
+                return None
+            args.append(seg)
+        return template.replace("%", "%%").replace("{}", "%s"), args
+    return None
+
+
+def _flatten_concat(node: ast.AST) -> list[ast.AST] | None:
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _flatten_concat(node.left)
+        right = _flatten_concat(node.right)
+        if left is None or right is None:
+            return None
+        return left + right
+    return [node]
+
+
+# -- FLX004: version-gated API -> compat spelling ---------------------------
+
+
+def _version_edit(
+    source: str,
+    node: ast.Attribute,
+    imports: ImportMap,
+    suppressions,
+    needed_shim_imports: set[str],
+) -> tuple[int, int, str] | None:
+    resolved = imports.resolve(node)
+    if resolved is None or suppressions.active("FLX004", node.lineno):
+        return None
+    root = _chain_root(node)
+    if root is None:
+        return None
+    if resolved in _TREE_MAP_APIS:
+        span = _span(source, node)
+        return (*span, f"{root.id}.tree.map") if span else None
+    if resolved in _SHIM_NAMES:
+        span = _span(source, node)
+        if span is None:
+            return None
+        needed_shim_imports.add(_SHIM_NAMES[resolved])
+        return (*span, _SHIM_NAMES[resolved])
+    return None
+
+
+def _chain_root(node: ast.Attribute) -> ast.Name | None:
+    base: ast.AST = node
+    while isinstance(base, ast.Attribute):
+        base = base.value
+    return base if isinstance(base, ast.Name) else None
+
+
+# -- span plumbing ----------------------------------------------------------
+
+
+def _line_offsets(source: str) -> list[int]:
+    offsets = [0]
+    for line in source.splitlines(keepends=True):
+        offsets.append(offsets[-1] + len(line))
+    return offsets
+
+
+def _span(source: str, node: ast.AST) -> tuple[int, int] | None:
+    end_lineno = getattr(node, "end_lineno", None)
+    end_col = getattr(node, "end_col_offset", None)
+    if end_lineno is None or end_col is None:
+        return None
+    offsets = _line_offsets(source)
+    if node.lineno > len(offsets) - 1 or end_lineno > len(offsets) - 1:
+        return None
+    return offsets[node.lineno - 1] + node.col_offset, offsets[end_lineno - 1] + end_col
+
+
+def _apply_edits(source: str, edits: Sequence[tuple[int, int, str]]) -> str:
+    applied = source
+    last_start = len(source) + 1
+    for start, end, replacement in sorted(edits, key=lambda e: e[0], reverse=True):
+        if end > last_start:
+            continue  # overlapping (nested) edit: outermost wins
+        applied = applied[:start] + replacement + applied[end:]
+        last_start = start
+    return applied
+
+
+def _imported_shim_names(source: str) -> set[str]:
+    """Names already imported from the mesh shim — checked per name, not by
+    substring: a pre-existing ``from ...mesh import shard_map`` must not
+    suppress the insert a new bare ``axis_size`` still needs."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return set()
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == _MESH_SHIM:
+            # an aliased import (shard_map as sm) does not bind the bare
+            # name the rewritten call sites use — only unaliased ones count
+            out.update(a.name for a in node.names if a.asname in (None, a.name))
+    return out
+
+
+def _insert_import(source: str, import_line: str) -> str:
+    """Insert after the last top-level import (or the module docstring)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return source
+    insert_after = 0
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            insert_after = getattr(node, "end_lineno", node.lineno)
+        elif (
+            insert_after == 0
+            and isinstance(node, ast.Expr)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            insert_after = getattr(node, "end_lineno", node.lineno)
+    lines = source.splitlines(keepends=True)
+    newline = "\n"
+    lines.insert(insert_after, import_line + newline)
+    return "".join(lines)

@@ -1,0 +1,97 @@
+"""Suppression baseline (``--baseline`` check / ``--update-baseline`` write).
+
+New semantic rules must be able to land without a flag day: the baseline
+file records the findings that existed when a rule shipped, the gate fails
+only on findings *not* in the baseline, and — symmetrically — on **drift**:
+baseline entries whose finding no longer fires are stale suppressions that
+must be deleted, so the baseline can only ever shrink.
+
+Entries are keyed by a line-number-free fingerprint (path, rule, message)
+with an occurrence count, so unrelated edits that shift a suppressed
+finding up or down a file do not invalidate the baseline, while fixing the
+finding (or rewording the rule) retires the entry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Sequence
+
+from .core import Finding, LintError
+
+
+def fingerprint(finding: Finding) -> str:
+    raw = f"{finding.path}\x1f{finding.rule}\x1f{finding.message}"
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
+def write_baseline(path: str | Path, findings: Sequence[Finding]) -> int:
+    """Write the aggregated baseline for ``findings``; returns entry count."""
+    counts: Counter[str] = Counter(fingerprint(f) for f in findings)
+    seen: set[str] = set()
+    entries = []
+    for f in sorted(findings):
+        fp = fingerprint(f)
+        if fp in seen:
+            continue
+        seen.add(fp)
+        entries.append(
+            {
+                "fingerprint": fp,
+                "path": f.path,
+                "rule": f.rule,
+                "message": f.message,
+                "count": counts[fp],
+            }
+        )
+    payload = {"version": 1, "findings": entries}
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+    return len(entries)
+
+
+def load_baseline(path: str | Path) -> list[dict]:
+    try:
+        payload = json.loads(Path(path).read_text())
+    except OSError as exc:
+        raise LintError(f"cannot read baseline {path}: {exc}") from exc
+    except json.JSONDecodeError as exc:
+        raise LintError(f"baseline {path} is not valid JSON: {exc}") from exc
+    if not isinstance(payload, dict) or "findings" not in payload:
+        raise LintError(f"baseline {path} has no 'findings' list")
+    return list(payload["findings"])
+
+
+def apply_baseline(
+    findings: Sequence[Finding], entries: Sequence[dict]
+) -> tuple[list[Finding], list[dict]]:
+    """Split ``findings`` against the baseline.
+
+    Returns ``(new_findings, stale_entries)``: findings not covered by any
+    baseline entry (each entry absorbs up to ``count`` occurrences of its
+    fingerprint), and entries with *unused* budget — an entry whose count
+    exceeds what still fires is a stale suppression: the surplus would
+    otherwise silently absorb the same finding if it were reintroduced
+    later, so the baseline must shrink to the surviving count."""
+    budget: Counter[str] = Counter()
+    for e in entries:
+        fp = e.get("fingerprint")
+        if isinstance(fp, str):
+            budget[fp] += int(e.get("count", 1))
+    matched: Counter[str] = Counter()
+    new: list[Finding] = []
+    for f in sorted(findings):
+        fp = fingerprint(f)
+        if matched[fp] < budget.get(fp, 0):
+            matched[fp] += 1
+        else:
+            new.append(f)
+    stale = [
+        e
+        for e in entries
+        if isinstance(e.get("fingerprint"), str)
+        and matched[e["fingerprint"]] < budget[e["fingerprint"]]
+    ]
+    return new, stale

@@ -1,0 +1,88 @@
+"""Call graph over a :class:`~tools.floxlint.index.ProjectIndex`.
+
+Edges connect canonical function names ("flox_tpu.cache.clear_all" ->
+"flox_tpu.telemetry.MetricsRegistry.reset" is out of scope — method
+receivers are not resolved — but plain-function calls, including ones
+reached through import aliases and package re-exports, are). Each edge
+keeps its call sites so interprocedural rules (FLX008 reachability, FLX011
+helper-sync) can point findings at the exact offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from dataclasses import dataclass
+
+from .index import ProjectIndex
+from .rules.common import dotted_name
+
+
+@dataclass(frozen=True)
+class CallSite:
+    caller: str  #: canonical qualname of the calling function
+    callee: str  #: canonical qualname of the resolved project function
+    node: ast.Call
+
+
+class CallGraph:
+    def __init__(self) -> None:
+        #: caller qualname -> set of resolved project callee qualnames
+        self.edges: dict[str, set[str]] = {}
+        #: caller qualname -> call sites (resolved project calls only)
+        self.sites: dict[str, list[CallSite]] = {}
+
+    @classmethod
+    def build(cls, index: ProjectIndex) -> "CallGraph":
+        graph = cls()
+        for mod in index.modules.values():
+            for fi in mod.functions.values():
+                graph.edges.setdefault(fi.qualname, set())
+                graph.sites.setdefault(fi.qualname, [])
+                for call in _own_calls(fi.node):
+                    name = dotted_name(call.func)
+                    if name is None:
+                        continue
+                    resolved = index.resolve_symbol(mod.name, name)
+                    if resolved is None or index.function(resolved) is None:
+                        continue
+                    graph.edges[fi.qualname].add(resolved)
+                    graph.sites[fi.qualname].append(
+                        CallSite(caller=fi.qualname, callee=resolved, node=call)
+                    )
+        return graph
+
+    def callees(self, qualname: str) -> set[str]:
+        return self.edges.get(qualname, set())
+
+    def reachable(self, qualname: str, max_depth: int | None = None) -> set[str]:
+        """Functions reachable from ``qualname`` (excluded itself), BFS with
+        an optional depth bound (depth 1 = direct callees)."""
+        out: set[str] = set()
+        queue: deque[tuple[str, int]] = deque([(qualname, 0)])
+        while queue:
+            fn, depth = queue.popleft()
+            if max_depth is not None and depth >= max_depth:
+                continue
+            for callee in self.edges.get(fn, ()):
+                if callee not in out and callee != qualname:
+                    out.add(callee)
+                    queue.append((callee, depth + 1))
+        return out
+
+
+def _own_calls(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[ast.Call]:
+    """Call nodes in ``fn``'s own body, excluding nested function bodies
+    (those attribute to the nested function's own graph node)."""
+    calls: list[ast.Call] = []
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(child, ast.Call):
+                calls.append(child)
+            visit(child)
+
+    visit(fn)
+    return calls

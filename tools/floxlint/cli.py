@@ -1,6 +1,7 @@
-"""Command-line entry: ``python -m tools.floxlint flox_tpu/``.
+"""Command-line entry: ``python -m tools.floxlint flox_tpu/ tools/``.
 
-Exit codes: 0 clean, 1 findings, 2 usage/driver error."""
+Exit codes: 0 clean, 1 findings (new findings, or stale baseline entries —
+baseline drift), 2 usage/driver error."""
 
 from __future__ import annotations
 
@@ -8,25 +9,57 @@ import argparse
 import sys
 from typing import Sequence
 
-from .core import LintError, iter_python_files, lint_file
-from .core import _SuppressionIndex  # driver-internal, shared across files
-from .registry import RULES, get_rules
-from .reporting import format_human, format_json
+from .core import LintError, lint_run
+from .registry import RULES, get_rules, rule_id_range
 
 
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="floxlint",
-        description="JAX-hazard static analysis for flox_tpu (FLX001-FLX005).",
+        # derived from the registry so the blurb can never lag a new rule
+        description=f"JAX-hazard static analysis for flox_tpu ({rule_id_range()}).",
     )
     parser.add_argument("paths", nargs="*", help="files or directories to lint")
     parser.add_argument(
-        "--format", choices=("human", "json"), default="human", help="output format"
+        "--format",
+        choices=("human", "json", "sarif"),
+        default="human",
+        help="output format (sarif emits a SARIF 2.1.0 log for code scanning)",
     )
     parser.add_argument(
         "--select", help="comma-separated rule ids to run (default: all)"
     )
     parser.add_argument("--ignore", help="comma-separated rule ids to skip")
+    parser.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help=(
+            "suppression baseline: known findings recorded in FILE are not "
+            "reported; entries whose finding no longer fires are baseline "
+            "drift and fail the run"
+        ),
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="write the current findings to --baseline and exit 0",
+    )
+    parser.add_argument(
+        "--fix",
+        action="store_true",
+        help=(
+            "apply autofixes for the mechanical rules (FLX007 eager logging "
+            "-> lazy %%-args, FLX004 version-gate wrapping), then re-lint"
+        ),
+    )
+    parser.add_argument(
+        "--index-cache",
+        metavar="FILE",
+        help=(
+            "pickle the project index here and reuse it while the tree is "
+            "byte-identical (CI shares it between lint steps)"
+        ),
+    )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule table and exit"
     )
@@ -42,6 +75,9 @@ def main(argv: Sequence[str] | None = None) -> int:
     if not args.paths:
         print("floxlint: no paths given (try: python -m tools.floxlint flox_tpu/)", file=sys.stderr)
         return 2
+    if args.update_baseline and not args.baseline:
+        print("floxlint: --update-baseline requires --baseline FILE", file=sys.stderr)
+        return 2
     try:
         rules = get_rules(
             args.select.split(",") if args.select else None,
@@ -50,17 +86,64 @@ def main(argv: Sequence[str] | None = None) -> int:
     except KeyError as exc:
         print(f"floxlint: {exc.args[0]}", file=sys.stderr)
         return 2
-    index = _SuppressionIndex()
-    findings = set()
-    files_checked = 0
     try:
-        for path, root in iter_python_files(args.paths):
-            files_checked += 1
-            findings.update(lint_file(path, rules, root=root, _index=index))
+        findings, files_checked = lint_run(
+            args.paths, rules, index_cache=args.index_cache
+        )
+        if args.fix:
+            from .autofix import FIXABLE_RULES, fix_paths
+
+            fixable_paths = {f.path for f in findings if f.rule in FIXABLE_RULES}
+            fixed = fix_paths(sorted(fixable_paths))
+            if fixed:
+                total = sum(fixed.values())
+                print(
+                    f"floxlint: fixed {total} finding(s) in {len(fixed)} file(s)",
+                    file=sys.stderr,
+                )
+                findings, files_checked = lint_run(
+                    args.paths, rules, index_cache=args.index_cache
+                )
     except LintError as exc:
         print(f"floxlint: {exc}", file=sys.stderr)
         return 2
-    ordered = sorted(findings)
-    formatter = format_json if args.format == "json" else format_human
-    print(formatter(ordered, files_checked=files_checked))
-    return 1 if ordered else 0
+
+    stale: list[dict] = []
+    if args.baseline:
+        from .baseline import apply_baseline, load_baseline, write_baseline
+
+        if args.update_baseline:
+            n = write_baseline(args.baseline, findings)
+            print(
+                f"floxlint: baseline {args.baseline} updated with {n} entry(ies) "
+                f"covering {len(findings)} finding(s)",
+                file=sys.stderr,
+            )
+            return 0
+        try:
+            entries = load_baseline(args.baseline)
+        except LintError as exc:
+            print(f"floxlint: {exc}", file=sys.stderr)
+            return 2
+        findings, stale = apply_baseline(findings, entries)
+        for entry in stale:
+            print(
+                "floxlint: baseline drift: "
+                f"{entry.get('path')}: {entry.get('rule')} fires fewer times "
+                f"than baselined — shrink or remove the entry in {args.baseline}",
+                file=sys.stderr,
+            )
+
+    if args.format == "sarif":
+        from .sarif import format_sarif
+
+        print(format_sarif(findings, rules, files_checked=files_checked))
+    elif args.format == "json":
+        from .reporting import format_json
+
+        print(format_json(findings, files_checked=files_checked))
+    else:
+        from .reporting import format_human
+
+        print(format_human(findings, files_checked=files_checked))
+    return 1 if findings or stale else 0

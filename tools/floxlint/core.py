@@ -11,6 +11,15 @@ from pathlib import Path
 from typing import Iterable, Iterator, Sequence
 
 _SUPPRESS_RE = re.compile(r"#\s*floxlint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_,\s]+)")
+#: ``# noqa: FLX001[,FLX002]`` is accepted as a line-disable alias — the ids
+#: are mandatory, so ruff-style bare ``# noqa`` (or ``# noqa: E722``) never
+#: silences floxlint findings
+_NOQA_RE = re.compile(r"#\s*noqa:\s*((?:FLX\d{3}[,\s]*)+)", re.IGNORECASE)
+
+#: directory names pruned while recursing into a lint root (passing such a
+#: directory — or a file inside one — explicitly still lints it: the
+#: self-test suite lints the seeded fixture corpus that way)
+_PRUNED_DIR_NAMES = frozenset({"fixtures"})
 
 
 @dataclass(frozen=True, order=True)
@@ -66,15 +75,21 @@ def parse_suppressions(source: str) -> Suppressions:
         if tok.type != tokenize.COMMENT:
             continue
         m = _SUPPRESS_RE.search(tok.string)
-        if not m:
-            continue
-        kind, raw = m.group(1), m.group(2)
-        rules = frozenset(r.strip().upper() for r in raw.split(",") if r.strip())
-        if kind == "disable-file":
-            file_rules |= rules
+        if m:
+            kind, raw = m.group(1), m.group(2)
+            rules = frozenset(r.strip().upper() for r in raw.split(",") if r.strip())
+            if kind == "disable-file":
+                file_rules |= rules
+                continue
         else:
-            line = tok.start[0]
-            line_rules[line] = line_rules.get(line, frozenset()) | rules
+            m = _NOQA_RE.search(tok.string)
+            if not m:
+                continue
+            rules = frozenset(
+                r.upper() for r in re.findall(r"FLX\d{3}", m.group(1), re.IGNORECASE)
+            )
+        line = tok.start[0]
+        line_rules[line] = line_rules.get(line, frozenset()) | rules
     return Suppressions(file_rules=frozenset(file_rules), line_rules=line_rules)
 
 
@@ -91,6 +106,23 @@ class FileContext:
     @property
     def display_path(self) -> str:
         return str(self.path)
+
+
+@dataclass
+class ProjectContext:
+    """Everything a project-scoped rule (``scope = "project"``) needs: the
+    lint root, the parsed-once :class:`~tools.floxlint.index.ProjectIndex`,
+    and the call graph over it. Rules implement ``check_project(pctx)``
+    instead of ``check(ctx)``."""
+
+    root: Path
+    index: "object"  #: tools.floxlint.index.ProjectIndex
+    callgraph: "object"  #: tools.floxlint.callgraph.CallGraph
+
+
+def rule_scope(rule) -> str:
+    """"file" (default) or "project"."""
+    return getattr(rule, "scope", "file")
 
 
 class _SuppressionIndex:
@@ -118,11 +150,19 @@ class _SuppressionIndex:
 
 
 def iter_python_files(paths: Sequence[str | Path]) -> Iterator[tuple[Path, Path]]:
-    """Yield (file, lint_root) pairs for every .py under ``paths``."""
+    """Yield (file, lint_root) pairs for every .py under ``paths``.
+
+    Directories named in ``_PRUNED_DIR_NAMES`` ("fixtures") strictly below a
+    given root are skipped — ``floxlint tools/`` must not lint the seeded
+    violation corpus — but a pruned directory passed explicitly as a path is
+    linted in full (that is how the self-tests exercise the corpus)."""
     for raw in paths:
         p = Path(raw)
         if p.is_dir():
             for f in sorted(p.rglob("*.py")):
+                rel_dirs = f.relative_to(p).parts[:-1]
+                if any(part in _PRUNED_DIR_NAMES for part in rel_dirs):
+                    continue
                 yield f, p
         elif p.is_file():
             yield p, p.parent
@@ -162,15 +202,80 @@ def lint_file(
     ctx = FileContext(path=path, source=source, tree=tree, root=root)
     findings: list[Finding] = []
     for rule in rules if rules is not None else get_rules():
+        if rule_scope(rule) != "file":
+            continue  # project rules run once per root, via lint_paths
         findings.extend(rule.check(ctx))
     return sorted(f for f in findings if not index.suppressed(f))
 
 
-def lint_paths(paths: Sequence[str | Path], rules: Iterable | None = None) -> list[Finding]:
-    """Lint files/directories; deduplicates findings (package-level rules can
-    re-derive the same finding from several entry files)."""
+def run_project_rules(
+    project_rules: Sequence,
+    files: Sequence[Path],
+    root: Path,
+    _index: _SuppressionIndex | None = None,
+    project_index=None,
+) -> list[Finding]:
+    """Run ``scope == "project"`` rules once over ``files`` (one lint root),
+    returning suppression-filtered findings. ``project_index`` short-circuits
+    the parse when the caller restored one from ``--index-cache``."""
+    if not project_rules:
+        return []
+    from .callgraph import CallGraph
+    from .index import ProjectIndex
+
+    index = _index if _index is not None else _SuppressionIndex()
+    pidx = project_index if project_index is not None else ProjectIndex.build(files, root)
+    pctx = ProjectContext(root=root, index=pidx, callgraph=CallGraph.build(pidx))
+    findings: list[Finding] = []
+    for rule in project_rules:
+        findings.extend(rule.check_project(pctx))
+    return sorted(f for f in findings if not index.suppressed(f))
+
+
+def lint_run(
+    paths: Sequence[str | Path],
+    rules: Iterable | None = None,
+    *,
+    index_cache: str | Path | None = None,
+) -> tuple[list[Finding], int]:
+    """The one driver loop: file rules per file, project rules once per
+    lint root over its whole file set, findings deduplicated (package-level
+    rules can re-derive the same finding from several entry files).
+    Returns (findings, files_checked). ``index_cache`` round-trips the
+    parsed project index through a pickle while the tree is byte-identical
+    (CI shares it between the gate and SARIF steps)."""
+    from .registry import get_rules
+
+    all_rules = list(rules) if rules is not None else get_rules()
+    project_rules = [r for r in all_rules if rule_scope(r) == "project"]
     index = _SuppressionIndex()
     out: set[Finding] = set()
+    files_checked = 0
+    groups: dict[Path, list[Path]] = {}
     for f, lint_root in iter_python_files(paths):
-        out.update(lint_file(f, rules, root=lint_root, _index=index))
-    return sorted(out)
+        files_checked += 1
+        out.update(lint_file(f, all_rules, root=lint_root, _index=index))
+        groups.setdefault(lint_root, []).append(f)
+    for lint_root in sorted(groups):
+        files = groups[lint_root]
+        cached = None
+        if index_cache or project_rules:
+            from . import index as index_mod
+
+            if index_cache:
+                cached = index_mod.load_cached(Path(index_cache), files, lint_root)
+            if cached is None:
+                cached = index_mod.ProjectIndex.build(files, lint_root)
+                if index_cache:
+                    index_mod.save_cache(Path(index_cache), cached, files)
+        out.update(
+            run_project_rules(
+                project_rules, files, lint_root, _index=index, project_index=cached
+            )
+        )
+    return sorted(out), files_checked
+
+
+def lint_paths(paths: Sequence[str | Path], rules: Iterable | None = None) -> list[Finding]:
+    """Findings-only wrapper over :func:`lint_run` (the stable public API)."""
+    return lint_run(paths, rules)[0]

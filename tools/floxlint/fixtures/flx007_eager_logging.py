@@ -1,9 +1,11 @@
-"""Seeded FLX007 violations: eager-formatted logging and bare print in
-library code.
+"""Seeded FLX007 violations: eager-formatted logging in library code.
 
 Every violating line carries the corpus's trailing expect-marker; the clean
 shapes below pin the rule's negative space (lazy %-args, constant messages,
-prints inside main()/__main__ guards, non-logger .debug attributes).
+non-logger .debug attributes). Every violation in THIS file is mechanically
+fixable — ``--fix`` must rewrite it to lazy %-args so the output re-lints
+clean and is byte-stable on a second pass (the bare-print half of FLX007,
+which has no mechanical fix, lives in flx007_print.py).
 """
 
 import logging
@@ -16,12 +18,24 @@ def eager_fstring(ngroups):
     logger.debug(f"ngroups={ngroups}")  # expect: FLX007
 
 
+def eager_fstring_multi(nslabs, nbytes):
+    logger.debug(f"staged {nslabs} slabs ({nbytes} bytes, 100% done)")  # expect: FLX007
+
+
 def eager_percent(size):
     logger.info("size=%d" % size)  # expect: FLX007
 
 
+def eager_percent_tuple(start, stop):
+    logger.info("slab [%d, %d)" % (start, stop))  # expect: FLX007
+
+
 def eager_concat(name):
     logger.warning("failed for " + name)  # expect: FLX007
+
+
+def eager_concat_str_call(count):
+    logger.warning("retries=" + str(count))  # expect: FLX007
 
 
 def eager_format(path):
@@ -34,10 +48,6 @@ def eager_log_method(level, n):
 
 def eager_inline_getlogger(x):
     logging.getLogger("flox_tpu").debug(f"x={x}")  # expect: FLX007
-
-
-def bare_print(result):
-    print(result)  # expect: FLX007
 
 
 def clean_lazy_args(ngroups, size):
@@ -59,13 +69,3 @@ def clean_not_a_logger(tracer, x):
 
 def clean_numeric_binop(a, b):
     logger.debug("%s", a + b)
-
-
-def main(argv=None):
-    # the CLI surface: print IS the output channel here
-    print("report follows")
-    return 0
-
-
-if __name__ == "__main__":
-    print("running fixture as a script")

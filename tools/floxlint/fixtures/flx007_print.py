@@ -1,0 +1,27 @@
+"""Seeded FLX007 violations: bare ``print`` in library code.
+
+Split from flx007_eager_logging.py because print has NO mechanical fix
+(rewriting it needs a logger decision) — keeping it here lets the autofix
+self-tests require the eager-logging fixture to re-lint fully clean after
+``--fix``. The clean shapes pin the CLI exemptions: prints inside ``main``
+functions and under ``if __name__ == "__main__":`` are the sanctioned
+output channel.
+"""
+
+
+def bare_print(result):
+    print(result)  # expect: FLX007
+
+
+def bare_print_formatted(ngroups):
+    print(f"ngroups={ngroups}")  # expect: FLX007
+
+
+def main(argv=None):
+    # the CLI surface: print IS the output channel here
+    print("report follows")
+    return 0
+
+
+if __name__ == "__main__":
+    print("running fixture as a script")

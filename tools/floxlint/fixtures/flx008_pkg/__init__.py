@@ -1,0 +1,2 @@
+"""FLX008 fixture package: a mini flox_tpu with a ``cache`` module whose
+``clear_all`` misses one runtime cache (see registries.py markers)."""

@@ -1,0 +1,10 @@
+"""Cache module for the FLX008 fixture: ``clear_all`` clears the named
+cache directly and the probe memo through a one-level helper call, but
+misses ``_ORPHAN_CACHE``."""
+
+
+def clear_all():
+    from .registries import _CLEARED_CACHE, reset_probes
+
+    _CLEARED_CACHE.clear()
+    reset_probes()

@@ -1,0 +1,36 @@
+"""Module-level state for the FLX008 fixture.
+
+``_CLEARED_CACHE`` is referenced by ``cache.clear_all`` directly,
+``_PROBE_RESULT`` through the one-level ``reset_probes`` helper — both
+clean. ``_ORPHAN_CACHE`` accretes at runtime but is unreachable from
+``clear_all``: the seeded violation. ``KERNELS`` is a static registry
+populated at import time only, which the rule must exempt (tables are not
+caches), and ``_SCRATCH`` mutates at runtime but is not cache-named."""
+
+_CLEARED_CACHE: dict = {}
+_ORPHAN_CACHE: dict = {}  # expect: FLX008
+_PROBE_RESULT: list = []
+
+KERNELS = {
+    "sum": sum,
+    "max": max,
+}
+
+_SCRATCH: list = []
+
+
+def remember(key, value):
+    _CLEARED_CACHE[key] = value
+    _ORPHAN_CACHE[key] = value
+    _SCRATCH.append(key)
+    return value
+
+
+def probe_once():
+    if not _PROBE_RESULT:
+        _PROBE_RESULT.append(True)
+    return _PROBE_RESULT[0]
+
+
+def reset_probes():
+    _PROBE_RESULT.clear()

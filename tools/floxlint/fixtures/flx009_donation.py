@@ -1,0 +1,96 @@
+"""FLX009 fixture: donated buffers referenced after dispatch.
+
+The donation idiom (pipeline.maybe_donate / jax.jit donate_argnums) lets
+XLA alias the carry into the output; the buffer passed in is dead after the
+call. The seeded violations reference it anyway; the clean shapes pin the
+sanctioned carry idiom (rebind the result to the same name) and non-Name
+arguments the rule must leave alone."""
+
+import jax
+import jax.numpy as jnp
+
+
+def maybe_donate(fun, *, donate_argnums):
+    # stand-in for flox_tpu.pipeline.maybe_donate (basename-matched)
+    return jax.jit(fun, donate_argnums=donate_argnums)
+
+
+def build_step():
+    def step(state, slab):
+        return state + jnp.sum(slab)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def bad_direct_jit(state, slab):
+    step = jax.jit(lambda acc, x: acc + x, donate_argnums=(0,))
+    out = step(state, slab)
+    return out + state  # expect: FLX009
+
+
+def bad_through_factory(state, slab):
+    step = build_step()
+    new = step(state, slab)
+    total = jnp.sum(state)  # expect: FLX009
+    return new, total
+
+
+def bad_maybe_donate(state, slab):
+    jitted = maybe_donate(lambda acc, x: acc + x, donate_argnums=(0,))
+    out = jitted(state, slab)
+    del out
+    return state  # expect: FLX009
+
+
+def bad_second_position(prefix, counts, slab):
+    update = jax.jit(lambda p, c, s: (p, c + s), donate_argnums=(1,))
+    prefix, new_counts = update(prefix, counts, slab)
+    return new_counts + counts.shape[0], counts  # expect: FLX009
+
+
+def bad_loop_redonation(state, slabs, outs):
+    step = build_step()
+    for slab in slabs:
+        outs.append(step(state, slab))  # expect: FLX009
+    return outs
+
+
+def clean_carry_rebind(state, slabs):
+    step = build_step()
+    for slab in slabs:
+        state = step(state, slab)
+    return state
+
+
+def clean_loop_rebind_later(state, slabs):
+    step = build_step()
+    for slab in slabs:
+        out = step(state, slab)
+        state = out
+    return state
+
+
+def clean_tuple_rebind(prefix, counts, slab):
+    update = jax.jit(lambda p, c, s: (p + 1, c + s), donate_argnums=(0, 1))
+    prefix, counts = update(prefix, counts, slab)
+    return prefix, counts
+
+
+def clean_fresh_value(slabs):
+    step = build_step()
+    state = jnp.zeros((8,))
+    for slab in slabs:
+        state = step(state, slab)
+    return state
+
+
+def clean_expression_arg(state, slab):
+    step = build_step()
+    out = step(state + 0.0, slab)  # donated operand is a fresh temporary
+    return out + state
+
+
+def clean_undonated(state, slab):
+    plain = jax.jit(lambda acc, x: acc + x)
+    out = plain(state, slab)
+    return out + state

@@ -1,0 +1,28 @@
+"""FLX010 fixture: OPTIONS fields drifting from their env/validator mirrors.
+
+``good_knob`` carries the full triangle (env mirror + validator; the docs
+leg is skipped here because the fixture corpus has no docs/ directory next
+to its lint root). The seeded violations drop one leg each."""
+
+import os
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+OPTIONS = {
+    "good_knob": _env_int("FLOX_TPU_GOOD_KNOB", 4),
+    "good_path_knob": os.environ.get("FLOX_TPU_GOOD_PATH_KNOB") or None,
+    "no_env_mirror": 0.25,  # expect: FLX010
+    "no_validator": _env_int("FLOX_TPU_NO_VALIDATOR", 8),  # expect: FLX010
+}
+
+_VALIDATORS = {
+    "good_knob": lambda x: x >= 0,
+    "good_path_knob": lambda x: x is None or isinstance(x, str),
+    "no_env_mirror": lambda x: 0 < x <= 1,
+}

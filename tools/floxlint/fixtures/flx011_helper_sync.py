@@ -1,0 +1,67 @@
+"""FLX011 fixture: host-syncs leaking through local helpers.
+
+FLX001 cannot see these — the sync lives in a plain (untraced) helper —
+but the call happens inside a jitted region, so the device->host pull still
+lands mid-program. The clean shapes pin the negative space: helpers that
+stay on device, helpers fed static metadata, and host-side callers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _threshold(value):
+    return float(value) > 0.5
+
+
+def _to_host(block):
+    return np.asarray(block)
+
+
+def _item_of(arr):
+    first = arr.reshape(-1)
+    return first.item()
+
+
+def _on_device(block):
+    return jnp.sum(block)
+
+
+def _shape_of(block):
+    # metadata-only helper: no sync on the value itself
+    return block.shape[-1]
+
+
+@jax.jit
+def bad_helper_sync(x):
+    total = jnp.sum(x)
+    if _threshold(total):  # expect: FLX011
+        return x
+    return x * 2
+
+
+@jax.jit
+def bad_helper_np(x):
+    host = _to_host(x)  # expect: FLX011
+    return x + host.shape[0]
+
+
+@jax.jit
+def bad_helper_item(x):
+    return x * _item_of(x)  # expect: FLX011
+
+
+@jax.jit
+def clean_helper_on_device(x):
+    return _on_device(x) + 1
+
+
+@jax.jit
+def clean_metadata_helper(x):
+    return x / _shape_of(x)
+
+
+def clean_host_side_caller(values):
+    # not traced: helpers may sync freely here
+    arr = _to_host(values)
+    return _threshold(arr.mean())

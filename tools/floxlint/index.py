@@ -1,0 +1,292 @@
+"""Project index: the whole lint tree parsed once.
+
+Where the per-file rules (FLX001–FLX007) see one ``ast.Module`` at a time,
+the semantic rules (FLX008–FLX011) need whole-program facts: which module
+defines ``clear_all``, what ``from .pipeline import maybe_donate`` resolves
+to, which helper a traced function is really calling. :class:`ProjectIndex`
+parses every file under a lint root once and exposes
+
+* a module table (dotted name -> :class:`ModuleInfo` with source, tree,
+  imports, top-level definitions),
+* a symbol table with resolved imports — ``from x import y as z`` and
+  package re-exports are followed to the defining module, and
+* per-function records (:class:`FunctionInfo`) the call graph builds on.
+
+The index is pure AST — nothing is imported — so it is safe to build over
+fixture corpora that would crash at import time. It pickles cleanly;
+:func:`load_cached` / :func:`save_cache` give the CLI's ``--index-cache``
+a content-hashed reuse path so CI builds the index once per tree state.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import pickle
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Sequence
+
+from .rules.common import ImportMap
+
+
+def module_name_for(path: Path) -> str:
+    """Dotted module name, derived from the filesystem package structure
+    (climb while ``__init__.py`` exists). Loose files resolve to their stem."""
+    path = path.resolve()
+    parts: list[str] = [] if path.name == "__init__.py" else [path.stem]
+    d = path.parent
+    while (d / "__init__.py").exists() and d.name:
+        parts.insert(0, d.name)
+        parent = d.parent
+        if parent == d:
+            break
+        d = parent
+    return ".".join(parts) if parts else path.stem
+
+
+@dataclass
+class FunctionInfo:
+    """One function or method definition, addressable by canonical name."""
+
+    qualname: str  #: canonical, e.g. "flox_tpu.cache.clear_all" / "mod.Cls.fn"
+    name: str
+    module: str
+    path: Path
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+
+
+#: import-alias kinds: a name bound to a module vs to a symbol in a module
+_MODULE, _SYMBOL = "module", "symbol"
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    path: Path
+    source: str
+    tree: ast.Module
+    #: the per-file alias map the file rules already use (absolute imports)
+    imports: ImportMap
+    #: canonical-name -> function/method defined here (any nesting level)
+    functions: dict[str, FunctionInfo] = field(default_factory=dict)
+    #: top-level names defined here (functions, classes, assignments)
+    definitions: dict[str, ast.AST] = field(default_factory=dict)
+    #: local alias -> (kind, target module, original symbol name or "")
+    aliases: dict[str, tuple[str, str, str]] = field(default_factory=dict)
+
+    @property
+    def package(self) -> str:
+        """Top-level package component ("flox_tpu" for flox_tpu.cache)."""
+        return self.name.partition(".")[0]
+
+
+class ProjectIndex:
+    """Symbol-resolved view of every module under one lint root."""
+
+    def __init__(self, root: Path) -> None:
+        self.root = root
+        self.modules: dict[str, ModuleInfo] = {}
+        self.by_path: dict[str, ModuleInfo] = {}
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def build(cls, files: Sequence[Path], root: Path) -> "ProjectIndex":
+        index = cls(Path(root))
+        for path in sorted(set(Path(f) for f in files)):
+            try:
+                source = path.read_text()
+                tree = ast.parse(source, filename=str(path))
+            except (OSError, SyntaxError):
+                continue  # the driver reports these as FLX000 per file
+            index._add_module(path, source, tree)
+        # aliases resolve against the full module table, so second pass
+        for mod in index.modules.values():
+            index._collect_aliases(mod)
+        return index
+
+    def _add_module(self, path: Path, source: str, tree: ast.Module) -> None:
+        name = module_name_for(path)
+        mod = ModuleInfo(
+            name=name, path=path, source=source, tree=tree,
+            imports=ImportMap.from_tree(tree),
+        )
+        self._collect_definitions(mod)
+        self._collect_functions(mod)
+        self.modules[name] = mod
+        self.by_path[str(path)] = mod
+
+    def _collect_definitions(self, mod: ModuleInfo) -> None:
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                mod.definitions[node.name] = node
+            elif isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        mod.definitions[target.id] = node
+            elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                mod.definitions[node.target.id] = node
+
+    def _collect_aliases(self, mod: ModuleInfo) -> None:
+        """Alias table covering relative imports and function-local imports
+        (``clear_all`` imports its caches inside its own body)."""
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.partition(".")[0]
+                    target = a.name if a.asname else a.name.partition(".")[0]
+                    mod.aliases.setdefault(local, (_MODULE, target, ""))
+            elif isinstance(node, ast.ImportFrom):
+                base = self._import_base(mod, node)
+                if base is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    candidate = f"{base}.{a.name}"
+                    if self._is_known_module(candidate):
+                        mod.aliases.setdefault(local, (_MODULE, candidate, ""))
+                    else:
+                        mod.aliases.setdefault(local, (_SYMBOL, base, a.name))
+
+    def _is_known_module(self, dotted: str) -> bool:
+        if dotted in self.modules:
+            return True
+        # modules outside the lint set but inside the source tree (a single
+        # linted file importing a sibling) resolve via the filesystem
+        rel = Path(*dotted.split("."))
+        for base in (self.root, self.root.parent):
+            if (base / rel).with_suffix(".py").exists():
+                return True
+            if (base / rel / "__init__.py").exists():
+                return True
+        return False
+
+    @staticmethod
+    def _import_base(mod: ModuleInfo, node: ast.ImportFrom) -> str | None:
+        """Absolute module path an ImportFrom pulls from (relative imports
+        resolved against the importing module's package)."""
+        if node.level == 0:
+            return node.module
+        parts = mod.name.split(".")
+        if mod.path.name != "__init__.py":
+            parts = parts[:-1]  # a plain module's package drops the leaf
+        climb = node.level - 1  # level 1 = current package
+        if climb > len(parts):
+            return None
+        if climb:
+            parts = parts[:-climb]
+        if node.module:
+            parts = parts + node.module.split(".")
+        return ".".join(parts) or None
+
+    def _collect_functions(self, mod: ModuleInfo) -> None:
+        def visit(node: ast.AST, stack: list[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = ".".join([mod.name, *stack, child.name])
+                    mod.functions[qual] = FunctionInfo(
+                        qualname=qual, name=child.name, module=mod.name,
+                        path=mod.path, node=child,
+                    )
+                    visit(child, stack + [child.name])
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, stack + [child.name])
+                else:
+                    visit(child, stack)
+
+        visit(mod.tree, [])
+
+    # -- resolution ---------------------------------------------------------
+
+    def function(self, canonical: str) -> FunctionInfo | None:
+        for mod in self.modules.values():
+            fi = mod.functions.get(canonical)
+            if fi is not None:
+                return fi
+        return None
+
+    def resolve_symbol(
+        self, module: str, dotted: str, _seen: frozenset[tuple[str, str]] = frozenset()
+    ) -> str | None:
+        """Canonical "defining_module.symbol" for a dotted name as written in
+        ``module``; follows from-import chains (package re-exports) to the
+        definition site. None for names outside the project (jax, numpy,
+        builtins)."""
+        mod = self.modules.get(module)
+        if mod is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        if (module, head) in _seen:
+            return None
+        if head in mod.definitions:
+            return f"{module}.{dotted}" if rest else f"{module}.{head}"
+        alias = mod.aliases.get(head)
+        if alias is None:
+            return None
+        kind, target, orig = alias
+        if kind == _MODULE:
+            if not rest:
+                return target if target in self.modules else None
+            if target in self.modules:
+                return self.resolve_symbol(target, rest, _seen | {(module, head)})
+            return None
+        resolved = self.resolve_symbol(target, orig, _seen | {(module, head)})
+        if resolved is None:
+            return None
+        return f"{resolved}.{rest}" if rest else resolved
+
+
+# -- pickle cache (--index-cache / CI reuse) --------------------------------
+
+
+def tree_fingerprint(files: Sequence[Path]) -> str:
+    """Content hash over the sorted file set — any edit invalidates it."""
+    h = hashlib.sha256()
+    for path in sorted(set(Path(f) for f in files)):
+        h.update(str(path).encode())
+        try:
+            h.update(path.read_bytes())
+        except OSError:
+            h.update(b"<unreadable>")
+    return h.hexdigest()
+
+
+def load_cached(
+    cache_path: Path, files: Sequence[Path], root: Path
+) -> ProjectIndex | None:
+    """Cached index for ``root`` if the tree is byte-identical, else None."""
+    try:
+        with open(cache_path, "rb") as f:
+            payload = pickle.load(f)
+    except (OSError, pickle.UnpicklingError, EOFError, AttributeError, ImportError):
+        return None
+    entry = payload.get(str(root)) if isinstance(payload, dict) else None
+    if not entry or entry.get("fingerprint") != tree_fingerprint(files):
+        return None
+    index = entry.get("index")
+    return index if isinstance(index, ProjectIndex) else None
+
+
+def save_cache(cache_path: Path, index: ProjectIndex, files: Sequence[Path]) -> None:
+    """Merge this root's index into the cache file (best-effort: an
+    unwritable cache never fails the lint)."""
+    payload: dict = {}
+    try:
+        with open(cache_path, "rb") as f:
+            existing = pickle.load(f)
+        if isinstance(existing, dict):
+            payload = existing
+    except (OSError, pickle.UnpicklingError, EOFError, AttributeError, ImportError):
+        pass
+    payload[str(index.root)] = {
+        "fingerprint": tree_fingerprint(files),
+        "index": index,
+    }
+    try:
+        with open(cache_path, "wb") as f:
+            pickle.dump(payload, f)
+    except OSError:
+        pass

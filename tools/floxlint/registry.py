@@ -9,6 +9,10 @@ from .rules.flx004_version import VersionGatedApiRule
 from .rules.flx005_api import UntypedPublicApiRule
 from .rules.flx006_swallow import SwallowedRetryExceptionRule
 from .rules.flx007_logging import EagerLoggingRule
+from .rules.flx008_cache_registry import CacheRegistryRule
+from .rules.flx009_donation import DonationAfterUseRule
+from .rules.flx010_options_drift import OptionsEnvDriftRule
+from .rules.flx011_helper_sync import HelperHostSyncRule
 
 #: id -> rule instance, in id order
 RULES = {
@@ -21,8 +25,19 @@ RULES = {
         UntypedPublicApiRule(),
         SwallowedRetryExceptionRule(),
         EagerLoggingRule(),
+        CacheRegistryRule(),
+        DonationAfterUseRule(),
+        OptionsEnvDriftRule(),
+        HelperHostSyncRule(),
     )
 }
+
+
+def rule_id_range() -> str:
+    """Human-readable id span ("FLX001-FLX011"), derived — never hardcoded —
+    so the CLI description can't drift from the registry."""
+    ids = sorted(RULES)
+    return f"{ids[0]}-{ids[-1]}" if len(ids) > 1 else ids[0]
 
 
 def get_rules(select: list[str] | None = None, ignore: list[str] | None = None) -> list:

@@ -73,27 +73,9 @@ class EagerLoggingRule:
         )
 
     def _check_log(self, ctx: FileContext, node: ast.Call) -> Finding | None:
-        func = node.func
-        if not (isinstance(func, ast.Attribute) and func.attr in _LOG_METHODS):
+        msg = log_message_arg(node)
+        if msg is None:
             return None
-        receiver = func.value
-        recv_name = None
-        if isinstance(receiver, ast.Name):
-            recv_name = receiver.id
-        elif isinstance(receiver, ast.Attribute):
-            recv_name = receiver.attr
-        elif isinstance(receiver, ast.Call):
-            # logging.getLogger(...).debug(...)
-            inner = receiver.func
-            if isinstance(inner, ast.Attribute) and inner.attr == "getLogger":
-                recv_name = "logger"
-        if recv_name is None or recv_name.lower() not in _LOGGER_NAMES:
-            return None
-        # .log(level, msg, ...) carries the message second
-        args = node.args[1:] if func.attr == "log" else node.args
-        if not args:
-            return None
-        msg = args[0]
         how = _eager_kind(msg)
         if how is None:
             return None
@@ -107,6 +89,32 @@ class EagerLoggingRule:
                 'off; use lazy %-style args: logger.debug("x=%s", x)'
             ),
         )
+
+
+def log_message_arg(node: ast.Call) -> ast.AST | None:
+    """The message argument of a logging call (``logger.debug(msg, ...)`` /
+    ``logger.log(level, msg, ...)``), or None when ``node`` is not a logging
+    call. Shared by the rule and the ``--fix`` rewriter so they cannot
+    disagree about what counts as a log call."""
+    func = node.func
+    if not (isinstance(func, ast.Attribute) and func.attr in _LOG_METHODS):
+        return None
+    receiver = func.value
+    recv_name = None
+    if isinstance(receiver, ast.Name):
+        recv_name = receiver.id
+    elif isinstance(receiver, ast.Attribute):
+        recv_name = receiver.attr
+    elif isinstance(receiver, ast.Call):
+        # logging.getLogger(...).debug(...)
+        inner = receiver.func
+        if isinstance(inner, ast.Attribute) and inner.attr == "getLogger":
+            recv_name = "logger"
+    if recv_name is None or recv_name.lower() not in _LOGGER_NAMES:
+        return None
+    # .log(level, msg, ...) carries the message second
+    args = node.args[1:] if func.attr == "log" else node.args
+    return args[0] if args else None
 
 
 def _eager_kind(msg: ast.AST) -> str | None:

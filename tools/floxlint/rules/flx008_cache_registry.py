@@ -1,0 +1,216 @@
+"""FLX008 — cache-registry completeness.
+
+``cache.clear_all`` is the package's analogue of the reference's
+``flox.cache.cache.clear()``: benchmarks clear it between timing rounds and
+tests rely on it to reset process state. Every module-level mutable cache
+that accretes entries at runtime must therefore be reachable from it — a
+cache that ``clear_all`` misses leaks memory across benchmark rounds and
+lets one test's compiled programs poison the next's counters. PR 2 guarded
+this with a runtime introspection test; this rule makes the same invariant
+static, so a new ``_FOO_CACHE`` without the matching ``clear_all`` entry
+fails the lint before any test runs.
+
+Scope: modules in the same top-level package as a ``*.cache`` module that
+defines ``clear_all``. A candidate is a module-level ALL_CAPS name whose
+name marks it as cache-like (CACHE / MEMO / REGISTRY / SNAPSHOT / PROBE),
+bound to a mutable container literal or constructor, and mutated from at
+least one function body (import-time-populated static registries such as
+``AGGREGATIONS`` or ``KERNELS`` are exempt: they are tables, not caches).
+Reachability is name-based, matching the runtime test: the candidate's name
+must appear in ``clear_all``'s body or in the body of a function
+``clear_all`` directly calls (one level through the call graph).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import TYPE_CHECKING, Iterator
+
+from ..core import Finding
+from .common import dotted_name
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..core import ProjectContext
+
+_NAME_TOKEN = re.compile(r"CACHE|MEMO|REGISTR|SNAPSHOT|PROBE")
+_CONTAINER_CALLS = frozenset(
+    {"dict", "list", "set", "defaultdict", "OrderedDict", "Counter", "deque"}
+)
+_MUTATING_METHODS = frozenset(
+    {"append", "add", "update", "setdefault", "extend", "insert", "clear",
+     "pop", "popitem", "remove", "discard", "appendleft"}
+)
+
+
+class CacheRegistryRule:
+    id = "FLX008"
+    name = "cache-registry-completeness"
+    description = (
+        "module-level mutable cache/registry that accretes at runtime but is "
+        "not reachable from cache.clear_all"
+    )
+    scope = "project"
+
+    def check_project(self, pctx: "ProjectContext") -> Iterator[Finding]:
+        index = pctx.index
+        mutating_positions = _param_mutating_positions(index)
+        for mod in index.modules.values():
+            if mod.name.rpartition(".")[2] != "cache":
+                continue
+            clear_all = mod.functions.get(f"{mod.name}.clear_all")
+            if clear_all is None:
+                continue
+            cleared = _names_reached_from(pctx, clear_all.qualname)
+            package = mod.package
+            for other in index.modules.values():
+                if other.package != package:
+                    continue
+                for cand_name, node in _candidates(other, pctx, mutating_positions):
+                    if cand_name in cleared:
+                        continue
+                    yield Finding(
+                        path=str(other.path),
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.id,
+                        message=(
+                            f"module-level cache/registry `{cand_name}` in "
+                            f"`{other.name}` accretes at runtime but is never "
+                            f"cleared by `{mod.name}.clear_all` — register it "
+                            "there (or suppress with a rationale if it is "
+                            "deliberately process-lifetime state)"
+                        ),
+                    )
+
+
+def _names_reached_from(pctx: "ProjectContext", qualname: str) -> set[str]:
+    """Every identifier mentioned in ``qualname``'s body plus the bodies of
+    its direct project callees: Name ids, attribute tails, and import alias
+    names (``from .cohorts import _COHORTS_CACHE`` counts as a mention)."""
+    names: set[str] = set()
+    fns = [qualname, *pctx.callgraph.reachable(qualname, max_depth=1)]
+    for fn_qual in fns:
+        fi = pctx.index.function(fn_qual)
+        if fi is None:
+            continue
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Name):
+                names.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                names.add(node.attr)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for a in node.names:
+                    names.add(a.asname or a.name)
+    return names
+
+
+def _candidates(mod, pctx, mutating_positions) -> Iterator[tuple[str, ast.AST]]:
+    """(name, defining node) for every runtime-mutated cache-like
+    module-level container in ``mod``."""
+    mutated = _runtime_mutated_names(mod.tree)
+    mutated |= _mutated_through_calls(mod, pctx, mutating_positions)
+    for node in mod.tree.body:
+        targets: list[ast.Name] = []
+        value: ast.AST | None = None
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            targets = [node.target]
+            value = node.value
+        if value is None or not targets:
+            continue
+        if not _is_mutable_container(value):
+            continue
+        for t in targets:
+            name = t.id
+            if name != name.upper() or not _NAME_TOKEN.search(name):
+                continue
+            if name in mutated:
+                yield name, node
+
+
+def _is_mutable_container(value: ast.AST) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        fn = value.func
+        base = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        return base in _CONTAINER_CALLS
+    return False
+
+
+def _bare_mutation_targets(scope: ast.AST) -> set[str]:
+    """Names mutated in place anywhere under ``scope``: subscript stores,
+    deletes, or mutating method calls on the bare name."""
+    mutated: set[str] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name):
+                    mutated.add(t.value.id)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name):
+                    mutated.add(t.value.id)
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.attr in _MUTATING_METHODS
+        ):
+            mutated.add(node.func.value.id)
+    return mutated
+
+
+def _runtime_mutated_names(tree: ast.Module) -> set[str]:
+    """Names mutated from inside any function body in the module (module
+    top-level mutation is import-time population, which is exempt)."""
+    mutated: set[str] = set()
+    for outer in ast.walk(tree):
+        if isinstance(outer, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mutated |= _bare_mutation_targets(outer)
+    return mutated
+
+
+def _param_mutating_positions(index) -> dict[str, set[int]]:
+    """canonical function -> positional-arg indices it mutates in place
+    (``def _probed_ok(memo, ...): memo.append(...)`` mutates position 0) —
+    the one-level-interprocedural half of runtime-mutation detection."""
+    out: dict[str, set[int]] = {}
+    for mod in index.modules.values():
+        for fi in mod.functions.values():
+            args = fi.node.args
+            params = [a.arg for a in args.posonlyargs + args.args]
+            mutated = _bare_mutation_targets(fi.node)
+            positions = {i for i, p in enumerate(params) if p in mutated}
+            if positions:
+                out[fi.qualname] = positions
+    return out
+
+
+def _mutated_through_calls(mod, pctx, mutating_positions: dict[str, set[int]]) -> set[str]:
+    """Module-level names passed (from a function body in ``mod``) into a
+    project function that mutates that parameter in place."""
+    mutated: set[str] = set()
+    for outer in ast.walk(mod.tree):
+        if not isinstance(outer, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(outer):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee is None:
+                continue
+            resolved = pctx.index.resolve_symbol(mod.name, callee)
+            if resolved is None:
+                continue
+            for i in mutating_positions.get(resolved, ()):
+                if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                    mutated.add(node.args[i].id)
+    return mutated

@@ -1,0 +1,277 @@
+"""FLX009 — donated buffer referenced after dispatch.
+
+The streaming executor jits its step programs with ``donate_argnums`` (via
+``pipeline.maybe_donate``) so the dense ``(…, size)`` carry updates in
+place across slabs. Donation invalidates the argument buffer: XLA may alias
+it into the output, so a caller that touches the donated value *after* the
+dispatch reads freed (or silently overwritten) memory — on TPU this
+surfaces as a ``Buffer has been deleted or donated`` error at best and as
+wrong numerics at worst, and only on platforms where the donation probe
+passes, which is exactly not the CPU where tests run.
+
+The rule tracks, inside each function, names bound to a donating callable:
+
+* directly — ``jax.jit(fn, donate_argnums=(0,))`` or
+  ``maybe_donate(fn, donate_argnums=(0,))``, or
+* through one level of helper calls — a project function whose return
+  value is such a jit (the step-factory pattern), resolved via the call
+  graph/index.
+
+At each call of a donating name, any *plain-name* argument in a donated
+position becomes dead unless the same statement rebinds it (the
+``state = step(state, slab)`` carry idiom). A later load of a dead name —
+before any rebinding — is the finding, reported at the offending load.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import TYPE_CHECKING, Iterator
+
+from ..core import Finding
+from .common import ImportMap, assigned_names, dotted_name
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..core import ProjectContext
+
+_DONATE_KWARGS = ("donate_argnums", "donate_argnames")
+
+
+class DonationAfterUseRule:
+    id = "FLX009"
+    name = "donation-after-use"
+    description = (
+        "a value passed through a donate_argnums/maybe_donate dispatch is "
+        "referenced afterwards in the caller — the buffer may be freed or "
+        "aliased into the output"
+    )
+    scope = "project"
+
+    def check_project(self, pctx: "ProjectContext") -> Iterator[Finding]:
+        factories = _donating_factories(pctx)
+        for mod in pctx.index.modules.values():
+            for fi in mod.functions.values():
+                yield from self._check_function(
+                    mod.name, mod.path, fi.node, mod.imports, pctx, factories
+                )
+
+    def _check_function(
+        self, module, path, fn, imports: ImportMap, pctx, factories
+    ) -> Iterator[Finding]:
+        donating = _donating_names(module, fn, imports, pctx, factories)
+        if not donating:
+            return
+        parents = _parent_map(fn)
+        statements = _ordered_statements(fn)
+        for stmt in statements:
+            for call in _calls_in_statement(stmt):
+                name = call.func.id if isinstance(call.func, ast.Name) else None
+                if name is None or name not in donating:
+                    continue
+                positions = donating[name]
+                donated_args = {
+                    a.id
+                    for i, a in enumerate(call.args)
+                    if i in positions and isinstance(a, ast.Name)
+                }
+                killed = set(_stmt_assigned_names(stmt))
+                for dead in sorted(donated_args - killed):
+                    # loop back-edge: a donation inside a loop whose body
+                    # never rebinds the name re-dispatches a freed buffer
+                    # on the next iteration — same source line, so the
+                    # linear next-use scan below cannot see it
+                    loop = _enclosing_loop(fn, stmt, parents)
+                    if loop is not None and dead not in _stored_names_in(loop):
+                        yield Finding(
+                            path=str(path),
+                            line=call.lineno,
+                            col=call.col_offset,
+                            rule=self.id,
+                            message=(
+                                f"`{dead}` is donated into `{name}(...)` "
+                                "inside a loop without being rebound — the "
+                                "next iteration re-dispatches a freed/"
+                                "aliased buffer; rebind the result to "
+                                f"`{dead}` (carry idiom)"
+                            ),
+                        )
+                        continue
+                    use = _next_use(fn, dead, stmt)
+                    if use is not None:
+                        yield Finding(
+                            path=str(path),
+                            line=use.lineno,
+                            col=use.col_offset,
+                            rule=self.id,
+                            # no line numbers in the message: the baseline
+                            # fingerprints (path, rule, message) and must
+                            # survive findings shifting up or down a file
+                            message=(
+                                f"`{dead}` was donated into `{name}(...)` "
+                                "and is referenced afterwards — the buffer "
+                                "may be freed/aliased by XLA; rebind the "
+                                "result to the same name (carry idiom) or "
+                                "copy before dispatch"
+                            ),
+                        )
+
+
+def _donating_factories(pctx) -> dict[str, tuple[int, ...]]:
+    """Canonical qualname -> donated positions, for project functions whose
+    return value is a donating jit (one helper level)."""
+    out: dict[str, tuple[int, ...]] = {}
+    for mod in pctx.index.modules.values():
+        for fi in mod.functions.values():
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Return) or node.value is None:
+                    continue
+                positions = _donate_positions(node.value, mod.imports)
+                if positions:
+                    out[fi.qualname] = positions
+    return out
+
+
+def _donate_positions(value: ast.AST, imports: ImportMap) -> tuple[int, ...] | None:
+    """Donated argnums if ``value`` is a donating-jit call, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn_name = dotted_name(value.func)
+    if fn_name is None:
+        return None
+    basename = fn_name.rpartition(".")[2]
+    is_jit_like = imports.resolves_to(value.func, "jax.jit", "jax.pmap") or basename in (
+        "jit", "maybe_donate"
+    )
+    if not is_jit_like:
+        return None
+    for kw in value.keywords:
+        if kw.arg in _DONATE_KWARGS:
+            positions = _int_tuple(kw.value)
+            if positions:
+                return positions
+    return None
+
+
+def _int_tuple(node: ast.AST) -> tuple[int, ...] | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant) and isinstance(elt.value, int)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _donating_names(
+    module: str, fn, imports: ImportMap, pctx, factories
+) -> dict[str, tuple[int, ...]]:
+    """Local names bound (in ``fn``) to a donating callable."""
+    out: dict[str, tuple[int, ...]] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        positions = _donate_positions(node.value, imports)
+        if positions is None and isinstance(node.value, ast.Call):
+            callee = dotted_name(node.value.func)
+            if callee is not None:
+                resolved = pctx.index.resolve_symbol(module, callee)
+                if resolved is not None:
+                    positions = factories.get(resolved)
+        if positions:
+            out[target.id] = positions
+    return out
+
+
+def _ordered_statements(fn) -> list[ast.stmt]:
+    """All statements in ``fn``'s own body (nested defs excluded), in
+    source order."""
+    out: list[ast.stmt] = []
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.stmt):
+                out.append(child)
+            visit(child)
+
+    visit(fn)
+    return sorted(out, key=lambda s: (s.lineno, s.col_offset))
+
+
+def _calls_in_statement(stmt: ast.stmt) -> Iterator[ast.Call]:
+    """Calls in the statement's own expressions — nested statements (a For
+    body, an If branch) are separate entries in ``_ordered_statements`` and
+    carry their own kill sets, so descending into them here would re-process
+    their calls with the wrong one."""
+
+    def visit(node: ast.AST) -> Iterator[ast.Call]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt, ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            yield from visit(child)
+
+    yield from visit(stmt)
+
+
+def _stmt_assigned_names(stmt: ast.stmt) -> Iterator[str]:
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            yield from assigned_names(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        yield from assigned_names(stmt.target)
+    elif isinstance(stmt, ast.For):
+        yield from assigned_names(stmt.target)
+
+
+def _parent_map(fn) -> dict[int, ast.AST]:
+    parents: dict[int, ast.AST] = {}
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+            visit(child)
+
+    visit(fn)
+    return parents
+
+
+def _enclosing_loop(fn, stmt: ast.stmt, parents: dict[int, ast.AST]):
+    """Nearest For/While containing ``stmt`` inside ``fn`` (None if the
+    statement is straight-line code)."""
+    node: ast.AST | None = stmt
+    while node is not None and node is not fn:
+        node = parents.get(id(node))
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            return node
+    return None
+
+
+def _stored_names_in(scope: ast.AST) -> set[str]:
+    return {
+        node.id
+        for node in ast.walk(scope)
+        if isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store,))
+    }
+
+
+def _next_use(fn, name: str, after: ast.stmt) -> ast.Name | None:
+    """First event on ``name`` after ``after``'s last line: a Load returns
+    the node (finding), a Store ends the hazard (the name was rebound)."""
+    boundary = getattr(after, "end_lineno", after.lineno) or after.lineno
+    events: list[tuple[int, int, str, ast.Name]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id == name and node.lineno > boundary:
+            kind = "load" if isinstance(node.ctx, ast.Load) else "store"
+            events.append((node.lineno, node.col_offset, kind, node))
+    for _, _, kind, node in sorted(events, key=lambda e: (e[0], e[1])):
+        return node if kind == "load" else None
+    return None

@@ -1,0 +1,136 @@
+"""FLX010 — OPTIONS/env drift.
+
+Every knob in ``flox_tpu.options.OPTIONS`` is part of a triangle: the
+programmatic field, its ``FLOX_TPU_*`` environment mirror (how CI matrices
+and operators flip modes without code changes), and its ``_VALIDATORS``
+entry (the set-time check that rejects what the env seeding also refuses —
+the "cannot seed what set_options refuses" contract from PR 3). A field
+missing any corner drifts silently: an env-only knob cannot be validated, a
+validator-only knob cannot be swept in CI, and an undocumented knob cannot
+be discovered. This rule pins all three statically:
+
+* **env mirror** — the field's value expression must mention a
+  ``FLOX_TPU_*`` string constant (``_env_int("FLOX_TPU_X", ...)``,
+  ``os.environ.get("FLOX_TPU_X")``, ...);
+* **set-time validation** — the field must have a ``_VALIDATORS`` entry;
+* **docs** — the field name must appear somewhere under ``docs/`` (checked
+  only when a ``docs/`` directory exists next to the lint root, so fixture
+  corpora and scratch trees skip it).
+
+Applies to any module that defines both a module-level ``OPTIONS`` dict
+literal and a ``_VALIDATORS`` dict literal.
+"""
+
+from __future__ import annotations
+
+import ast
+from functools import lru_cache
+from pathlib import Path
+from typing import TYPE_CHECKING, Iterator
+
+from ..core import Finding
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..core import ProjectContext
+
+
+class OptionsEnvDriftRule:
+    id = "FLX010"
+    name = "options-env-drift"
+    description = (
+        "an OPTIONS field is missing its FLOX_TPU_* env mirror, its "
+        "_VALIDATORS entry, or a mention in docs/"
+    )
+    scope = "project"
+
+    def check_project(self, pctx: "ProjectContext") -> Iterator[Finding]:
+        docs_text = _docs_text(pctx.root.resolve().parent / "docs")
+        for mod in pctx.index.modules.values():
+            options = _toplevel_dict(mod.tree, "OPTIONS")
+            validators = _toplevel_dict(mod.tree, "_VALIDATORS")
+            if options is None or validators is None:
+                continue
+            validated = {
+                k.value
+                for k in validators.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+            for key, value in zip(options.keys, options.values):
+                if not (isinstance(key, ast.Constant) and isinstance(key.value, str)):
+                    continue
+                field = key.value
+                if not _has_env_mirror(value):
+                    yield self._finding(
+                        mod, key,
+                        f"OPTIONS[{field!r}] has no FLOX_TPU_* env mirror — "
+                        "seed it with _env_int/_env_float/_env_choice (or "
+                        "os.environ.get) so CI matrices can flip it without "
+                        "code changes",
+                    )
+                if field not in validated:
+                    yield self._finding(
+                        mod, key,
+                        f"OPTIONS[{field!r}] has no _VALIDATORS entry — a bad "
+                        "value must raise at set_options() time, not surface "
+                        "mid-stream",
+                    )
+                if docs_text is not None and field not in docs_text:
+                    yield self._finding(
+                        mod, key,
+                        f"OPTIONS[{field!r}] is not mentioned anywhere under "
+                        "docs/ — document the knob (docs/implementation.md "
+                        "carries the options table)",
+                    )
+
+    def _finding(self, mod, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            path=str(mod.path), line=node.lineno, col=node.col_offset,
+            rule=self.id, message=message,
+        )
+
+
+def _toplevel_dict(tree: ast.Module, name: str) -> ast.Dict | None:
+    for node in tree.body:
+        value: ast.AST | None = None
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in node.targets
+        ):
+            value = node.value
+        elif (
+            isinstance(node, ast.AnnAssign)
+            and isinstance(node.target, ast.Name)
+            and node.target.id == name
+        ):
+            value = node.value
+        if isinstance(value, ast.Dict):
+            return value
+    return None
+
+
+def _has_env_mirror(value: ast.AST) -> bool:
+    for sub in ast.walk(value):
+        if (
+            isinstance(sub, ast.Constant)
+            and isinstance(sub.value, str)
+            and sub.value.startswith("FLOX_TPU_")
+        ):
+            return True
+    return False
+
+
+@lru_cache(maxsize=8)
+def _docs_text_cached(docs_dir: str) -> str | None:
+    d = Path(docs_dir)
+    if not d.is_dir():
+        return None
+    chunks = []
+    for md in sorted(d.rglob("*.md")):
+        try:
+            chunks.append(md.read_text())
+        except OSError:
+            continue
+    return "\n".join(chunks)
+
+
+def _docs_text(docs_dir: Path) -> str | None:
+    return _docs_text_cached(str(docs_dir))

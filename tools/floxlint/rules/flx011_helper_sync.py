@@ -1,0 +1,192 @@
+"""FLX011 — host-sync leak through helpers (interprocedural FLX001).
+
+FLX001 catches ``float(x)`` / ``.item()`` / ``np.*(x)`` on a traced value
+*inside* a traced function. The same hazard one call away is invisible to a
+per-file pass: a jitted region calls an innocent-looking local helper, and
+the helper concretizes its argument. The sync still lands in the middle of
+the XLA program — it just lives in another stack frame.
+
+This rule closes that hole one level deep: for every project function it
+precomputes which *parameters* flow into a host-sync operation
+(``float``/``int``/``bool``/``complex`` builtins, ``.item()``-family
+methods, ``np.*`` calls — the FLX001 set, seeded per-parameter so each
+finding can name the guilty argument), then flags any call from a traced
+function (FLX001's notion: jit-decorated, or passed by name to a tracing
+entrypoint) that feeds a traced value into a sync-tainted position. The
+finding points at the call site — the traced frame where the sync will
+actually stall the pipeline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import TYPE_CHECKING, Iterator
+
+from ..core import Finding
+from .common import (
+    ImportMap,
+    collect_traced_functions,
+    collect_traced_names,
+    dotted_name,
+)
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..core import ProjectContext
+
+_HOST_BUILTINS = ("float", "int", "bool", "complex")
+_HOST_METHODS = ("item", "tolist", "to_py", "__array__")
+
+
+class HelperHostSyncRule:
+    id = "FLX011"
+    name = "helper-host-sync"
+    description = (
+        "a traced function calls a local helper that host-syncs "
+        "(float()/.item()/np.*) on the traced argument"
+    )
+    scope = "project"
+
+    def check_project(self, pctx: "ProjectContext") -> Iterator[Finding]:
+        tainted = _sync_tainted_params(pctx)
+        if not tainted:
+            return
+        for mod in pctx.index.modules.values():
+            traced_fns = collect_traced_functions(mod.tree, mod.imports)
+            traced_ids = {id(fn) for fn in traced_fns}
+            for fn in traced_fns:
+                traced_names = collect_traced_names(fn, mod.imports)
+                for call in ast.walk(fn):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    callee_name = dotted_name(call.func)
+                    if callee_name is None:
+                        continue
+                    resolved = pctx.index.resolve_symbol(mod.name, callee_name)
+                    if resolved is None or resolved not in tainted:
+                        continue
+                    helper = pctx.index.function(resolved)
+                    if helper is not None and id(helper.node) in traced_ids:
+                        continue  # the helper is itself traced: FLX001 owns it
+                    for param, reason in self._hazardous_args(
+                        call, tainted[resolved], traced_names
+                    ):
+                        yield Finding(
+                            path=str(mod.path),
+                            line=call.lineno,
+                            col=call.col_offset,
+                            rule=self.id,
+                            message=(
+                                f"`{callee_name}()` host-syncs its parameter "
+                                f"`{param}` ({reason}); calling it on a traced "
+                                f"value inside `{fn.name}` forces a "
+                                "device->host sync one frame down — inline a "
+                                "jnp equivalent or hoist the call out of the "
+                                "traced region"
+                            ),
+                        )
+
+    def _hazardous_args(
+        self, call: ast.Call, taint: dict, traced_names: set[str]
+    ) -> Iterator[tuple[str, str]]:
+        def is_traced(expr: ast.AST) -> bool:
+            return any(
+                isinstance(sub, ast.Name) and sub.id in traced_names
+                for sub in ast.walk(expr)
+            )
+
+        params: list[str] = taint["params"]
+        for i, arg in enumerate(call.args):
+            if i < len(params) and params[i] in taint["tainted"] and is_traced(arg):
+                yield params[i], taint["tainted"][params[i]]
+        for kw in call.keywords:
+            if kw.arg in taint["tainted"] and is_traced(kw.value):
+                yield kw.arg, taint["tainted"][kw.arg]
+
+
+def _sync_tainted_params(pctx: "ProjectContext") -> dict[str, dict]:
+    """canonical function -> {"params": [names in positional order],
+    "tainted": {param -> reason}} for helpers that host-sync a parameter."""
+    out: dict[str, dict] = {}
+    for mod in pctx.index.modules.values():
+        for fi in mod.functions.values():
+            fn = fi.node
+            args = fn.args
+            params = [a.arg for a in args.posonlyargs + args.args]
+            all_params = params + [a.arg for a in args.kwonlyargs]
+            tainted: dict[str, str] = {}
+            for param in all_params:
+                reason = _param_sync_reason(fn, param, mod.imports)
+                if reason is not None:
+                    tainted[param] = reason
+            if tainted:
+                out[fi.qualname] = {"params": params, "tainted": tainted}
+    return out
+
+
+def _param_sync_reason(fn, param: str, imports: ImportMap) -> str | None:
+    """How (if at all) values derived from ``param`` reach a host-sync op
+    in ``fn``'s own body."""
+    derived = _derived_names(fn, param)
+
+    def mentions(expr: ast.AST) -> bool:
+        return any(
+            isinstance(sub, ast.Name) and sub.id in derived
+            for sub in ast.walk(expr)
+        )
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _HOST_BUILTINS
+            and node.func.id not in imports.aliases
+            and node.args
+            and mentions(node.args[0])
+        ):
+            # reasons carry no line numbers: they end up in finding
+            # messages, which the baseline fingerprints line-free
+            return f"via `{node.func.id}()`"
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HOST_METHODS
+            and mentions(node.func.value)
+        ):
+            return f"via `.{node.func.attr}()`"
+        if imports.resolves_to(node.func, "numpy") and any(
+            mentions(a) for a in node.args
+        ):
+            return f"via `{dotted_name(node.func)}(...)`"
+    return None
+
+
+def _derived_names(fn, param: str) -> set[str]:
+    """Names derived from ``param`` inside ``fn`` (fixpoint over simple
+    assignments, like FLX001's propagation but seeded from one parameter)."""
+    derived = {param}
+    for _ in range(2):
+        before = len(derived)
+        for node in ast.walk(fn):
+            value = None
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, list(node.targets)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            elif isinstance(node, ast.AugAssign):
+                value, targets = node.value, [node.target]
+            if value is None:
+                continue
+            if any(
+                isinstance(sub, ast.Name) and sub.id in derived
+                for sub in ast.walk(value)
+            ):
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            derived.add(sub.id)
+        if len(derived) == before:
+            break
+    return derived

@@ -1,0 +1,100 @@
+"""SARIF 2.1.0 output (``--format sarif``).
+
+One run, one driver ("floxlint"), the full rule table as
+``tool.driver.rules`` (so GitHub code scanning can show rule help even for
+rules with zero results this run), and one result per finding with a
+single physical location. Columns are 1-based in SARIF where the internal
+:class:`~tools.floxlint.core.Finding` carries 0-based ``col`` — the +1
+happens exactly once, here. URIs are emitted repo-relative with forward
+slashes so the upload-sarif action can anchor code-scanning annotations.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Sequence
+
+from .core import Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+_TOOL_VERSION = "2.0.0"  # floxlint v2: project index + semantic rules
+
+
+def _relative_uri(path: str) -> str:
+    """Repo-relative forward-slash URI when the path is under the cwd,
+    else the path as given (absolute paths stay absolute)."""
+    p = Path(path)
+    try:
+        p = p.resolve().relative_to(Path.cwd().resolve())
+    except ValueError:
+        pass
+    return p.as_posix()
+
+
+def sarif_document(findings: Sequence[Finding], rules: Sequence) -> dict:
+    """The SARIF log as a plain dict (the JSON-serializable contract the
+    self-tests validate structurally)."""
+    ordered_rules = sorted(rules, key=lambda r: r.id)
+    rule_index = {r.id: i for i, r in enumerate(ordered_rules)}
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": f"{f.rule} {f.message}"},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": _relative_uri(f.path),
+                            "uriBaseId": "%SRCROOT%",
+                        },
+                        "region": {
+                            "startLine": max(f.line, 1),
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if f.rule in rule_index:
+            result["ruleIndex"] = rule_index[f.rule]
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "floxlint",
+                        "version": _TOOL_VERSION,
+                        "informationUri": (
+                            "https://github.com/flox-tpu/flox-tpu/blob/main/"
+                            "docs/implementation.md"
+                        ),
+                        "rules": [
+                            {
+                                "id": r.id,
+                                "name": r.name,
+                                "shortDescription": {"text": r.description},
+                                "defaultConfiguration": {"level": "warning"},
+                            }
+                            for r in ordered_rules
+                        ],
+                    }
+                },
+                "columnKind": "utf16CodeUnits",
+                "results": results,
+            }
+        ],
+    }
+
+
+def format_sarif(findings: Sequence[Finding], rules: Sequence, *, files_checked: int = 0) -> str:
+    return json.dumps(sarif_document(findings, rules), indent=2)

@@ -10,7 +10,13 @@ cd "$(dirname "$0")/.."
 rc=0
 
 echo "== floxlint =="
-python -m tools.floxlint flox_tpu/ || rc=1
+# the full tree (fixtures auto-pruned), checked against the suppression
+# baseline: new findings fail, and so do stale baseline entries (drift —
+# a fixed hazard whose suppression was never deleted). The project index
+# is cached on disk and shared with CI's SARIF step.
+python -m tools.floxlint flox_tpu/ tools/ tests_tpu/ \
+    --baseline tools/floxlint/baseline.json \
+    --index-cache .floxlint-index.pickle || rc=1
 
 echo
 echo "== ruff =="

@@ -43,7 +43,9 @@ from bench import _probe_once  # noqa: E402 — single probe implementation
 def log(msg: str) -> None:
     stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     line = f"[{stamp}] {msg}"
-    print(line, flush=True)
+    # the capture tool IS a CLI: stdout is its live progress channel,
+    # mirrored to the logfile below
+    print(line, flush=True)  # floxlint: disable=FLX007
     try:
         with open(LOG, "a") as f:
             f.write(line + "\n")
