@@ -426,6 +426,45 @@ def main() -> None:
               file=sys.stderr, flush=True)
         telemetry_profile = None
 
+    # --- autotune store feed + regression sentinel (ISSUE 6) --------------
+    # the round's sweep results ARE the measurements the autotuner's `auto`
+    # dispatch wants: record them under the workload's bands (source=bench
+    # outranks nothing — EWMA-merged like any observation) and persist when
+    # a cache path is configured. The sentinel then diffs this round's
+    # per-family GB/s against the LAST history round (same platform) —
+    # computed BEFORE this round is appended, so it compares rounds, not
+    # the round against itself.
+    from flox_tpu import autotune
+
+    try:
+        nelems_bench = nlat * nlon * ntime
+        for impl, impl_gbps in sweep_gbps.items():
+            autotune.record("segment_sum", impl, impl_gbps, dtype="float32",
+                            ngroups=size, nelems=nelems_bench, source="bench")
+        for qimpl, q_gbps in (quantile_gbps or {}).items():
+            if q_gbps:
+                autotune.record("quantile", qimpl, q_gbps, dtype="float32",
+                                ngroups=size, nelems=nelems_bench, source="bench")
+        autotune.save()  # no-op without a configured autotune_cache_path
+        families = {"headline": gbps}
+        families.update({f"segment_sum[{k}]": v for k, v in sweep_gbps.items()})
+        families.update(
+            {f"quantile[{k}]": v for k, v in (quantile_gbps or {}).items() if v}
+        )
+        families["streaming[sync]"] = streaming["gbps_sync"]
+        families["streaming[prefetch]"] = streaming["gbps_prefetch"]
+        regressions = autotune.regression_sentinel(
+            families, history_path=HISTORY_PATH, platform=backend,
+            workload={"nlat": nlat, "nlon": nlon, "ntime": ntime,
+                      "nbytes": nbytes, "ngroups": size},
+        )
+        autotune_record = autotune.decision_record()
+    except Exception as exc:  # noqa: BLE001 — diagnostics must not kill the bench
+        print(f"flox-tpu bench: autotune/sentinel failed: {exc}",
+              file=sys.stderr, flush=True)
+        regressions = None
+        autotune_record = None
+
     # one shared field set: the persisted hardware record and the stdout
     # line must never drift apart about what was measured
     core = {
@@ -440,6 +479,8 @@ def main() -> None:
         "quantile_gbps": quantile_gbps,
         "streaming": streaming,
         "telemetry": telemetry_profile,
+        "autotune": autotune_record,
+        "regressions": regressions,
     }
     if on_accel:
         # the round's hardware evidence: persist it so a later capture that
@@ -470,7 +511,14 @@ def main() -> None:
         last = _load_last_onchip()
         if last is not None:
             line["last_onchip"] = last
-    _append_history({"wall_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **line})
+    _append_history({
+        "wall_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        **line,
+        # the sentinel matches rounds by platform AND workload: a bounded
+        # smoke round must never be compared against a full-size one
+        "workload": {"nlat": nlat, "nlon": nlon, "ntime": ntime,
+                     "nbytes": nbytes, "ngroups": size},
+    })
     print(json.dumps(line))
 
 

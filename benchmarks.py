@@ -355,6 +355,63 @@ def bench_cohort_detection(scale: str):
     ]
 
 
+def sentinel_row(rows: list, platform: str) -> dict:
+    """Regression sentinel over this sweep's GB/s rows (ISSUE 6): diff each
+    throughput row against the newest committed ``BENCH_HISTORY/r*_cpu.jsonl``
+    round with a matching row, flagging drops past the autotune threshold.
+    Report-only by construction — the verdict is a row, never an exit code."""
+    import glob
+    import os
+    import re
+
+    from flox_tpu.autotune import _REGRESSION_THRESHOLD, compare_families
+
+    current = {
+        r["bench"]: r["value"]
+        for r in rows
+        if r.get("unit") == "GB/s" and isinstance(r.get("value"), (int, float))
+    }
+    previous: dict = {}
+    compared = None
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for path in glob.glob(os.path.join(here, "BENCH_HISTORY", "r*_cpu.jsonl")):
+        m = re.match(r"r(\d+)_cpu\.jsonl$", os.path.basename(path))
+        if m:
+            rounds.append((int(m.group(1)), path))
+    # newest round first, by the parsed round NUMBER: lexicographic order
+    # inverts r99/r100 (and any unpadded name) the moment digits grow
+    for _, path in sorted(rounds, reverse=True):
+        try:
+            with open(path) as f:
+                lines = [json.loads(line) for line in f if line.strip()]
+        except (OSError, ValueError):
+            continue
+        plat = next((r["value"] for r in lines if r.get("bench") == "platform"), None)
+        if plat != platform:
+            continue
+        previous = {
+            r["bench"]: r["value"]
+            for r in lines
+            if r.get("unit") == "GB/s" and isinstance(r.get("value"), (int, float))
+        }
+        compared = os.path.basename(path)
+        break
+    families, regressed = compare_families(current, previous)
+    return {
+        "bench": "regression_sentinel",
+        "value": {
+            "status": "regression" if regressed else "ok",
+            "platform": platform,
+            "threshold": _REGRESSION_THRESHOLD,
+            "compared_against": compared,
+            "regressed": regressed,
+            "families": families,
+        },
+        "unit": "verdict",
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", choices=["small", "full"], default="small")
@@ -418,6 +475,7 @@ def main() -> None:
     for sweep in sweeps:
         for r in sweep:
             by_name.setdefault(r["bench"], []).append(r)
+    medians = []
     for name, rows in by_name.items():
         vals = sorted(r["value"] for r in rows if isinstance(r["value"], (int, float)))
         if vals:
@@ -426,7 +484,10 @@ def main() -> None:
             out = dict(rows[0], value=med)
         else:
             out = rows[0]
+        medians.append(out)
         print(json.dumps(out))
+    # report-only regression sentinel over the medians (ISSUE 6)
+    print(json.dumps(sentinel_row(medians, jax.default_backend())))
 
 
 if __name__ == "__main__":

@@ -21,6 +21,7 @@ def stats() -> dict:
     telemetry layer (``telemetry.profile_call`` embeds this, and a bench
     row showing ``bundle_lru.misses`` climbing across same-shaped calls is
     a retrace storm caught red-handed)."""
+    from .autotune import _AUTOTUNE_CACHE
     from .cohorts import _COHORTS_CACHE
     from .core import _jitted_bundle
     from .factorize import _FACTORIZE_CACHE
@@ -35,6 +36,7 @@ def stats() -> dict:
         "mesh_programs": len(_PROGRAM_CACHE),
         "scan_programs": len(_SCAN_CACHE),
         "stream_steps": len(_STEP_CACHE),
+        "autotune": len(_AUTOTUNE_CACHE),
         "bundle_lru": {
             "size": info.currsize, "hits": info.hits, "misses": info.misses
         },
@@ -50,6 +52,7 @@ def clear_all() -> None:
     analogue of the reference's ``flox.cache.cache.clear()`` (its asv
     benchmarks clear between timing rounds; ``benchmarks.py`` here does the
     same)."""
+    from .autotune import _AUTOTUNE_CACHE, _AUTOTUNE_STATE
     from .cohorts import _COHORTS_CACHE
     from .core import _jitted_bundle
     from .factorize import _FACTORIZE_CACHE, _FACTORIZE_CACHE_BYTES
@@ -86,5 +89,12 @@ def clear_all() -> None:
     _PALLAS_MINMAX_COMPILE_PROBE.clear()
     _PALLAS_SCAN_PROBE_RESULT.clear()
     _PALLAS_SCAN_COMPILE_PROBE.clear()
+    # autotune measurement store + its counters/lazy-load flag: clearing
+    # returns the tuner to the unloaded state, so the next consult reloads
+    # the persisted file (or runs plain heuristics when no path is set) —
+    # every accessor reads the state dict through .get() with a default,
+    # making the empty dict the reset state
+    _AUTOTUNE_CACHE.clear()
+    _AUTOTUNE_STATE.clear()
     _jitted_bundle.cache_clear()
     METRICS.reset()

@@ -278,32 +278,50 @@ def _normalize_reduce_axes(arr, bys, axis):
     return arr, bys, len(by_keep), bndim
 
 
-# Below this many elements a host array reduces faster on the numpy engine
-# than through jit dispatch. Measured (round 5, CPU host, nanmean, 10
-# groups, median of 20): numpy/jax ms = 0.15/0.60 @512, 0.19/0.64 @2048,
-# 0.93/1.93 @32768, 8.4/6.3 @131072 — crossover ~64-100k; 32768 is the
-# last measured point where numpy wins 2x, and device dispatch (transfer +
-# launch) only pushes the crossover higher on an accelerator.
-_NUMPY_ENGINE_MAX_ELEMS = 32768
-
-
 def _choose_engine(engine, array, array_is_jax: bool) -> str:
     """Default engine choice (parity: _choose_engine, core.py:712-736).
 
     The jit path wins for device arrays and anything sizeable; small host
     arrays skip jit dispatch overhead via the numpy engine — but only when
     both engines produce the same result dtype (x64 on), so the choice is
-    invisible to the caller.
+    invisible to the caller. The size crossover is
+    ``OPTIONS["numpy_engine_max_elems"]`` (measured round 5, CPU host,
+    nanmean, 10 groups, median of 20: numpy/jax ms = 0.15/0.60 @512,
+    0.19/0.64 @2048, 0.93/1.93 @32768, 8.4/6.3 @131072 — crossover
+    ~64-100k; 32768 is the last measured point where numpy wins 2x, and
+    device dispatch only pushes the crossover higher on an accelerator).
+    With the autotuner on, a measured "engine" record for the size band
+    overrides the threshold — both engines are x64-equivalent here, so the
+    swap stays invisible to the caller.
     """
     if engine is not None:
         return normalize_engine(engine)
-    if (
-        not array_is_jax
-        and utils.x64_enabled()
-        and np.asarray(array).size < _NUMPY_ENGINE_MAX_ELEMS
-    ):
-        logger.debug("engine heuristic: small host array -> numpy")
-        return "numpy"
+    if not array_is_jax and utils.x64_enabled():
+        arr = np.asarray(array)
+        nelems = int(arr.size)
+        heuristic = (
+            "numpy"
+            if nelems < OPTIONS["numpy_engine_max_elems"]
+            else OPTIONS["default_engine"]
+        )
+        # consult the tuner only when the fallback is the jax engine — a
+        # default_engine="numpy" session forced the host engine and the
+        # tuner must not second-guess that
+        if OPTIONS["autotune"] and OPTIONS["default_engine"] == "jax":
+            from . import autotune
+
+            dt = arr.dtype
+            autotune.prime_engine(dt, nelems)
+            chosen = autotune.decide(
+                "engine", heuristic, ("numpy", "jax"),
+                dtype=str(dt), nelems=nelems,
+            )
+            if chosen != heuristic:
+                logger.debug("engine autotune: %s (heuristic %s)", chosen, heuristic)
+            return chosen
+        if heuristic == "numpy":
+            logger.debug("engine heuristic: small host array -> numpy")
+        return heuristic
     return OPTIONS["default_engine"]
 
 
@@ -715,6 +733,15 @@ def _groupby_reduce_impl(
                     "raise set_options(dense_intermediate_bytes_max=...) if the "
                     "device really has the headroom."
                 )
+        if engine == "jax" and OPTIONS["autotune"]:
+            # first-call candidate measurement (budgeted, once per banded
+            # key): runs HERE, on the host outside any trace, so the traced
+            # decision points below only ever do a dict lookup
+            from . import autotune
+
+            autotune.prime_reduce(
+                func_name, arr_flat.dtype, size, int(np.prod(arr_flat.shape))
+            )
         result = _reduce_blockwise(
             arr_flat,
             codes_flat,

@@ -187,6 +187,24 @@ OPTIONS: dict[str, Any] = {
     # Chrome trace-event JSON (ui.perfetto.dev-loadable) at flush/exit.
     # None keeps records in the in-process buffer (telemetry.spans()).
     "telemetry_export_path": os.environ.get("FLOX_TPU_TELEMETRY_EXPORT_PATH") or None,
+    # Autotuner (flox_tpu/autotune.py): when on, every `auto` dispatch
+    # decision (engine, segment_sum_impl, quantile sort-vs-select, streaming
+    # slab/prefetch sizing) consults the per-host measurement store and
+    # picks the observed winner; first call measures candidates (budgeted
+    # micro-sweeps) or serves seeds from BENCH_HISTORY. Off (the default)
+    # is record-only: observations still accrete, dispatch stays on the
+    # static heuristics — bit-identical to the pre-autotune tree.
+    "autotune": bool(_env_int("FLOX_TPU_AUTOTUNE", 0, 0, 1)),
+    # persistence target for the autotune store: an atomic-JSON file path
+    # loaded lazily at first consult and saved at exit / autotune.save().
+    # None keeps the store in-process only.
+    "autotune_cache_path": os.environ.get("FLOX_TPU_AUTOTUNE_CACHE_PATH") or None,
+    # Below this many elements a host array reduces faster on the numpy
+    # engine than through jit dispatch (engine=None heuristic; measured
+    # round 5 — see docs/engines.md). An OPTIONS entry so accelerator
+    # deployments can tune the crossover without a code change (ADVICE r5);
+    # the autotuner's measured "engine" records override it when enabled.
+    "numpy_engine_max_elems": _env_int("FLOX_TPU_NUMPY_ENGINE_MAX_ELEMS", 32768, 0),
 }
 
 # single source of truth for the accumulation disciplines — referenced by
@@ -228,6 +246,13 @@ _VALIDATORS = {
     "telemetry_export_path": lambda x: x is None or (
         isinstance(x, (str, os.PathLike)) and bool(str(x))
     ),
+    # autotune knobs: same at-set-time discipline — a non-bool switch or a
+    # pathless persistence target raises here, not mid-dispatch
+    "autotune": lambda x: isinstance(x, bool),
+    "autotune_cache_path": lambda x: x is None or (
+        isinstance(x, (str, os.PathLike)) and bool(str(x))
+    ),
+    "numpy_engine_max_elems": lambda x: _is_int(x) and x >= 0,
 }
 
 
@@ -266,7 +291,35 @@ def trace_fingerprint() -> tuple:
         # applies: a cached step compiled with donation must not serve a
         # stream_donate="off" session (and vice versa)
         OPTIONS["stream_donate"],
+        # the autotuner's decisions are read at trace time wherever the
+        # policies above are; a record that flips a winner bumps this, so
+        # cached programs never serve a stale lowering choice. Constant
+        # while the tuner is off (record-only mode never retraces).
+        _autotune_fingerprint(),
     )
+
+
+def _autotune_fingerprint() -> tuple:
+    from .autotune import decision_fingerprint
+
+    return decision_fingerprint()
+
+
+#: option names the user pinned explicitly — via the env mirror at import
+#: or any set_options() call since. The autotuner treats only UNPINNED
+#: knobs as an "auto" surface it may adapt (an explicit
+#: set_options(stream_prefetch=2) means 2, even with the tuner on).
+_EXPLICIT_OPTIONS: set[str] = {
+    name
+    for name, env in (("stream_prefetch", "FLOX_TPU_STREAM_PREFETCH"),)
+    if env in os.environ
+}
+
+
+def explicitly_set(name: str) -> bool:
+    """Whether ``name`` was pinned by the user (env mirror or set_options)
+    rather than riding its built-in default."""
+    return name in _EXPLICIT_OPTIONS
 
 
 class set_options:
@@ -285,6 +338,14 @@ class set_options:
             if k in _VALIDATORS and not _VALIDATORS[k](v):
                 raise ValueError(f"option {k!r} given an invalid value: {v!r}")
             self.old[k] = OPTIONS[k]
+        # pin provenance alongside the value (matters only to the
+        # autotuner's may-I-adapt check, never to option values). A plain
+        # setter call pins for the rest of the session; the context-manager
+        # form unpins on exit along with restoring the value — once the
+        # knob rides its built-in default again, it is back on the tuner's
+        # "auto" surface (and library-internal with-blocks never leak pins)
+        self._newly_explicit = set(kwargs) - _EXPLICIT_OPTIONS
+        _EXPLICIT_OPTIONS.update(kwargs)
         OPTIONS.update(kwargs)
 
     def __enter__(self) -> None:
@@ -292,3 +353,4 @@ class set_options:
 
     def __exit__(self, *args: Any) -> None:
         OPTIONS.update(self.old)
+        _EXPLICIT_OPTIONS.difference_update(self._newly_explicit)

@@ -251,6 +251,20 @@ def stream_slabs(
     from .profiling import StreamReport, record_stream
 
     depth = OPTIONS["stream_prefetch"] if prefetch is None else prefetch
+    if prefetch is None and OPTIONS["autotune"]:
+        from .options import explicitly_set
+
+        if not explicitly_set("stream_prefetch"):
+            # observed-best depth for this size band — but ONLY while the
+            # depth rides its built-in default: an env mirror or
+            # set_options(stream_prefetch=...) is an explicit user choice
+            # the tuner never second-guesses. Prefetch changes only when
+            # staging happens, never what bytes land on device, so the
+            # adaptive depth keeps the bit-identity contract.
+            from .autotune import pick_stream_prefetch
+
+            nelems_total = n * int(np.prod(lead_shape)) if lead_shape else n
+            depth = pick_stream_prefetch(depth, nelems=nelems_total)
     nbatches = math.ceil(n / batch_len) if n else 0
     order_full = range(nbatches - 1, -1, -1) if reverse else range(nbatches)
     order = order_full[skip:] if skip else order_full
@@ -305,6 +319,19 @@ def stream_slabs(
         t_end = perf_counter()
         report.wall_ms = (t_end - t_begin) * 1e3
         record_stream(report)
+        # feed the autotune store (record-only safe): throughput per
+        # prefetch depth and slab band, plus the overlap fraction — the
+        # StreamReport signal ROADMAP item 4 names
+        if report.slabs and stager._dtype0 is not None:
+            from .autotune import observe_stream
+
+            lead_elems = int(np.prod(lead_shape)) if lead_shape else 1
+            span_elems = lead_elems * sum(s.stop - s.start for s in report.slabs)
+            observe_stream(
+                report,
+                nbytes=span_elems * np.dtype(stager._dtype0).itemsize,
+                nelems=n * lead_elems,
+            )
         from . import telemetry
 
         if telemetry.enabled():

@@ -46,6 +46,11 @@ __all__ = ["streaming_groupby_reduce", "streaming_groupby_scan"]
 
 _BIG = np.iinfo(np.int32).max
 
+#: slab byte budget when the caller passes neither batch_len nor
+#: batch_bytes — the only sizing leg the autotuner may adapt (an explicit
+#: batch_bytes= is a device-memory cap the tuner never second-guesses)
+_DEFAULT_BATCH_BYTES = 256 * 2**20
+
 # compiled step/pass/program functions for every streaming runtime path
 # (single-device steps, quantile passes, scan steps, mesh shard_map
 # pairs) — a fresh jax.jit object per call would recompile on every
@@ -99,7 +104,7 @@ def streaming_groupby_reduce(
     *,
     func: str | Aggregation,
     batch_len: int | None = None,
-    batch_bytes: int = 256 * 2**20,
+    batch_bytes: int | None = None,
     expected_groups: Any = None,
     isbin: Any = False,
     sort: bool = True,
@@ -170,7 +175,7 @@ def _streaming_groupby_reduce_impl(
     *,
     func: str | Aggregation,
     batch_len: int | None,
-    batch_bytes: int,
+    batch_bytes: int | None,
     expected_groups: Any,
     isbin: Any,
     sort: bool,
@@ -320,6 +325,33 @@ def _streaming_groupby_reduce_impl(
     itemsize = probe.dtype.itemsize
     row_bytes = int(np.prod(lead_shape, dtype=np.int64)) * itemsize if lead_shape else itemsize
     if batch_len is None:
+        from .options import OPTIONS
+
+        explicit_bytes = batch_bytes is not None
+        if not explicit_bytes:
+            batch_bytes = _DEFAULT_BATCH_BYTES
+        if (
+            OPTIONS["autotune"]
+            and not explicit_bytes
+            and not OPTIONS["stream_checkpoint_path"]
+        ):
+            # observed-best slab byte budget for this stream-size band
+            # (fed by past StreamReport observations); the default budget
+            # otherwise. Explicit sizing is never second-guessed — a
+            # passed batch_len pins the slab length and a passed
+            # batch_bytes is a device-memory cap — only the
+            # nothing-specified default adapts. With checkpointing on, the
+            # derived batch_len is part of the checkpoint identity key: it
+            # must be reproducible by the resuming process, and a store
+            # whose winner shifted between runs would silently orphan the
+            # snapshot — so adaptation is off whenever a checkpoint path
+            # is configured.
+            from .autotune import pick_stream_batch_bytes
+
+            lead_elems = int(np.prod(lead_shape, dtype=np.int64)) if lead_shape else 1
+            batch_bytes = pick_stream_batch_bytes(
+                batch_bytes, nelems=int(n) * lead_elems
+            )
         batch_len = max(1, min(n, batch_bytes // max(row_bytes, 1)))
 
     if stream_orderstat:
@@ -857,7 +889,7 @@ def streaming_groupby_scan(
     *,
     func: str,
     batch_len: int | None = None,
-    batch_bytes: int = 256 * 2**20,
+    batch_bytes: int | None = None,
     expected_groups: Any = None,
     dtype: Any = None,
     out: Callable[[int, int, Any], None] | None = None,
@@ -903,7 +935,7 @@ def _streaming_groupby_scan_impl(
     *,
     func: str,
     batch_len: int | None,
-    batch_bytes: int,
+    batch_bytes: int | None,
     expected_groups: Any,
     dtype: Any,
     out: Callable[[int, int, Any], None] | None,
@@ -992,6 +1024,8 @@ def _streaming_groupby_scan_impl(
     itemsize = probe.dtype.itemsize
     row_bytes = int(np.prod(lead_shape, dtype=np.int64)) * itemsize if lead_shape else itemsize
     if batch_len is None:
+        if batch_bytes is None:
+            batch_bytes = _DEFAULT_BATCH_BYTES
         batch_len = max(1, min(n, batch_bytes // max(row_bytes, 1)))
     nbatches = math.ceil(n / batch_len)
 

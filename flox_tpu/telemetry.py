@@ -55,12 +55,14 @@ from __future__ import annotations
 import contextvars
 import itertools
 import json
+import math
 import os
 import threading
 import time
 from typing import Any, Iterable
 
 __all__ = [
+    "HIST_EDGES_MS",
     "METRICS",
     "MetricsRegistry",
     "annotated",
@@ -110,19 +112,40 @@ def detailed() -> bool:
 # ---------------------------------------------------------------------------
 
 
+#: fixed log-spaced histogram bucket edges (upper bounds), shared by every
+#: histogram: 1 µs .. ~9 min in ms, factor 2 per bucket. Fixed-and-shared is
+#: what makes histograms mergeable across processes and exports — the
+#: autotune store and the report CLI both rely on it.
+HIST_EDGES_MS: tuple[float, ...] = tuple(0.001 * 2.0**i for i in range(30))
+
+
+def _hist_bucket(value_ms: float) -> int:
+    """Index of the first bucket whose upper edge holds ``value_ms`` (the
+    last bucket absorbs overflow)."""
+    for i, edge in enumerate(HIST_EDGES_MS):
+        if value_ms <= edge:
+            return i
+    return len(HIST_EDGES_MS) - 1
+
+
 class MetricsRegistry:
-    """Process-wide counters and gauges, thread-safe.
+    """Process-wide counters, gauges and histograms, thread-safe.
 
     Counters only ever increase (``inc``); gauges hold the latest value
-    (``set_gauge``) or a running max (``max_gauge``). ``snapshot`` returns a
-    plain dict for exports and the bench rows; ``reset`` zeroes everything
-    (wired into ``cache.clear_all``).
+    (``set_gauge``) or a running max (``max_gauge``); histograms
+    (``observe``) count observations into the fixed log-spaced
+    :data:`HIST_EDGES_MS` buckets, from which ``percentile`` interpolates
+    p50/p99-style summaries. ``snapshot`` returns a plain dict of
+    counters+gauges for exports and the bench rows (histograms travel
+    separately via ``histograms()`` — they are vectors, not scalars);
+    ``reset`` zeroes everything (wired into ``cache.clear_all``).
     """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
+        self._hists: dict[str, dict] = {}
 
     def inc(self, name: str, value: float = 1) -> None:
         with self._lock:
@@ -143,6 +166,43 @@ class MetricsRegistry:
                 return self._counters[name]
             return self._gauges.get(name, default)
 
+    def observe(self, name: str, value: float) -> None:
+        """Count one observation into ``name``'s log-spaced histogram."""
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                hist = self._hists[name] = {
+                    "counts": [0] * len(HIST_EDGES_MS),
+                    "count": 0,
+                    "sum": 0.0,
+                    "min": float("inf"),
+                    "max": float("-inf"),
+                }
+            hist["counts"][_hist_bucket(float(value))] += 1
+            hist["count"] += 1
+            hist["sum"] += float(value)
+            hist["min"] = min(hist["min"], float(value))
+            hist["max"] = max(hist["max"], float(value))
+
+    def histograms(self) -> dict[str, dict]:
+        """A deep copy of every histogram (name -> counts/count/sum/min/max);
+        bucket upper edges are the shared :data:`HIST_EDGES_MS`."""
+        with self._lock:
+            return {
+                name: {**hist, "counts": list(hist["counts"])}
+                for name, hist in self._hists.items()
+            }
+
+    def percentile(self, name: str, q: float) -> float | None:
+        """The ``q``-quantile (0..1) of ``name``'s histogram, interpolated
+        within the holding bucket and clamped to the observed min/max (so
+        p0/p100 are exact). ``None`` for an unknown or empty histogram."""
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None or not hist["count"]:
+                return None
+            return _hist_percentile(hist, q)
+
     def snapshot(self) -> dict[str, float]:
         with self._lock:
             return {**self._counters, **self._gauges}
@@ -151,6 +211,26 @@ class MetricsRegistry:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
+            self._hists.clear()
+
+
+def _hist_percentile(hist: dict, q: float) -> float:
+    """Percentile from a bucket-count vector: walk the cumulative counts to
+    the target rank, then interpolate linearly inside the holding bucket
+    (lower edge = previous bucket's upper edge, 0 for the first)."""
+    target = max(0.0, min(1.0, q)) * hist["count"]
+    cum = 0
+    for i, c in enumerate(hist["counts"]):
+        if not c:
+            continue
+        if cum + c >= target:
+            lo = HIST_EDGES_MS[i - 1] if i else 0.0
+            hi = HIST_EDGES_MS[i]
+            frac = (target - cum) / c
+            value = lo + frac * (hi - lo)
+            return min(max(value, hist["min"]), hist["max"])
+        cum += c
+    return hist["max"]
 
 
 METRICS = MetricsRegistry()
@@ -366,6 +446,11 @@ _JSONL_BATCH = 64
 def _emit(record: dict) -> None:
     from .options import OPTIONS
 
+    if record.get("type") == "span":
+        # every finished span feeds the per-phase latency histogram — the
+        # p50/p99 source for the report CLI, the Perfetto export, and the
+        # serving-layer SLO metrics (ROADMAP item 1)
+        METRICS.observe("span_ms." + record["name"], record.get("dur_us", 0.0) / 1e3)
     path = OPTIONS["telemetry_export_path"]
     with _RECORDS_LOCK:
         if len(_RECORDS) >= _MAX_RECORDS:
@@ -455,7 +540,13 @@ def reset() -> None:
 
 
 def _counters_record() -> dict:
-    return {"type": "counters", "counters": METRICS.snapshot(), "wall0": _WALL0}
+    return {
+        "type": "counters",
+        "counters": METRICS.snapshot(),
+        "histograms": METRICS.histograms(),
+        "hist_edges_ms": list(HIST_EDGES_MS),
+        "wall0": _WALL0,
+    }
 
 
 def export_jsonl(path: str, records: Iterable[dict] | None = None) -> None:
@@ -518,6 +609,8 @@ def to_chrome_trace(records: Iterable[dict] | None = None) -> dict:
         "traceEvents": trace_events,
         "displayTimeUnit": "ms",
         "floxTpuCounters": METRICS.snapshot(),
+        "floxTpuHistograms": METRICS.histograms(),
+        "floxTpuHistEdgesMs": list(HIST_EDGES_MS),
         "floxTpuWall0": _WALL0,
     }
 
@@ -596,14 +689,17 @@ def profile_call(fn: Any) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def _load_export(path: str) -> tuple[list[dict], dict]:
-    """Parse either export format back to (span records, counters).
+def _parse_export(path: str) -> tuple[list[dict], dict, dict]:
+    """Parse either export format to (span records, counters, histograms).
 
     Format detection is by content, not extension: a Chrome trace is ONE
     JSON document with a ``traceEvents`` key; anything that fails a
     whole-file parse (or parses to a non-trace object) is read as
     JSON-lines — every record line there is an object too, so peeking at
-    the first byte cannot distinguish them."""
+    the first byte cannot distinguish them. A malformed JSON-lines line is
+    an error naming the line number, never a silent skip: a truncated or
+    interleaved export must fail the report (and its CI step), not
+    quietly under-count."""
     with open(path) as f:
         text = f.read()
     try:
@@ -612,6 +708,7 @@ def _load_export(path: str) -> tuple[list[dict], dict]:
         payload = None
     if isinstance(payload, dict) and "traceEvents" in payload:
         counters = payload.get("floxTpuCounters", {})
+        histograms = payload.get("floxTpuHistograms", {})
         spans_ = [
             {
                 "type": "span" if ev.get("ph") == "X" else "event",
@@ -622,27 +719,48 @@ def _load_export(path: str) -> tuple[list[dict], dict]:
             }
             for ev in payload.get("traceEvents", [])
         ]
-        return spans_, counters
+        return spans_, counters, histograms
     counters: dict = {}
+    histograms: dict = {}
     spans_ = []
-    for line in text.splitlines():
+    for lineno, line in enumerate(text.splitlines(), start=1):
         line = line.strip()
         if not line:
             continue
-        rec = json.loads(line)
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as exc:
+            raise ValueError(
+                f"{path}:{lineno}: malformed JSON-lines record ({exc})"
+            ) from exc
+        if not isinstance(rec, dict):
+            raise ValueError(
+                f"{path}:{lineno}: JSON-lines record is "
+                f"{type(rec).__name__}, expected an object"
+            )
         if rec.get("type") == "counters":
             # later snapshots supersede earlier ones (append-mode files
             # may carry one per flush)
             counters = rec.get("counters", {})
+            histograms = rec.get("histograms", {})
         else:
             spans_.append(rec)
+    return spans_, counters, histograms
+
+
+def _load_export(path: str) -> tuple[list[dict], dict]:
+    """Back-compat 2-tuple view of :func:`_parse_export`."""
+    spans_, counters, _ = _parse_export(path)
     return spans_, counters
 
 
 def summarize(records: list[dict]) -> list[dict]:
-    """Aggregate span records per name: count / total / mean / max ms,
-    sorted by total descending."""
+    """Aggregate span records per name: count / total / mean / p50 / p99 /
+    max ms, sorted by total descending. Percentiles here are EXACT (from
+    the raw durations) — the registry histograms trade that exactness for
+    a bounded, mergeable representation."""
     agg: dict[str, dict] = {}
+    durs: dict[str, list[float]] = {}
     for rec in records:
         if rec.get("type") != "span":
             continue
@@ -653,28 +771,52 @@ def summarize(records: list[dict]) -> list[dict]:
         row["count"] += 1
         row["total_ms"] += dur_ms
         row["max_ms"] = max(row["max_ms"], dur_ms)
+        durs.setdefault(rec["name"], []).append(dur_ms)
     out = sorted(agg.values(), key=lambda r: -r["total_ms"])
     for row in out:
         row["mean_ms"] = row["total_ms"] / row["count"] if row["count"] else 0.0
+        seq = sorted(durs[row["name"]])
+        # nearest-rank with ceiling: the upper percentile of a small sample
+        # must not round down past its tail (p99 of 5 spans IS the max)
+        row["p50_ms"] = seq[min(len(seq) - 1, math.ceil(0.50 * (len(seq) - 1)))]
+        row["p99_ms"] = seq[min(len(seq) - 1, math.ceil(0.99 * (len(seq) - 1)))]
     return out
 
 
-def _report_lines(path: str) -> list[str]:
-    records, counters = _load_export(path)
+def _report_lines(path: str, histograms: bool = False) -> list[str]:
+    records, counters, hists = _parse_export(path)
     rows = summarize(records)
     nevents = sum(1 for r in records if r.get("type") == "event")
     lines = [
         f"telemetry report — {path}",
         f"{len(records) - nevents} span(s), {nevents} event(s)",
         "",
-        f"{'phase':<40} {'count':>7} {'total ms':>12} {'mean ms':>10} {'max ms':>10}",
-        "-" * 82,
+        f"{'phase':<36} {'count':>7} {'total ms':>12} {'mean ms':>10} "
+        f"{'p50 ms':>10} {'p99 ms':>10} {'max ms':>10}",
+        "-" * 100,
     ]
     for row in rows:
         lines.append(
-            f"{row['name'][:40]:<40} {row['count']:>7} {row['total_ms']:>12.2f} "
-            f"{row['mean_ms']:>10.3f} {row['max_ms']:>10.2f}"
+            f"{row['name'][:36]:<36} {row['count']:>7} {row['total_ms']:>12.2f} "
+            f"{row['mean_ms']:>10.3f} {row['p50_ms']:>10.3f} "
+            f"{row['p99_ms']:>10.3f} {row['max_ms']:>10.2f}"
         )
+    if histograms:
+        lines += ["", "histograms (registry, log-spaced buckets):"]
+        if not hists:
+            lines.append("  (export carries no histogram snapshot)")
+        for name in sorted(hists):
+            hist = hists[name]
+            count = hist.get("count", 0)
+            if not count:
+                continue
+            p50, p90, p99 = (
+                _hist_percentile(hist, q) for q in (0.50, 0.90, 0.99)
+            )
+            lines.append(
+                f"  {name[:38]:<38} {count:>7} obs "
+                f"p50 {p50:>10.3f}  p90 {p90:>10.3f}  p99 {p99:>10.3f}"
+            )
     if counters:
         lines += ["", "counters/gauges:"]
         for name in sorted(counters):
@@ -694,13 +836,19 @@ def main(argv: list[str] | None = None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
     rep = sub.add_parser("report", help="per-phase summary table of an export file")
     rep.add_argument("file", help="a .jsonl or Chrome-trace .json telemetry export")
+    rep.add_argument(
+        "--histograms", action="store_true",
+        help="also print the registry histograms (per-metric p50/p90/p99)",
+    )
     args = parser.parse_args(argv)
     try:
-        lines = _report_lines(args.file)
+        lines = _report_lines(args.file, histograms=args.histograms)
     except OSError as exc:
         parser.error(f"cannot read {args.file}: {exc}")
-    except (json.JSONDecodeError, KeyError, TypeError) as exc:
-        parser.error(f"{args.file} is not a telemetry export: {exc}")
+    except (ValueError, KeyError, TypeError) as exc:
+        # ValueError covers json.JSONDecodeError AND _parse_export's
+        # malformed-line error (which names file:line) — both exit non-zero
+        parser.error(f"{args.file} is not a readable telemetry export: {exc}")
     print("\n".join(lines))
     return 0
 

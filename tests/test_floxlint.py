@@ -161,6 +161,56 @@ def test_swallowed_retry_exception_fails(tmp_path):
     assert not [f for f in lint_file(good) if f.rule == "FLX006"]
 
 
+def test_unregistered_autotune_store_fails_flx008(tmp_path):
+    # ISSUE 6 satellite: the autotune measurement store is a module-level
+    # mutable cache that accretes at runtime; reintroducing it (or any
+    # successor) WITHOUT the matching cache.clear_all registration must be
+    # caught statically. This mirrors the real flox_tpu.autotune shape: a
+    # sibling package whose clear_all forgets the store.
+    pkg = tmp_path / "minipkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "autotune.py").write_text(
+        '"""Mini autotune module with an unregistered store."""\n\n'
+        "_AUTOTUNE_CACHE: dict = {}\n\n\n"
+        "def record(key, candidate, gbps):\n"
+        "    rec = _AUTOTUNE_CACHE.setdefault(key, {})\n"
+        "    rec[candidate] = gbps\n"
+        "    return rec\n"
+    )
+    (pkg / "cache.py").write_text(
+        '"""clear_all that misses the autotune store."""\n\n\n'
+        "def clear_all():\n"
+        "    pass\n"
+    )
+    findings = [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+    assert len(findings) == 1
+    assert "_AUTOTUNE_CACHE" in findings[0].message
+    assert findings[0].path.endswith("autotune.py")
+    # registering it in clear_all makes the package clean again — the
+    # spelling flox_tpu.cache.clear_all actually uses
+    (pkg / "cache.py").write_text(
+        '"""clear_all that registers the autotune store."""\n\n\n'
+        "def clear_all():\n"
+        "    from .autotune import _AUTOTUNE_CACHE\n\n"
+        "    _AUTOTUNE_CACHE.clear()\n"
+    )
+    assert not [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+
+
+def test_real_autotune_store_is_registered():
+    # the static complement: the REAL store must be reachable from the real
+    # clear_all (covered by test_flox_tpu_package_is_clean too; this
+    # assertion names the contract so a refactor cannot lose it silently)
+    import flox_tpu.cache as flox_cache
+    from flox_tpu.autotune import _AUTOTUNE_CACHE, record
+
+    record("segment_sum", "scatter", 1.0, dtype="float32", ngroups=4, nelems=64)
+    assert len(_AUTOTUNE_CACHE) >= 1
+    flox_cache.clear_all()
+    assert _AUTOTUNE_CACHE == {}
+
+
 def test_eager_logging_reintroduction_fails(tmp_path):
     # ISSUE 4 satellite: hot-path logging that formats eagerly (f-string)
     # or prints straight to stdout must fail the lint; the lazy %-style

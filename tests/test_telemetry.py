@@ -432,3 +432,101 @@ def test_acceptance_perfetto_trace_with_compile_counter(tmp_path):
     # and the trace is self-describing enough for the report tool
     rows = telemetry.summarize(telemetry._load_export(str(path))[0])
     assert any(r["name"] == "dispatch" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# histograms (ISSUE 6): log-spaced buckets, p50/p99, report CLI
+# ---------------------------------------------------------------------------
+
+
+class TestHistograms:
+    def test_observe_and_percentile(self):
+        reg = telemetry.MetricsRegistry()
+        for v in (1.0, 1.0, 1.0, 1.0, 100.0):
+            reg.observe("lat_ms", v)
+        hist = reg.histograms()["lat_ms"]
+        assert hist["count"] == 5
+        assert hist["sum"] == pytest.approx(104.0)
+        assert hist["min"] == 1.0 and hist["max"] == 100.0
+        assert sum(hist["counts"]) == 5
+        p50 = reg.percentile("lat_ms", 0.50)
+        assert 0.5 <= p50 <= 2.1  # inside (or clamped to) the 1.0 bucket
+        assert reg.percentile("lat_ms", 0.99) == pytest.approx(100.0)
+        assert reg.percentile("lat_ms", 0.0) == 1.0  # clamped to observed min
+        assert reg.percentile("unknown", 0.5) is None
+
+    def test_reset_clears_histograms(self):
+        telemetry.METRICS.observe("lat_ms", 5.0)
+        cache.clear_all()
+        assert telemetry.METRICS.histograms() == {}
+
+    def test_spans_feed_histograms_when_enabled(self):
+        with flox_tpu.set_options(telemetry=True):
+            with telemetry.span("phasex"):
+                pass
+        hists = telemetry.METRICS.histograms()
+        assert "span_ms.phasex" in hists
+        assert hists["span_ms.phasex"]["count"] == 1
+
+    def test_disabled_spans_leave_histograms_untouched(self):
+        with telemetry.span("phasex"):
+            pass
+        assert telemetry.METRICS.histograms() == {}
+
+    def test_summarize_has_exact_percentiles(self):
+        records = [
+            {"type": "span", "name": "p", "dur_us": d * 1e3}
+            for d in (1.0, 2.0, 3.0, 4.0, 100.0)
+        ]
+        row = telemetry.summarize(records)[0]
+        assert row["p50_ms"] == 3.0
+        assert row["p99_ms"] == 100.0
+
+    def test_exports_carry_histograms_both_formats(self, tmp_path):
+        with flox_tpu.set_options(telemetry=True):
+            with telemetry.span("phasex"):
+                pass
+            j, c = tmp_path / "t.jsonl", tmp_path / "t.json"
+            telemetry.export_jsonl(str(j))
+            telemetry.export_chrome_trace(str(c))
+        payload = json.loads(c.read_text())
+        assert "span_ms.phasex" in payload["floxTpuHistograms"]
+        assert payload["floxTpuHistEdgesMs"] == list(telemetry.HIST_EDGES_MS)
+        _, _, hists_j = telemetry._parse_export(str(j))
+        _, _, hists_c = telemetry._parse_export(str(c))
+        assert hists_j["span_ms.phasex"]["count"] == 1
+        assert hists_c["span_ms.phasex"]["count"] == 1
+
+    def test_report_cli_histograms_flag(self, tmp_path, capsys):
+        with flox_tpu.set_options(telemetry=True):
+            with telemetry.span("phasex"):
+                pass
+            path = tmp_path / "t.jsonl"
+            telemetry.export_jsonl(str(path))
+        rc = telemetry.main(["report", str(path), "--histograms"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "histograms" in out
+        assert "span_ms.phasex" in out
+        assert "p99" in out
+        # the default table now carries the exact per-phase percentiles too
+        assert "p50 ms" in out and "p99 ms" in out
+
+    def test_report_cli_rejects_malformed_jsonl_line(self, tmp_path, capsys):
+        path = tmp_path / "t.jsonl"
+        path.write_text(
+            '{"type": "span", "name": "ok", "dur_us": 1.0}\n'
+            "{broken json line\n"
+        )
+        with pytest.raises(SystemExit) as exc_info:
+            telemetry.main(["report", str(path)])
+        assert exc_info.value.code != 0
+        err = capsys.readouterr().err
+        assert ":2:" in err  # the error names the malformed line
+
+    def test_report_cli_rejects_non_object_jsonl_line(self, tmp_path):
+        path = tmp_path / "t.jsonl"
+        path.write_text('{"type": "span", "name": "ok", "dur_us": 1.0}\n[1, 2]\n')
+        with pytest.raises(SystemExit) as exc_info:
+            telemetry.main(["report", str(path)])
+        assert exc_info.value.code != 0
