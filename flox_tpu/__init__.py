@@ -6,7 +6,7 @@ built on JAX/XLA: device-resident group codes, jit-compiled segment-reduce
 kernels, and shard_map/collective execution strategies over a TPU mesh.
 """
 
-from . import autotune, cache, cohorts, faults, kernels, profiling, resilience, telemetry, xrlite
+from . import autotune, cache, cohorts, faults, kernels, profiling, resilience, serve, telemetry, xrlite
 from .aggregations import Aggregation, Scan, is_supported_aggregation
 from .xarray import xarray_reduce
 from .rechunk import rechunk_for_blockwise, rechunk_for_cohorts, reshard_for_blockwise
@@ -46,6 +46,7 @@ __all__ = [
     "ReindexArrayType",
     "ReindexStrategy",
     "resilience",
+    "serve",
     "set_options",
     "streaming_groupby_reduce",
     "streaming_groupby_scan",

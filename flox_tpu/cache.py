@@ -11,8 +11,94 @@ dicts suffice; ``memoize`` keeps the reference's decorator name and
 from __future__ import annotations
 
 import functools
+import threading
+from collections import OrderedDict
+from typing import Any, Iterator
 
 memoize = functools.lru_cache(maxsize=512)
+
+
+class LRUCache:
+    """A bounded dict with least-recently-used eviction, for the compiled
+    program caches.
+
+    The mesh ``_PROGRAM_CACHE`` and streaming ``_STEP_CACHE`` used to
+    wholesale ``.clear()`` past 256 entries — under sustained mixed traffic
+    that evicts every HOT compiled program the moment one cold key tips the
+    bound, and the next request for each recompiles from scratch (seconds
+    of XLA wall per program). LRU keeps the hot set: a ``get`` hit renews
+    the entry, inserts evict only the single stalest key, and the eviction
+    count is visible in :func:`stats` so a serving process can alarm on
+    thrash instead of discovering it as tail latency.
+
+    The mapping surface mirrors what callers already used on the plain
+    dicts (``get`` / ``[]=`` / ``len`` / ``clear`` / ``items`` / ``in``);
+    a lock keeps renew-on-read safe under the serving dispatcher's
+    executor threads.
+    """
+
+    def __init__(self, maxsize: int = 256) -> None:
+        if maxsize < 1:
+            raise ValueError(f"LRUCache maxsize must be >= 1, got {maxsize}")
+        self.maxsize = maxsize
+        self.evictions = 0
+        self._data: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        with self._lock:
+            try:
+                self._data.move_to_end(key)
+            except KeyError:
+                return default
+            return self._data[key]
+
+    def __getitem__(self, key: Any) -> Any:
+        with self._lock:
+            self._data.move_to_end(key)
+            return self._data[key]
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            while len(self._data) > self.maxsize:
+                self._data.popitem(last=False)
+                self.evictions += 1
+
+    def __contains__(self, key: Any) -> bool:
+        with self._lock:
+            return key in self._data
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def __iter__(self) -> Iterator:
+        with self._lock:
+            return iter(list(self._data))
+
+    def keys(self) -> list:
+        with self._lock:
+            return list(self._data.keys())
+
+    def values(self) -> list:
+        with self._lock:
+            return list(self._data.values())
+
+    def items(self) -> list:
+        with self._lock:
+            return list(self._data.items())
+
+    def pop(self, key: Any, *default: Any) -> Any:
+        with self._lock:
+            return self._data.pop(key, *default)
+
+    def clear(self) -> None:
+        """Drop every entry (eviction counter intact: it counts capacity
+        evictions, not deliberate clears)."""
+        with self._lock:
+            self._data.clear()
 
 
 def stats() -> dict:
@@ -27,6 +113,8 @@ def stats() -> dict:
     from .factorize import _FACTORIZE_CACHE
     from .parallel.mapreduce import _PROGRAM_CACHE
     from .parallel.scan import _SCAN_CACHE
+    from .serve.aot import _MANIFEST_MEMO
+    from .serve.dispatcher import _BATCH_REGISTRY, _COALESCE_CACHE, _PENDING_REGISTRY
     from .streaming import _STEP_CACHE
 
     info = _jitted_bundle.cache_info()
@@ -37,6 +125,19 @@ def stats() -> dict:
         "scan_programs": len(_SCAN_CACHE),
         "stream_steps": len(_STEP_CACHE),
         "autotune": len(_AUTOTUNE_CACHE),
+        # capacity evictions of the compiled-program LRUs: a serving
+        # process alarms on these climbing (program-cache thrash shows up
+        # here first, as recompiles second, as tail latency last)
+        "evictions": {
+            "mesh_programs": _PROGRAM_CACHE.evictions,
+            "stream_steps": _STEP_CACHE.evictions,
+        },
+        # serving layer: queued/in-flight requests, open coalescing
+        # entries + micro-batches, and AOT programs pending manifest save
+        "serve_pending": len(_PENDING_REGISTRY),
+        "serve_coalesce": len(_COALESCE_CACHE),
+        "serve_batches": len(_BATCH_REGISTRY),
+        "serve_aot_manifest": len(_MANIFEST_MEMO),
         "bundle_lru": {
             "size": info.currsize, "hits": info.hits, "misses": info.misses
         },
@@ -68,6 +169,8 @@ def clear_all() -> None:
     from .parallel.scan import _SCAN_CACHE
     from .pipeline import _DONATION_OK
     from .resilience import _SNAPSHOTS
+    from .serve.aot import _MANIFEST_MEMO
+    from .serve.dispatcher import _BATCH_REGISTRY, _COALESCE_CACHE, _PENDING_REGISTRY
     from .streaming import _STEP_CACHE
     from .telemetry import METRICS
 
@@ -79,6 +182,14 @@ def clear_all() -> None:
     _STEP_CACHE.clear()
     _DONATION_OK.clear()
     _SNAPSHOTS.clear()
+    # serving layer (flox_tpu/serve/): admission/pending table, coalescing
+    # + micro-batch tables, and the AOT warmup-manifest memo. Safe while a
+    # dispatcher is live: open batches hold direct references to their own
+    # entries, so a clear only prevents NEW requests from joining them.
+    _PENDING_REGISTRY.clear()
+    _COALESCE_CACHE.clear()
+    _BATCH_REGISTRY.clear()
+    _MANIFEST_MEMO.clear()
     # pallas one-time probe memos (floxlint FLX008: every runtime-accreted
     # module-level cache must be reachable from here) — the next reduction
     # after a clear re-validates the backend, which is exactly the fresh

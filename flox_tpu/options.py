@@ -6,6 +6,7 @@ semantics for its resharding analogue and adds device-policy knobs.
 
 from __future__ import annotations
 
+import contextvars
 import os
 from typing import Any
 
@@ -56,6 +57,41 @@ def _env_choice(name: str, default: str, valid: tuple[str, ...]) -> str:
     same cannot-seed-what-set_options-refuses contract."""
     value = os.environ.get(name, default)
     return value if value in valid else default
+
+
+#: the active option overlay: ``(values, pinned_names)`` installed by
+#: :class:`scoped`, or ``None`` outside any scope. A contextvar so each
+#: asyncio task / ``contextvars.Context`` sees its own overlay — the
+#: serving dispatcher runs concurrent requests with different knobs
+#: without racing on the process-global dict below (ROADMAP item 2's
+#: serving-critical slice; the span tracer set this precedent in PR 4).
+_SCOPE: contextvars.ContextVar[tuple[dict, frozenset] | None] = contextvars.ContextVar(
+    "flox_tpu_option_scope", default=None
+)
+
+
+class _ScopedOptions(dict):
+    """The process OPTIONS dict with contextvar overlay reads.
+
+    ``OPTIONS[k]`` consults the innermost active :class:`scoped` overlay
+    first and falls back to the global value, so every existing read site
+    (``OPTIONS["telemetry"]``, ``trace_fingerprint()``, ...) becomes
+    scope-aware without changing. Writes (``set_options``, ``update``)
+    still hit the global base — a scope is an overlay, never a fork."""
+
+    __slots__ = ()
+
+    def __getitem__(self, key: str) -> Any:
+        scope = _SCOPE.get()
+        if scope is not None and key in scope[0]:
+            return scope[0][key]
+        return dict.__getitem__(self, key)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        scope = _SCOPE.get()
+        if scope is not None and key in scope[0]:
+            return scope[0][key]
+        return dict.get(self, key, default)
 
 
 OPTIONS: dict[str, Any] = {
@@ -205,6 +241,37 @@ OPTIONS: dict[str, Any] = {
     # deployments can tune the crossover without a code change (ADVICE r5);
     # the autotuner's measured "engine" records override it when enabled.
     "numpy_engine_max_elems": _env_int("FLOX_TPU_NUMPY_ENGINE_MAX_ELEMS", 32768, 0),
+    # Serving layer (flox_tpu/serve/): admission-control bound on requests
+    # pending in the dispatcher (queued + executing). A submit beyond this
+    # depth is load-shed immediately (serve.LoadShedError) instead of
+    # growing an unbounded backlog the device can never drain. 0 disables
+    # admission control.
+    "serve_queue_depth": _env_int("FLOX_TPU_SERVE_QUEUE_DEPTH", 64, 0),
+    # default per-request deadline in seconds (queue wait + device time): a
+    # request still undispatched past it is cancelled with
+    # serve.DeadlineExceededError, never dispatched. 0 = no deadline.
+    # Per-request deadline= overrides.
+    "serve_deadline": _env_float("FLOX_TPU_SERVE_DEADLINE", 0.0),
+    # how many program-compatible small requests the dispatcher may stack
+    # into ONE device dispatch (a leading batch axis over identical-shape
+    # payloads sharing codes + program). 1 disables micro-batching.
+    "serve_microbatch_max": _env_int("FLOX_TPU_SERVE_MICROBATCH_MAX", 8, 1, 1024),
+    # seconds a freshly opened coalescing/micro-batch window stays open for
+    # compatible concurrent requests to join before the batch dispatches.
+    # 0 still yields the event loop once (same-tick submits coalesce);
+    # higher values trade first-request latency for batching opportunity.
+    "serve_batch_window": _env_float("FLOX_TPU_SERVE_BATCH_WINDOW", 0.002, 0.0, 60.0),
+    # elements ceiling for micro-batch eligibility: requests above it
+    # dispatch alone (stacking huge payloads would serialize the batch
+    # behind one giant program rather than amortize dispatch overhead)
+    "serve_microbatch_max_elems": _env_int(
+        "FLOX_TPU_SERVE_MICROBATCH_MAX_ELEMS", 1 << 20, 0
+    ),
+    # AOT persistence root (flox_tpu/serve/aot.py): the JAX persistent
+    # compilation cache directory + the warmup manifest next to it. A
+    # fresh replica pointed at a warm dir serves its first request with
+    # zero backend compiles. None disables persistence.
+    "serve_aot_dir": os.environ.get("FLOX_TPU_SERVE_AOT_DIR") or None,
 }
 
 # single source of truth for the accumulation disciplines — referenced by
@@ -253,7 +320,21 @@ _VALIDATORS = {
         isinstance(x, (str, os.PathLike)) and bool(str(x))
     ),
     "numpy_engine_max_elems": lambda x: _is_int(x) and x >= 0,
+    # serving knobs: same at-set-time discipline — a negative depth or a
+    # non-finite deadline raises here, not inside the dispatcher loop
+    "serve_queue_depth": lambda x: _is_int(x) and x >= 0,
+    "serve_deadline": lambda x: _is_finite_num(x) and x >= 0,
+    "serve_microbatch_max": lambda x: _is_int(x) and 1 <= x <= 1024,
+    "serve_batch_window": lambda x: _is_finite_num(x) and 0 <= x <= 60,
+    "serve_microbatch_max_elems": lambda x: _is_int(x) and x >= 0,
+    "serve_aot_dir": lambda x: x is None or (
+        isinstance(x, (str, os.PathLike)) and bool(str(x))
+    ),
 }
+
+# rebind the literal through the overlay-aware view: same object contents,
+# scope-aware reads everywhere `from .options import OPTIONS` already lands
+OPTIONS = _ScopedOptions(OPTIONS)
 
 
 def _is_int(x: Any) -> bool:
@@ -317,9 +398,74 @@ _EXPLICIT_OPTIONS: set[str] = {
 
 
 def explicitly_set(name: str) -> bool:
-    """Whether ``name`` was pinned by the user (env mirror or set_options)
-    rather than riding its built-in default."""
+    """Whether ``name`` was pinned by the user (env mirror, set_options, or
+    the innermost :class:`scoped` overlay) rather than riding its built-in
+    default. Scope pins end with the scope: provenance respects the active
+    overlay exactly as values do."""
+    scope = _SCOPE.get()
+    if scope is not None and name in scope[1]:
+        return True
     return name in _EXPLICIT_OPTIONS
+
+
+def scope_overrides() -> dict:
+    """The active :class:`scoped` overlay, merged innermost-wins — ``{}``
+    outside any scope. The serving dispatcher folds this into each
+    request's program key and execution overlay, so a submit made under an
+    ambient scope never shares a dispatch with differently-scoped peers."""
+    scope = _SCOPE.get()
+    return dict(scope[0]) if scope is not None else {}
+
+
+class scoped:
+    """Context-scoped option overlay: concurrent callers, isolated knobs.
+
+    >>> import flox_tpu
+    >>> from flox_tpu.options import OPTIONS, scoped
+    >>> with scoped(default_engine="numpy"):
+    ...     OPTIONS["default_engine"]
+    'numpy'
+    >>> OPTIONS["default_engine"]
+    'jax'
+
+    Unlike :class:`set_options` (which mutates the process-global dict and
+    therefore races under concurrency), ``scoped`` installs a contextvar
+    overlay visible only to the current context — asyncio tasks inherit a
+    copy at creation, threads start clean, and nested scopes merge with the
+    innermost value winning. The serving dispatcher wraps every request's
+    execution in its requested scope, so N concurrent requests with
+    different engines/telemetry levels read N different views of the same
+    OPTIONS object. Validation matches ``set_options`` (bad values raise at
+    entry, never mid-dispatch); ``explicitly_set`` reports overlay names as
+    pinned while the scope is live, so the autotuner never adapts a knob a
+    request pinned.
+    """
+
+    def __init__(self, **overrides: Any) -> None:
+        for k, v in overrides.items():
+            if k not in OPTIONS:
+                raise ValueError(
+                    f"argument name {k!r} is not in the set of valid options {set(OPTIONS)!r}"
+                )
+            if k in _VALIDATORS and not _VALIDATORS[k](v):
+                raise ValueError(f"option {k!r} given an invalid value: {v!r}")
+        self._overrides = overrides
+        self._token: contextvars.Token | None = None
+
+    def __enter__(self) -> "scoped":
+        parent = _SCOPE.get()
+        if parent is None:
+            values, pins = dict(self._overrides), frozenset(self._overrides)
+        else:
+            values = {**parent[0], **self._overrides}
+            pins = parent[1] | frozenset(self._overrides)
+        self._token = _SCOPE.set((values, pins))
+        return self
+
+    def __exit__(self, *args: Any) -> None:
+        if self._token is not None:
+            _SCOPE.reset(self._token)
+            self._token = None
 
 
 class set_options:
@@ -337,7 +483,10 @@ class set_options:
                 raise ValueError(f"argument name {k!r} is not in the set of valid options {set(OPTIONS)!r}")
             if k in _VALIDATORS and not _VALIDATORS[k](v):
                 raise ValueError(f"option {k!r} given an invalid value: {v!r}")
-            self.old[k] = OPTIONS[k]
+            # snapshot the GLOBAL base value, not the scope-aware view: a
+            # set_options inside a scoped() block must restore the base on
+            # exit, never promote the overlay value into the process dict
+            self.old[k] = dict.__getitem__(OPTIONS, k)
         # pin provenance alongside the value (matters only to the
         # autotuner's may-I-adapt check, never to option values). A plain
         # setter call pins for the rest of the session; the context-manager

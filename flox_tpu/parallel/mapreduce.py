@@ -36,6 +36,7 @@ import numpy as np
 
 from .. import utils
 from ..aggregations import Aggregation
+from ..cache import LRUCache
 from ..multiarray import MultiArray
 from .mesh import axis_size, make_mesh, shard_map
 
@@ -504,8 +505,8 @@ def sharded_groupby_reduce(
                 program, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
             )
         )
-        if len(_PROGRAM_CACHE) > 256:
-            _PROGRAM_CACHE.clear()
+        # bounded LRU: a cold key past capacity evicts ONE stale program
+        # (counted in cache.stats()["evictions"]), never the whole hot set
         _PROGRAM_CACHE[cache_key] = fn
         from ..profiling import timed
 
@@ -525,7 +526,11 @@ def sharded_groupby_reduce(
         return fn(arr, codes_dev)
 
 
-_PROGRAM_CACHE: dict = {}
+#: compiled shard_map programs, LRU-bounded: get() renews recency, inserts
+#: past capacity evict the single least-recently-served program (the old
+#: wholesale clear-at-256 dropped every hot program under sustained
+#: mixed-key traffic — exactly the serving workload's shape)
+_PROGRAM_CACHE: LRUCache = LRUCache(maxsize=256)
 
 
 def _agg_cache_key(agg: Aggregation):

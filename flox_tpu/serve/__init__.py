@@ -1,0 +1,45 @@
+"""Groupby-as-a-service: the serving front-end (ROADMAP item 1).
+
+The library below this package is call-at-a-time; a serving replica
+amortizes compilation, device dispatch, and admission decisions across
+requests instead:
+
+* :mod:`.dispatcher` — the asyncio front-end: request coalescing
+  (identical-program-identical-payload requests share ONE execution),
+  micro-batching (program-compatible small payloads stack into one device
+  dispatch), and admission control (bounded queue depth, per-request
+  deadlines with cancellation, load-shed at saturation).
+* :mod:`.aot` — program persistence: JAX's persistent compilation cache
+  rooted at ``OPTIONS["serve_aot_dir"]`` plus a warmup manifest, so a
+  restarted replica serves its first request with zero new backend
+  compiles (asserted on the ``jax.compiles`` telemetry counter).
+* ``python -m flox_tpu.serve`` — a JSON-lines request loop over the
+  dispatcher, for testing and smoke deployment (see :mod:`.__main__`).
+
+Per-request SLO metrics (``serve.queue_ms`` / ``serve.device_ms`` /
+``serve.request_ms`` histograms, ``serve.*`` counters) flow through the
+process telemetry registry; serving state is visible in ``cache.stats()``
+and reset by ``cache.clear_all()``.
+"""
+
+from __future__ import annotations
+
+from . import aot
+from .dispatcher import (
+    AggregationRequest,
+    DeadlineExceededError,
+    Dispatcher,
+    LoadShedError,
+    ServeError,
+    ServeResult,
+)
+
+__all__ = [
+    "AggregationRequest",
+    "DeadlineExceededError",
+    "Dispatcher",
+    "LoadShedError",
+    "ServeError",
+    "ServeResult",
+    "aot",
+]

@@ -1,0 +1,204 @@
+"""``python -m flox_tpu.serve`` — JSON-lines serving loop.
+
+One JSON object per input line (stdin by default, ``--input FILE`` for
+scripted runs), one JSON object per output line. Request lines carry the
+:class:`~flox_tpu.serve.AggregationRequest` fields::
+
+    {"id": "r1", "func": "sum", "array": [...], "by": [...],
+     "options": {"default_engine": "numpy"}, "deadline": 0.5}
+
+and are submitted CONCURRENTLY as they are read — lines arriving within
+the batching window coalesce / micro-batch exactly as library callers do.
+Responses are emitted as each completes (match them by ``id``)::
+
+    {"id": "r1", "ok": true, "result": [...], "groups": [...],
+     "coalesced": false, "batch": 1, "queue_ms": 0.4, "device_ms": 2.1}
+    {"id": "r2", "ok": false, "error": "LoadShedError", "message": "..."}
+
+Control lines use ``op`` instead of ``func``:
+
+* ``{"op": "warmup"}`` — replay the AOT manifest (:func:`serve.aot.warmup`);
+  responds with ``{"warmed": N, "compiles": <jax.compiles so far>}``.
+* ``{"op": "stats"}`` — cache.stats() + the telemetry counter snapshot
+  (``jax.compiles`` included: the two-process AOT smoke asserts on it).
+* ``{"op": "drain"}`` — wait for every in-flight request before reading on
+  (scripted runs use it to sequence assertions).
+
+The loop exits at EOF after draining in-flight work. Malformed lines get
+an ``ok: false`` response with ``error: "protocol"`` — one bad client line
+must never take the replica down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from typing import Any
+
+import numpy as np
+
+from . import aot
+from .dispatcher import AggregationRequest, Dispatcher, ServeError
+
+_REQUEST_FIELDS = frozenset(
+    {
+        "func", "array", "by", "expected_groups", "fill_value", "dtype",
+        "min_count", "engine", "finalize_kwargs", "options", "deadline",
+    }
+)
+
+
+def _emit(obj: dict) -> None:
+    # all emits run on the event-loop thread, so lines never interleave
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def _counters() -> dict:
+    from .. import cache
+    from ..telemetry import METRICS
+
+    return {"cache": cache.stats(), "counters": METRICS.snapshot()}
+
+
+async def _serve_request(dispatcher: Dispatcher, line_no: int, msg: dict) -> None:
+    rid = msg.get("id", f"line-{line_no}")
+    try:
+        unknown = set(msg) - _REQUEST_FIELDS - {"id"}
+        if unknown:
+            raise ValueError(f"unknown request fields: {sorted(unknown)}")
+        request = AggregationRequest(
+            request_id=rid, **{k: v for k, v in msg.items() if k != "id"}
+        )
+    except Exception as exc:  # noqa: BLE001 — malformed envelope, client's bug
+        _emit({"id": rid, "ok": False, "error": "protocol", "message": str(exc)})
+        return
+    try:
+        result = await dispatcher.submit(request)
+    except ServeError as exc:
+        _emit(
+            {"id": rid, "ok": False, "error": type(exc).__name__, "message": str(exc)}
+        )
+    except Exception as exc:  # noqa: BLE001 — execution failed, NOT a protocol
+        # error: report the real class so clients can tell a bad func/dtype
+        # apart from a malformed line (and never kill the loop over it)
+        _emit(
+            {"id": rid, "ok": False, "error": type(exc).__name__, "message": str(exc)}
+        )
+    else:
+        _emit(
+            {
+                "id": rid,
+                "ok": True,
+                "result": np.asarray(result.result).tolist(),
+                "groups": np.asarray(result.groups).tolist(),
+                "coalesced": result.coalesced,
+                "batch": result.batch_size,
+                "queue_ms": round(result.queue_ms, 3),
+                "device_ms": round(result.device_ms, 3),
+            }
+        )
+
+
+async def _amain(args: argparse.Namespace) -> int:
+    from ..options import set_options
+
+    if args.aot_dir:
+        set_options(serve_aot_dir=args.aot_dir)
+    if args.warmup:
+        warmed = await asyncio.to_thread(aot.warmup)
+        from ..telemetry import METRICS
+
+        _emit({"warmed": warmed, "compiles": METRICS.get("jax.compiles")})
+    dispatcher = Dispatcher(
+        queue_depth=args.queue_depth,
+        deadline=args.deadline,
+        microbatch_max=args.microbatch_max,
+        batch_window=args.batch_window,
+    )
+    stream = sys.stdin if args.input == "-" else open(args.input)
+    pending: set[asyncio.Task] = set()
+    line_no = 0
+    try:
+        while True:
+            # one reader thread-hop per line; requests run concurrently
+            # because we never await the per-request task here
+            line = await asyncio.to_thread(stream.readline)
+            if not line:
+                break
+            line_no += 1
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+                assert isinstance(msg, dict)
+            # noqa: FLX006 — not a retry loop: lines are independent client
+            # requests, and one malformed line must never kill the replica
+            except Exception:  # noqa: FLX006
+                _emit(
+                    {
+                        "id": f"line-{line_no}", "ok": False, "error": "protocol",
+                        "message": f"malformed JSON on line {line_no}",
+                    }
+                )
+                continue
+            op = msg.get("op")
+            if op == "stats":
+                _emit({"op": "stats", **_counters()})
+            elif op == "warmup":
+                warmed = await asyncio.to_thread(aot.warmup)
+                from ..telemetry import METRICS
+
+                _emit({"warmed": warmed, "compiles": METRICS.get("jax.compiles")})
+            elif op == "drain":
+                if pending:
+                    await asyncio.gather(*pending, return_exceptions=True)
+                await dispatcher.close()
+                _emit({"op": "drain", "ok": True})
+            elif op is not None:
+                _emit(
+                    {
+                        "id": msg.get("id", f"line-{line_no}"), "ok": False,
+                        "error": "protocol", "message": f"unknown op {op!r}",
+                    }
+                )
+            else:
+                task = asyncio.create_task(_serve_request(dispatcher, line_no, msg))
+                pending.add(task)
+                task.add_done_callback(pending.discard)
+    finally:
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+        await dispatcher.close()
+        if stream is not sys.stdin:
+            stream.close()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m flox_tpu.serve",
+        description="JSON-lines groupby serving loop (one request per line)",
+    )
+    parser.add_argument("--input", default="-", help="request file, or - for stdin")
+    parser.add_argument(
+        "--aot-dir", default=None,
+        help="AOT persistence root (overrides FLOX_TPU_SERVE_AOT_DIR)",
+    )
+    parser.add_argument(
+        "--warmup", action="store_true",
+        help="replay the AOT warmup manifest before reading requests",
+    )
+    parser.add_argument("--queue-depth", type=int, default=None)
+    parser.add_argument("--deadline", type=float, default=None)
+    parser.add_argument("--microbatch-max", type=int, default=None)
+    parser.add_argument("--batch-window", type=float, default=None)
+    args = parser.parse_args(argv)
+    return asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,544 @@
+"""Asyncio serving dispatcher: coalescing, micro-batching, admission control.
+
+The library below this layer is call-at-a-time: every ``groupby_reduce``
+pays its own dispatch, and concurrent callers race on process-global knobs.
+A serving replica amortizes those costs across requests instead:
+
+* **Coalescing** — concurrent requests that lower to the same compiled
+  program AND carry the same payload share ONE execution: the first arrival
+  creates a leaf with a future, later identical requests (same semantic
+  program key — the same identity ``_PROGRAM_CACHE`` / ``_STEP_CACHE`` key
+  on, ``trace_fingerprint()`` included — plus the same payload digest)
+  attach to that future. K identical requests -> exactly one device
+  dispatch, K correct responses (asserted in tests on the
+  ``serve.dispatches`` counter).
+* **Micro-batching** — program-compatible small requests with *different*
+  payloads stack along a new leading axis into one dispatch: B arrays of
+  shape ``(..., N)`` sharing codes + aggregation become one ``(B, ..., N)``
+  reduction whose row ``i`` is request ``i``'s result. Per-row accumulation
+  order is unchanged, so rows are bit-identical to solo runs. Bounded by
+  ``serve_microbatch_max`` requests and ``serve_microbatch_max_elems``
+  elements (stacking huge payloads would serialize the batch behind one
+  giant program rather than amortize dispatch overhead).
+* **Admission control** — ``serve_queue_depth`` bounds requests pending in
+  the dispatcher (queued + executing); a submit beyond it is load-shed
+  immediately (:class:`LoadShedError`) instead of growing a backlog the
+  device can never drain. Per-request deadlines (``deadline=`` or
+  ``serve_deadline``) cancel still-queued requests with
+  :class:`DeadlineExceededError`; a batch whose every waiter expired is
+  abandoned without dispatching, so expired requests never poison the queue.
+* **Isolation** — each request may carry an ``options`` overlay; execution
+  runs under ``options.scoped(**overrides)`` so concurrent requests with
+  different knobs (engine, prefetch, telemetry level) never race on the
+  process-global OPTIONS dict. The overlay is part of the program key:
+  requests only share a dispatch when their execution-relevant knobs agree.
+
+SLO metrics flow through the PR 4/PR 6 telemetry registry: counters
+(``serve.requests`` / ``serve.coalesced`` / ``serve.microbatched`` /
+``serve.dispatches`` / ``serve.shed`` / ``serve.deadline_exceeded`` /
+``serve.errors``) and log-spaced histograms (``serve.queue_ms`` /
+``serve.device_ms`` / ``serve.request_ms``) — queue-time vs device-time
+split per request, p50/p99 via ``METRICS.percentile``. The tables here
+(:data:`_PENDING_REGISTRY`, :data:`_COALESCE_CACHE`,
+:data:`_BATCH_REGISTRY`) are registered in ``cache.clear_all`` /
+``cache.stats`` (floxlint FLX008).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+# options is accessed as a module attribute (options.OPTIONS / scoped),
+# never from-bound: test_resilience importlib.reload()s flox_tpu.options,
+# and a from-import here would keep reading the pre-reload dict while
+# set_options writes to the post-reload one
+from .. import options, telemetry
+from ..telemetry import METRICS
+
+__all__ = [
+    "AggregationRequest",
+    "DeadlineExceededError",
+    "Dispatcher",
+    "LoadShedError",
+    "ServeError",
+    "ServeResult",
+]
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-layer request failures."""
+
+
+class LoadShedError(ServeError):
+    """The dispatcher is saturated (``serve_queue_depth`` reached); the
+    request was rejected WITHOUT queueing — retry with backoff."""
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline passed before its result was ready; if it was
+    still queued, it will never be dispatched."""
+
+
+@dataclass
+class AggregationRequest:
+    """One aggregation request: a ``groupby_reduce`` call plus serving
+    envelope (option overlay, deadline, id). ``array``/``by`` are host
+    arrays (anything ``np.asarray`` accepts)."""
+
+    func: Any
+    array: Any
+    by: Any
+    expected_groups: Any = None
+    fill_value: Any = None
+    dtype: Any = None
+    min_count: int | None = None
+    engine: str | None = None
+    finalize_kwargs: dict | None = None
+    #: ``options.scoped`` overlay active for this request's execution;
+    #: part of the program key, so only knob-identical requests share work
+    options: dict = field(default_factory=dict)
+    #: seconds from submit (queue wait + device time); ``None`` falls back
+    #: to ``OPTIONS["serve_deadline"]`` (0 there = no deadline)
+    deadline: float | None = None
+    request_id: str | None = None
+
+
+@dataclass
+class ServeResult:
+    """A served aggregation: the result/groups arrays plus per-request SLO
+    attribution. ``result``/``groups`` may be shared with coalesced peers —
+    treat them as read-only."""
+
+    result: np.ndarray
+    groups: np.ndarray
+    request_id: str | None = None
+    #: whether this request attached to another request's execution
+    coalesced: bool = False
+    #: leaves in the device dispatch that produced this result
+    batch_size: int = 1
+    queue_ms: float = 0.0
+    device_ms: float = 0.0
+
+
+class _Leaf:
+    """One unit of work: a unique (program, payload) pair. Coalesced
+    requests are extra waiters on the same leaf."""
+
+    __slots__ = (
+        "array", "payload_key", "future", "waiters", "t_dispatch",
+        "batch_size", "device_ms",
+    )
+
+    def __init__(self, array: np.ndarray, payload_key: tuple) -> None:
+        self.array = array
+        self.payload_key = payload_key
+        self.future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.waiters = 1
+        self.t_dispatch: float | None = None
+        self.batch_size = 1
+        self.device_ms = 0.0
+
+
+class _Batch:
+    """An open micro-batch: leaves sharing one program key, dispatched as
+    one device call after the batching window closes."""
+
+    __slots__ = ("pkey", "leaves", "open", "func", "by", "agg_kwargs", "overrides")
+
+    def __init__(
+        self, pkey: tuple, func: Any, by: np.ndarray,
+        agg_kwargs: dict, overrides: dict,
+    ) -> None:
+        self.pkey = pkey
+        self.leaves: list[_Leaf] = []
+        self.open = True
+        self.func = func
+        self.by = by
+        self.agg_kwargs = agg_kwargs
+        self.overrides = overrides
+
+
+#: admission/pending table: every admitted request (queued OR executing),
+#: keyed by a process-unique sequence id — ``len()`` is the queue depth the
+#: admission check bounds. Registered in cache.clear_all (FLX008).
+_PENDING_REGISTRY: dict[int, AggregationRequest] = {}
+
+#: coalescing table: (program key, payload digest) -> live _Leaf. Entries
+#: exist from first submit until their dispatch completes, so identical
+#: requests attach to queued AND in-flight executions alike.
+_COALESCE_CACHE: dict[tuple, _Leaf] = {}
+
+#: open micro-batches: program key -> the joinable _Batch (closed batches
+#: leave the table; their dispatch task keeps them alive).
+_BATCH_REGISTRY: dict[tuple, _Batch] = {}
+
+_IDS = itertools.count(1)
+
+#: reductions whose results grow axes (quantile's q-dim) or need run-length
+#: structure — stacking them along a lead axis would reshape results per
+#: request, so they always dispatch alone
+_UNBATCHABLE = frozenset(
+    {"quantile", "nanquantile", "median", "nanmedian", "mode", "nanmode"}
+)
+
+
+def _digest_bytes(*parts: bytes) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    for p in parts:
+        h.update(p)
+        h.update(b"|")
+    return h.hexdigest()
+
+
+def _array_digest(arr: np.ndarray) -> str:
+    arr = np.ascontiguousarray(arr)
+    return _digest_bytes(str(arr.dtype).encode(), repr(arr.shape).encode(), arr.tobytes())
+
+
+#: payloads up to this many bytes hash inline on the event-loop thread (a
+#: thread hop costs more than the hash there); bigger ones go off-loop
+_INLINE_DIGEST_BYTES = 1 << 16
+
+
+async def _digest_payload(arr: np.ndarray) -> str:
+    if arr.nbytes <= _INLINE_DIGEST_BYTES:
+        return _array_digest(arr)
+    return await asyncio.to_thread(_array_digest, arr)
+
+
+def _freeze(v: Any) -> Any:
+    """Hashable identity of request kwargs for the program key (same
+    spirit as ``mapreduce._agg_cache_key``'s ``h``)."""
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, np.ndarray):
+        return ("__ndarray__", _array_digest(v))
+    if isinstance(v, float) and np.isnan(v):
+        return "__nan__"
+    if isinstance(v, np.generic):
+        return repr(v)
+    if callable(v):
+        return (getattr(v, "__qualname__", repr(v)), id(v))
+    return v
+
+
+def _program_key(
+    func: Any, arr: np.ndarray, by_digest: str, agg_kwargs: dict, overrides: dict
+) -> tuple:
+    """Semantic compiled-program identity of a request.
+
+    The same contract as the ``_PROGRAM_CACHE`` / ``_STEP_CACHE`` /
+    ``_jitted_bundle`` keys: aggregation identity + static shapes/dtypes +
+    codes identity + ``trace_fingerprint()`` (must be evaluated under the
+    request's option scope — a request that pins ``segment_sum_impl`` lowers
+    a different program). Two requests with equal keys lower to the same
+    compiled program, which is what makes sharing a dispatch safe.
+    """
+    from ..options import trace_fingerprint
+
+    return (
+        "reduce",
+        func if isinstance(func, str) else ("__agg__", id(func)),
+        arr.shape,
+        str(arr.dtype),
+        by_digest,
+        _freeze(agg_kwargs),
+        _freeze(overrides),
+        trace_fingerprint(),
+    )
+
+
+class Dispatcher:
+    """The serving front-end: ``await dispatcher.submit(request)``.
+
+    Constructor knobs override the ``OPTIONS`` defaults per instance
+    (``None`` reads the option — scope-aware — at each submit). All state
+    mutation happens on the event-loop thread; executions run in worker
+    threads via ``asyncio.to_thread`` (which propagates contextvars, so the
+    request's option scope and telemetry span context follow the work).
+    """
+
+    def __init__(
+        self,
+        *,
+        queue_depth: int | None = None,
+        deadline: float | None = None,
+        microbatch_max: int | None = None,
+        microbatch_max_elems: int | None = None,
+        batch_window: float | None = None,
+    ) -> None:
+        self.queue_depth = queue_depth
+        self.deadline = deadline
+        self.microbatch_max = microbatch_max
+        self.microbatch_max_elems = microbatch_max_elems
+        self.batch_window = batch_window
+        self._tasks: set[asyncio.Task] = set()
+
+    def _knob(self, explicit: Any, name: str) -> Any:
+        return explicit if explicit is not None else options.OPTIONS[name]
+
+    async def submit(
+        self, request: AggregationRequest | None = None, **kwargs: Any
+    ) -> ServeResult:
+        """Admit, (maybe) coalesce/batch, execute, and return one request.
+
+        Accepts a prebuilt :class:`AggregationRequest` or its fields as
+        keyword arguments. Raises :class:`LoadShedError` at saturation and
+        :class:`DeadlineExceededError` past the deadline; any execution
+        error propagates to every waiter of the failed dispatch.
+        """
+        if request is None:
+            request = AggregationRequest(**kwargs)
+        t0 = time.perf_counter()
+        METRICS.inc("serve.requests")
+        depth = self._knob(self.queue_depth, "serve_queue_depth")
+        if depth and len(_PENDING_REGISTRY) >= depth:
+            METRICS.inc("serve.shed")
+            raise LoadShedError(
+                f"dispatcher saturated: {len(_PENDING_REGISTRY)} requests pending "
+                f"(serve_queue_depth={depth}); retry with backoff"
+            )
+        rid = next(_IDS)
+        _PENDING_REGISTRY[rid] = request
+        try:
+            return await self._submit_admitted(request, t0)
+        finally:
+            _PENDING_REGISTRY.pop(rid, None)
+
+    async def _submit_admitted(
+        self, request: AggregationRequest, t0: float
+    ) -> ServeResult:
+        arr = np.asarray(request.array)
+        by = np.asarray(request.by)
+        # fold the submitter's AMBIENT scoped() overlay under the request's
+        # own options (request wins): ambient knobs like default_engine
+        # change results without appearing in trace_fingerprint(), so they
+        # must be part of the program key AND of the execution overlay — a
+        # scoped submit never shares a dispatch with differently-scoped
+        # peers, and execution no longer depends on whichever task's
+        # context the batch task happened to inherit
+        overrides = {**options.scope_overrides(), **(request.options or {})}
+        agg_kwargs = {
+            "expected_groups": request.expected_groups,
+            "fill_value": request.fill_value,
+            "dtype": request.dtype,
+            "min_count": request.min_count,
+            "engine": request.engine,
+            "finalize_kwargs": request.finalize_kwargs,
+        }
+        # large payloads hash in a worker thread — a multi-hundred-MB
+        # blake2b on the event-loop thread would stall every other
+        # request's admission, window timer, and deadline check
+        by_digest = await _digest_payload(by)
+        arr_digest = await _digest_payload(arr)
+        # the fingerprint half of the key must see the request's pinned
+        # knobs — evaluate under its scope (validates the overlay too, so a
+        # bad option name/value fails HERE, not inside a worker thread)
+        with options.scoped(**overrides):
+            pkey = _program_key(request.func, arr, by_digest, agg_kwargs, overrides)
+        payload_key = (pkey, arr_digest)
+        deadline = request.deadline
+        if deadline is None:
+            deadline = self._knob(self.deadline, "serve_deadline")
+        deadline = float(deadline) if deadline else None
+
+        leaf = _COALESCE_CACHE.get(payload_key)
+        coalesced = leaf is not None
+        if coalesced:
+            METRICS.inc("serve.coalesced")
+            leaf.waiters += 1
+        else:
+            leaf = _Leaf(arr, payload_key)
+            _COALESCE_CACHE[payload_key] = leaf
+            self._enqueue(leaf, request, arr, by, agg_kwargs, overrides, pkey)
+
+        try:
+            # shield: one waiter's timeout must not cancel the shared leaf
+            if deadline is None:
+                row, groups = await asyncio.shield(leaf.future)
+            else:
+                remaining = deadline - (time.perf_counter() - t0)
+                if remaining <= 0:
+                    raise asyncio.TimeoutError
+                row, groups = await asyncio.wait_for(
+                    asyncio.shield(leaf.future), remaining
+                )
+        except (asyncio.TimeoutError, TimeoutError):
+            # drop this waiter; a leaf with no waiters left is abandoned at
+            # dispatch time (never dispatched), so expired requests cannot
+            # poison the queue
+            leaf.waiters -= 1
+            METRICS.inc("serve.deadline_exceeded")
+            raise DeadlineExceededError(
+                f"deadline of {deadline:.4f}s exceeded "
+                f"({'dispatched' if leaf.t_dispatch else 'still queued'})"
+            ) from None
+        t1 = time.perf_counter()
+        # clamped: a request that attached to an ALREADY-dispatched leaf
+        # waited 0, not a negative interval (t_dispatch predates its t0)
+        queue_ms = max(0.0, ((leaf.t_dispatch or t1) - t0) * 1e3)
+        METRICS.observe("serve.request_ms", (t1 - t0) * 1e3)
+        METRICS.observe("serve.queue_ms", queue_ms)
+        telemetry.record_span(
+            "serve.request", t0, t1,
+            attrs={
+                "func": request.func if isinstance(request.func, str) else "custom",
+                "coalesced": coalesced, "batch": leaf.batch_size,
+            },
+        )
+        return ServeResult(
+            result=row,
+            groups=groups,
+            request_id=request.request_id,
+            coalesced=coalesced,
+            batch_size=leaf.batch_size,
+            queue_ms=queue_ms,
+            device_ms=leaf.device_ms,
+        )
+
+    # -- batching -----------------------------------------------------------
+
+    def _batchable(self, request: AggregationRequest, arr: np.ndarray) -> bool:
+        if not isinstance(request.func, str) or request.func in _UNBATCHABLE:
+            return False
+        if request.finalize_kwargs:
+            return False
+        if self._knob(self.microbatch_max, "serve_microbatch_max") <= 1:
+            return False
+        ceil = self._knob(self.microbatch_max_elems, "serve_microbatch_max_elems")
+        return not (ceil and arr.size > ceil)
+
+    def _enqueue(
+        self,
+        leaf: _Leaf,
+        request: AggregationRequest,
+        arr: np.ndarray,
+        by: np.ndarray,
+        agg_kwargs: dict,
+        overrides: dict,
+        pkey: tuple,
+    ) -> None:
+        batchable = self._batchable(request, arr)
+        if batchable:
+            batch = _BATCH_REGISTRY.get(pkey)
+            if (
+                batch is not None
+                and batch.open
+                and len(batch.leaves)
+                < self._knob(self.microbatch_max, "serve_microbatch_max")
+            ):
+                batch.leaves.append(leaf)
+                METRICS.inc("serve.microbatched")
+                return
+        batch = _Batch(pkey, request.func, by, agg_kwargs, overrides)
+        batch.leaves.append(leaf)
+        if batchable:
+            _BATCH_REGISTRY[pkey] = batch
+        window = self._knob(self.batch_window, "serve_batch_window")
+        task = asyncio.create_task(self._run_batch(batch, float(window)))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _run_batch(self, batch: _Batch, window: float) -> None:
+        # even window=0 yields the loop once, so same-tick submits coalesce
+        await asyncio.sleep(window)
+        batch.open = False
+        if _BATCH_REGISTRY.get(batch.pkey) is batch:
+            _BATCH_REGISTRY.pop(batch.pkey, None)
+        live = [leaf for leaf in batch.leaves if leaf.waiters > 0]
+        t_dispatch = time.perf_counter()
+        for leaf in batch.leaves:
+            if leaf.waiters > 0:
+                leaf.t_dispatch = t_dispatch
+                leaf.batch_size = len(live)
+            else:
+                # every waiter's deadline expired while queued: abandon the
+                # leaf (its future stays unset — nobody is listening)
+                _COALESCE_CACHE.pop(leaf.payload_key, None)
+        if not live:
+            METRICS.inc("serve.batches_abandoned")
+            return
+        try:
+            results = await asyncio.to_thread(self._execute, batch, live)
+        except BaseException as exc:  # noqa: BLE001 — fan the failure out
+            METRICS.inc("serve.errors")
+            for leaf in live:
+                if not leaf.future.done():
+                    leaf.future.set_exception(exc)
+                    # mark retrieved: if every waiter timed out meanwhile,
+                    # an unretrieved exception would warn at GC
+                    leaf.future.exception()
+            return
+        finally:
+            for leaf in live:
+                _COALESCE_CACHE.pop(leaf.payload_key, None)
+        rows, groups = results
+        for leaf, row in zip(live, rows):
+            if not leaf.future.done():
+                leaf.future.set_result((row, groups))
+
+    def _execute(self, batch: _Batch, live: list[_Leaf]) -> tuple[list, np.ndarray]:
+        """One device dispatch for every live leaf of ``batch`` (worker
+        thread; contextvars — option scope, span context — propagated by
+        ``asyncio.to_thread``)."""
+        from . import aot
+
+        # point jax's persistent cache at the AOT dir BEFORE the compile
+        # this dispatch may trigger, so the executable is written through
+        # (or retrieved) — idempotent no-op when serve_aot_dir is unset
+        aot.configure()
+        METRICS.inc("serve.dispatches")
+        t0 = time.perf_counter()
+        from ..core import groupby_reduce
+
+        kwargs = {k: v for k, v in batch.agg_kwargs.items() if v is not None}
+        with options.scoped(**batch.overrides):
+            with telemetry.span(
+                "serve.execute",
+                func=batch.func if isinstance(batch.func, str) else "custom",
+                batch=len(live),
+            ):
+                if len(live) == 1:
+                    result, groups = groupby_reduce(
+                        live[0].array, batch.by, func=batch.func, **kwargs
+                    )
+                    rows = [np.asarray(result)]
+                    dispatched = live[0].array
+                else:
+                    dispatched = np.stack([leaf.array for leaf in live])
+                    result, groups = groupby_reduce(
+                        dispatched, batch.by, func=batch.func, **kwargs
+                    )
+                    result = np.asarray(result)
+                    rows = [result[i] for i in range(len(live))]
+        groups = np.asarray(groups)
+        device_ms = (time.perf_counter() - t0) * 1e3
+        METRICS.observe("serve.device_ms", device_ms)
+        for leaf in live:
+            leaf.device_ms = device_ms
+        aot.record_reduce(
+            func=batch.func,
+            shape=tuple(np.shape(dispatched)),
+            dtype=str(np.asarray(dispatched).dtype),
+            by_shape=tuple(batch.by.shape),
+            by_dtype=str(batch.by.dtype),
+            ngroups=int(groups.shape[0]) if groups.ndim else 1,
+            agg_kwargs=kwargs,
+            options=batch.overrides,
+        )
+        return rows, groups
+
+    async def close(self) -> None:
+        """Wait for every in-flight batch task to finish (results/errors are
+        delivered to their waiters as usual)."""
+        while self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)

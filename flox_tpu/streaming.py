@@ -38,7 +38,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-from . import factorize as fct, utils
+from . import cache, factorize as fct, utils
 from .aggregations import Aggregation, _initialize_aggregation
 from .multiarray import MultiArray
 
@@ -57,7 +57,11 @@ _DEFAULT_BATCH_BYTES = 256 * 2**20
 # streaming_groupby_* invocation, so repeat same-shaped calls
 # (per-variable pipelines) would pay full retrace. Keys carry the
 # semantic identity plus trace_fingerprint() (appended by _step_cached).
-_STEP_CACHE: dict = {}
+# LRU-bounded: a cold key past capacity evicts the single stalest step
+# (counted in cache.stats()["evictions"]), never the whole hot set — the
+# old wholesale clear-at-256 dropped every hot program under sustained
+# mixed-key traffic, exactly the serving workload's shape.
+_STEP_CACHE: cache.LRUCache = cache.LRUCache(maxsize=256)
 
 
 def _mesh_stream_layout(mesh, axis_name, batch_len: int, lead_ndim: int):
@@ -90,8 +94,7 @@ def _step_cached(key, build):
     if fn is None:
         telemetry.count("cache.step_misses")
         fn = build()
-        if len(_STEP_CACHE) > 256:
-            _STEP_CACHE.clear()
+        # bounded LRU insert: past capacity this evicts ONE stale step
         _STEP_CACHE[key] = fn
     else:
         telemetry.count("cache.step_hits")

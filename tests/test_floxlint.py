@@ -198,6 +198,71 @@ def test_unregistered_autotune_store_fails_flx008(tmp_path):
     assert not [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
 
 
+def test_unregistered_serve_container_fails_flx008(tmp_path):
+    # ISSUE 7 satellite: every serve-layer container (request queue,
+    # coalescing table, AOT manifest memo) must be registered in
+    # cache.clear_all — this proves reintroducing an UNREGISTERED one (in a
+    # subpackage, like the real flox_tpu/serve/) is flagged statically.
+    pkg = tmp_path / "minipkg"
+    (pkg / "serve").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "serve" / "__init__.py").write_text("")
+    (pkg / "serve" / "dispatcher.py").write_text(
+        '"""Mini dispatcher with serve-layer tables."""\n\n'
+        "_PENDING_REGISTRY: dict = {}\n"
+        "_COALESCE_CACHE: dict = {}\n\n\n"
+        "def admit(rid, request):\n"
+        "    _PENDING_REGISTRY[rid] = request\n\n\n"
+        "def coalesce(key, leaf):\n"
+        "    return _COALESCE_CACHE.setdefault(key, leaf)\n"
+    )
+    (pkg / "cache.py").write_text(
+        '"""clear_all that forgets the coalescing table."""\n\n\n'
+        "def clear_all():\n"
+        "    from .serve.dispatcher import _PENDING_REGISTRY\n\n"
+        "    _PENDING_REGISTRY.clear()\n"
+    )
+    findings = [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+    assert len(findings) == 1
+    assert "_COALESCE_CACHE" in findings[0].message
+    assert findings[0].path.endswith("dispatcher.py")
+    # registering it too makes the package clean again
+    (pkg / "cache.py").write_text(
+        '"""clear_all that registers every serve table."""\n\n\n'
+        "def clear_all():\n"
+        "    from .serve.dispatcher import _COALESCE_CACHE, _PENDING_REGISTRY\n\n"
+        "    _PENDING_REGISTRY.clear()\n"
+        "    _COALESCE_CACHE.clear()\n"
+    )
+    assert not [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+
+
+def test_lru_bound_cache_is_flx008_candidate(tmp_path):
+    # the compiled-program caches are LRUCache instances now (ISSUE 7
+    # eviction fix) — swapping dict for LRUCache must not take a cache off
+    # FLX008's radar
+    pkg = tmp_path / "minipkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "programs.py").write_text(
+        '"""LRU-bound program cache, unregistered."""\n\n'
+        "from .lru import LRUCache\n\n"
+        "_PROGRAM_CACHE = LRUCache(maxsize=256)\n\n\n"
+        "def remember(key, fn):\n"
+        "    _PROGRAM_CACHE[key] = fn\n"
+    )
+    (pkg / "lru.py").write_text(
+        '"""Stand-in LRU container."""\n\n\n'
+        "class LRUCache(dict):\n"
+        "    def __init__(self, maxsize=256):\n"
+        "        super().__init__()\n"
+    )
+    (pkg / "cache.py").write_text('"""Empty clear_all."""\n\n\ndef clear_all():\n    pass\n')
+    findings = [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+    assert len(findings) == 1
+    assert "_PROGRAM_CACHE" in findings[0].message
+
+
 def test_real_autotune_store_is_registered():
     # the static complement: the REAL store must be reachable from the real
     # clear_all (covered by test_flox_tpu_package_is_clean too; this

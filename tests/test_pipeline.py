@@ -264,10 +264,12 @@ def test_clear_all_empties_every_named_cache():
                 named.append((mod, alias.asname or alias.name))
     assert len(named) >= 7, "clear_all no longer names the known caches?"
 
+    from flox_tpu.cache import LRUCache
+
     # populate what can be populated artificially, then clear
     for mod, name in named:
         obj = getattr(mod, name)
-        if isinstance(obj, dict):
+        if isinstance(obj, (dict, LRUCache)):
             obj[("__clear_all_probe__", name)] = object()
         elif isinstance(obj, list):
             for i in range(len(obj)):
@@ -283,6 +285,9 @@ def test_clear_all_empties_every_named_cache():
         obj = getattr(mod, name)
         if isinstance(obj, dict):
             assert obj == {}, f"{mod.__name__}.{name} not emptied by clear_all"
+            checked += 1
+        elif isinstance(obj, LRUCache):  # the compiled-program LRUs (ISSUE 7)
+            assert len(obj) == 0, f"{mod.__name__}.{name} not emptied by clear_all"
             checked += 1
         elif isinstance(obj, list):
             assert all(v == 0 for v in obj), f"{mod.__name__}.{name} not reset"

@@ -1,0 +1,718 @@
+"""Serving-layer test suite (ISSUE 7).
+
+The contracts under test:
+
+* **coalescing** — K concurrent identical-key requests trigger exactly ONE
+  device dispatch (``serve.dispatches`` counter) and all K receive correct
+  results;
+* **micro-batching** — program-compatible different-payload requests stack
+  into one dispatch whose per-row results are bit-identical to solo runs;
+* **concurrency correctness** — N concurrent requests with mixed option
+  scopes produce bit-identical results to the same requests run
+  sequentially;
+* **admission control** — submits beyond ``serve_queue_depth`` are
+  load-shed without queueing; deadline-expired requests are cancelled
+  without poisoning the queue (an all-expired batch is never dispatched);
+* **option scoping** — ``options.scoped`` overlays are per-context
+  (asyncio tasks and threads isolated), nest innermost-wins, leave the
+  process-global OPTIONS untouched, and carry ``explicitly_set``
+  provenance;
+* **LRU program caches** — ``_PROGRAM_CACHE`` / ``_STEP_CACHE`` evict one
+  stale entry past capacity (never the whole hot set) with the eviction
+  count visible in ``cache.stats()``;
+* **AOT persistence** — ``record_reduce`` -> manifest -> ``warmup``
+  round-trips, and a restarted process pointed at a warm dir serves its
+  first request with ``jax.compiles == 0`` (the two-process smoke, via
+  the ``python -m flox_tpu.serve`` JSON-lines protocol).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import flox_tpu
+from flox_tpu import cache, serve
+from flox_tpu.cache import LRUCache
+from flox_tpu.core import groupby_reduce
+from flox_tpu.options import OPTIONS, explicitly_set, scoped, set_options
+from flox_tpu.serve import (
+    AggregationRequest,
+    DeadlineExceededError,
+    Dispatcher,
+    LoadShedError,
+    aot,
+)
+from flox_tpu.telemetry import METRICS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Serving state and counters reset per test; AOT persistence off so
+    dispatch tests never touch disk (the AOT tests opt in per-test), and
+    the autotuner pinned off so a mid-test decision flip cannot break the
+    sequential-vs-concurrent bit-identity assertions under the CI
+    FLOX_TPU_AUTOTUNE=1 leg."""
+    with flox_tpu.set_options(serve_aot_dir=None, autotune=False):
+        cache.clear_all()
+        yield
+        cache.clear_all()
+        # jax's cache dir is process-global: detach it so tests after the
+        # AOT ones don't keep writing executables into a dead tmp dir
+        aot.deconfigure()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _payload(n=64, ngroups=5, seed=0, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    values = rng.normal(size=n).astype(dtype)
+    labels = rng.integers(0, ngroups, size=n)
+    return values, labels
+
+
+class TestCoalescing:
+    def test_identical_requests_one_dispatch_all_correct(self):
+        """Acceptance: K concurrent identical-key requests -> exactly one
+        device dispatch, K correct results."""
+        values, labels = _payload()
+        expect, egroups = groupby_reduce(values, labels, func="sum")
+        K = 8
+
+        async def main():
+            d = Dispatcher()
+            before = METRICS.get("serve.dispatches")
+            results = await asyncio.gather(
+                *[d.submit(func="sum", array=values, by=labels) for _ in range(K)]
+            )
+            await d.close()
+            return results, METRICS.get("serve.dispatches") - before
+
+        results, dispatches = run(main())
+        assert dispatches == 1
+        for r in results:
+            np.testing.assert_array_equal(r.result, np.asarray(expect))
+            np.testing.assert_array_equal(r.groups, np.asarray(egroups))
+        # first arrival created the leaf; the other K-1 attached to it
+        assert sorted(r.coalesced for r in results) == [False] + [True] * (K - 1)
+        assert METRICS.get("serve.coalesced") == K - 1
+        # a waiter attaching to an in-flight leaf waited 0, never negative
+        assert all(r.queue_ms >= 0 for r in results)
+
+    def test_different_payloads_do_not_coalesce(self):
+        values, labels = _payload()
+
+        async def main():
+            d = Dispatcher(microbatch_max=1)  # isolate coalescing from batching
+            before = METRICS.get("serve.dispatches")
+            await asyncio.gather(
+                d.submit(func="sum", array=values, by=labels),
+                d.submit(func="sum", array=values + 1.0, by=labels),
+            )
+            await d.close()
+            return METRICS.get("serve.dispatches") - before
+
+        assert run(main()) == 2
+
+    def test_different_option_scopes_do_not_coalesce(self):
+        """A pinned knob changes the compiled program: requests only share
+        a dispatch when their execution-relevant options agree."""
+        values, labels = _payload()
+
+        async def main():
+            d = Dispatcher(microbatch_max=1)
+            before = METRICS.get("serve.dispatches")
+            results = await asyncio.gather(
+                d.submit(func="sum", array=values, by=labels),
+                d.submit(
+                    func="sum", array=values, by=labels,
+                    options={"default_engine": "numpy"},
+                ),
+            )
+            await d.close()
+            return results, METRICS.get("serve.dispatches") - before
+
+        results, dispatches = run(main())
+        assert dispatches == 2
+        np.testing.assert_allclose(results[0].result, results[1].result)
+
+    def test_ambient_scope_is_part_of_the_program_key(self):
+        """A submit made under an ambient options.scoped() must not share
+        a dispatch with an unscoped identical request: ambient knobs like
+        default_engine change results without appearing in the request's
+        own overlay."""
+        values, labels = _payload()
+
+        async def main():
+            d = Dispatcher(microbatch_max=1)
+            before = METRICS.get("serve.dispatches")
+
+            async def scoped_submit():
+                with scoped(default_engine="numpy"):
+                    return await d.submit(func="sum", array=values, by=labels)
+
+            results = await asyncio.gather(
+                scoped_submit(), d.submit(func="sum", array=values, by=labels)
+            )
+            await d.close()
+            return results, METRICS.get("serve.dispatches") - before
+
+        results, dispatches = run(main())
+        assert dispatches == 2
+        np.testing.assert_allclose(results[0].result, results[1].result)
+
+    def test_execution_error_fans_out_to_every_waiter(self):
+        values, labels = _payload()
+
+        async def main():
+            d = Dispatcher()
+            results = await asyncio.gather(
+                *[
+                    d.submit(func="definitely-not-a-reduction", array=values, by=labels)
+                    for _ in range(3)
+                ],
+                return_exceptions=True,
+            )
+            await d.close()
+            return results
+
+        results = run(main())
+        assert len(results) == 3
+        assert all(isinstance(r, Exception) for r in results)
+        assert METRICS.get("serve.errors") == 1  # one failed dispatch, 3 waiters
+
+
+class TestMicroBatching:
+    def test_batched_rows_bit_identical_to_solo(self):
+        values, labels = _payload()
+        payloads = [values + i for i in range(4)]
+        solo = [np.asarray(groupby_reduce(p, labels, func="sum")[0]) for p in payloads]
+
+        async def main():
+            d = Dispatcher(batch_window=0.05)
+            before = METRICS.get("serve.dispatches")
+            results = await asyncio.gather(
+                *[d.submit(func="sum", array=p, by=labels) for p in payloads]
+            )
+            await d.close()
+            return results, METRICS.get("serve.dispatches") - before
+
+        results, dispatches = run(main())
+        assert dispatches == 1
+        assert [r.batch_size for r in results] == [4, 4, 4, 4]
+        for r, expect in zip(results, solo):
+            np.testing.assert_array_equal(r.result, expect)
+
+    def test_batch_respects_microbatch_max(self):
+        values, labels = _payload()
+
+        async def main():
+            d = Dispatcher(microbatch_max=2, batch_window=0.05)
+            before = METRICS.get("serve.dispatches")
+            await asyncio.gather(
+                *[d.submit(func="sum", array=values + i, by=labels) for i in range(4)]
+            )
+            await d.close()
+            return METRICS.get("serve.dispatches") - before
+
+        assert run(main()) == 2
+
+    def test_oversized_and_unbatchable_dispatch_alone(self):
+        values, labels = _payload()
+
+        async def main():
+            d = Dispatcher(batch_window=0.05)
+            with set_options(serve_microbatch_max_elems=8):
+                big = await asyncio.gather(
+                    d.submit(func="sum", array=values, by=labels),
+                    d.submit(func="sum", array=values + 1, by=labels),
+                )
+            quant = await asyncio.gather(
+                d.submit(func="quantile", array=values, by=labels,
+                         finalize_kwargs={"q": 0.5}),
+                d.submit(func="quantile", array=values + 1, by=labels,
+                         finalize_kwargs={"q": 0.5}),
+            )
+            await d.close()
+            return big, quant
+
+        big, quant = run(main())
+        assert [r.batch_size for r in big] == [1, 1]
+        assert [r.batch_size for r in quant] == [1, 1]
+        expect = np.asarray(
+            groupby_reduce(values, labels, func="quantile", finalize_kwargs={"q": 0.5})[0]
+        )
+        np.testing.assert_array_equal(quant[0].result, expect)
+
+
+class TestConcurrencyCorrectness:
+    def test_mixed_scopes_concurrent_equals_sequential(self):
+        """N concurrent requests with mixed option scopes == the same
+        requests run sequentially, bit for bit."""
+        requests = []
+        for i in range(12):
+            values, labels = _payload(seed=i, ngroups=3 + i % 4)
+            requests.append(
+                AggregationRequest(
+                    func=["sum", "nanmean", "max", "prod"][i % 4],
+                    array=values,
+                    by=labels,
+                    options=(
+                        {} if i % 3 == 0
+                        else {"default_engine": ["numpy", "jax"][i % 2]}
+                    ),
+                )
+            )
+
+        sequential = []
+        for req in requests:
+            with scoped(**req.options):
+                result, groups = groupby_reduce(req.array, req.by, func=req.func)
+            sequential.append((np.asarray(result), np.asarray(groups)))
+
+        async def main():
+            d = Dispatcher()
+            out = await asyncio.gather(*[d.submit(req) for req in requests])
+            await d.close()
+            return out
+
+        for served, (expect, egroups) in zip(run(main()), sequential):
+            np.testing.assert_array_equal(served.result, expect)
+            np.testing.assert_array_equal(served.groups, egroups)
+
+    def test_pending_registry_empties_after_serving(self):
+        values, labels = _payload()
+
+        async def main():
+            d = Dispatcher()
+            await asyncio.gather(
+                *[d.submit(func="sum", array=values + i, by=labels) for i in range(4)]
+            )
+            await d.close()
+
+        run(main())
+        stats = cache.stats()
+        assert stats["serve_pending"] == 0
+        assert stats["serve_coalesce"] == 0
+        assert stats["serve_batches"] == 0
+
+
+class TestAdmissionControl:
+    def test_load_shed_beyond_queue_depth(self):
+        values, labels = _payload()
+
+        async def main():
+            d = Dispatcher(queue_depth=2, batch_window=0.05)
+            results = await asyncio.gather(
+                *[d.submit(func="sum", array=values + i, by=labels) for i in range(5)],
+                return_exceptions=True,
+            )
+            await d.close()
+            return results
+
+        results = run(main())
+        shed = [r for r in results if isinstance(r, LoadShedError)]
+        served = [r for r in results if not isinstance(r, Exception)]
+        assert len(shed) == 3 and len(served) == 2
+        assert METRICS.get("serve.shed") == 3
+
+    def test_queue_depth_zero_disables_admission_control(self):
+        values, labels = _payload()
+
+        async def main():
+            d = Dispatcher(queue_depth=0)
+            results = await asyncio.gather(
+                *[d.submit(func="sum", array=values + i, by=labels) for i in range(8)]
+            )
+            await d.close()
+            return results
+
+        assert len(run(main())) == 8
+
+    def test_expired_request_cancelled_without_poisoning_queue(self):
+        """A deadline that expires while queued raises DeadlineExceededError
+        for that waiter; an all-expired batch is abandoned (never
+        dispatched); subsequent requests on the same dispatcher serve
+        normally."""
+        values, labels = _payload()
+        expect = np.asarray(groupby_reduce(values, labels, func="sum")[0])
+
+        async def main():
+            d = Dispatcher(batch_window=0.2)
+            before = METRICS.get("serve.dispatches")
+            with pytest.raises(DeadlineExceededError):
+                await d.submit(func="sum", array=values, by=labels, deadline=0.01)
+            await d.close()  # the abandoned batch's window elapses
+            abandoned_dispatches = METRICS.get("serve.dispatches") - before
+            after = await d.submit(func="sum", array=values, by=labels)
+            await d.close()
+            return abandoned_dispatches, after
+
+        abandoned_dispatches, after = run(main())
+        assert abandoned_dispatches == 0
+        assert METRICS.get("serve.batches_abandoned") == 1
+        assert METRICS.get("serve.deadline_exceeded") == 1
+        np.testing.assert_array_equal(after.result, expect)
+        assert cache.stats()["serve_pending"] == 0
+
+    def test_one_expired_waiter_does_not_cancel_peers(self):
+        """A coalesced waiter timing out must not cancel the shared leaf:
+        the surviving waiter still gets its result."""
+        values, labels = _payload()
+        expect = np.asarray(groupby_reduce(values, labels, func="sum")[0])
+
+        async def main():
+            d = Dispatcher(batch_window=0.15)
+            patient = asyncio.create_task(
+                d.submit(func="sum", array=values, by=labels)
+            )
+            await asyncio.sleep(0)  # let the leaf enqueue
+            with pytest.raises(DeadlineExceededError):
+                await d.submit(func="sum", array=values, by=labels, deadline=0.01)
+            result = await patient
+            await d.close()
+            return result
+
+        result = run(main())
+        np.testing.assert_array_equal(result.result, expect)
+
+
+class TestScopedOptions:
+    def test_overlay_reads_and_restores(self):
+        base = OPTIONS["default_engine"]
+        with scoped(default_engine="numpy"):
+            assert OPTIONS["default_engine"] == "numpy"
+            assert OPTIONS.get("default_engine") == "numpy"
+        assert OPTIONS["default_engine"] == base
+
+    def test_nested_scopes_innermost_wins(self):
+        with scoped(default_engine="numpy", telemetry=True):
+            with scoped(default_engine="jax"):
+                assert OPTIONS["default_engine"] == "jax"
+                assert OPTIONS["telemetry"] is True  # outer overlay visible
+            assert OPTIONS["default_engine"] == "numpy"
+
+    def test_validation_at_entry(self):
+        with pytest.raises(ValueError):
+            scoped(default_engine="fortran")
+        with pytest.raises(ValueError):
+            scoped(not_an_option=1)
+
+    def test_explicitly_set_respects_scope(self):
+        if "FLOX_TPU_STREAM_PREFETCH" in os.environ:
+            pytest.skip("depth pinned by the environment")
+        assert not explicitly_set("stream_prefetch")
+        with scoped(stream_prefetch=3):
+            assert explicitly_set("stream_prefetch")
+        assert not explicitly_set("stream_prefetch")
+
+    def test_set_options_inside_scope_restores_global_base(self):
+        """set_options under an active scope snapshots the GLOBAL value:
+        the overlay must never leak into the process dict on exit."""
+        base = OPTIONS["stream_prefetch"]
+        with scoped(stream_prefetch=7):
+            with set_options(stream_prefetch=5):
+                # scope overlay still wins reads inside the scope
+                assert OPTIONS["stream_prefetch"] == 7
+            assert dict.__getitem__(OPTIONS, "stream_prefetch") == base
+        assert OPTIONS["stream_prefetch"] == base
+
+    def test_threads_start_unscoped(self):
+        seen = {}
+
+        def worker():
+            seen["engine"] = OPTIONS["default_engine"]
+
+        with scoped(default_engine="numpy"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen["engine"] == dict.__getitem__(OPTIONS, "default_engine")
+
+    def test_asyncio_tasks_inherit_creating_scope(self):
+        async def probe():
+            return OPTIONS["default_engine"]
+
+        async def main():
+            with scoped(default_engine="numpy"):
+                inside = asyncio.create_task(probe())
+            outside = asyncio.create_task(probe())
+            return await inside, await outside
+
+        inside, outside = run(main())
+        assert inside == "numpy"
+        assert outside == dict.__getitem__(OPTIONS, "default_engine")
+
+    def test_concurrent_scopes_isolated(self):
+        async def hold(engine, barrier):
+            with scoped(default_engine=engine):
+                await barrier.wait()
+                return OPTIONS["default_engine"]
+
+        async def main():
+            barrier = asyncio.Event()
+            tasks = [
+                asyncio.create_task(hold("numpy", barrier)),
+                asyncio.create_task(hold("jax", barrier)),
+            ]
+            await asyncio.sleep(0)
+            barrier.set()
+            return await asyncio.gather(*tasks)
+
+        assert run(main()) == ["numpy", "jax"]
+
+
+class TestLRUProgramCaches:
+    def test_lru_evicts_one_stale_entry(self):
+        lru = LRUCache(maxsize=3)
+        for i in range(3):
+            lru[i] = f"p{i}"
+        assert lru.get(0) == "p0"  # renew 0: now 1 is the stalest
+        lru[3] = "p3"
+        assert lru.evictions == 1
+        assert 1 not in lru
+        assert set(lru.keys()) == {0, 2, 3}
+        assert len(lru) == 3
+
+    def test_lru_mapping_surface(self):
+        lru = LRUCache(maxsize=4)
+        lru["a"] = 1
+        assert lru["a"] == 1 and "a" in lru
+        assert lru.get("missing", 7) == 7
+        assert lru.items() == [("a", 1)] and lru.values() == [1]
+        assert lru.pop("a") == 1 and lru.pop("a", None) is None
+        with pytest.raises(KeyError):
+            lru["gone"]
+        with pytest.raises(ValueError):
+            LRUCache(maxsize=0)
+
+    def test_program_caches_are_lru_with_stats_counter(self):
+        from flox_tpu.parallel.mapreduce import _PROGRAM_CACHE
+        from flox_tpu.streaming import _STEP_CACHE
+
+        assert isinstance(_PROGRAM_CACHE, LRUCache)
+        assert isinstance(_STEP_CACHE, LRUCache)
+        stats = cache.stats()
+        assert stats["evictions"] == {"mesh_programs": 0, "stream_steps": 0}
+        # sustained mixed traffic past capacity: hot key survives because
+        # every get() renews it — the old clear() dropped it 4 times here
+        _STEP_CACHE["hot"] = "hot-program"
+        for i in range(_STEP_CACHE.maxsize + 4):
+            _STEP_CACHE[("cold", i)] = i
+            assert _STEP_CACHE.get("hot") == "hot-program"
+        assert _STEP_CACHE.evictions == 5
+        assert cache.stats()["evictions"]["stream_steps"] == 5
+
+    def test_clear_all_resets_serve_tables(self):
+        from flox_tpu.serve.aot import _MANIFEST_MEMO
+        from flox_tpu.serve.dispatcher import _COALESCE_CACHE, _PENDING_REGISTRY
+
+        _MANIFEST_MEMO["d"] = {"func": "sum"}
+        _PENDING_REGISTRY[99] = object()
+        _COALESCE_CACHE[("k",)] = object()
+        cache.clear_all()
+        assert not _MANIFEST_MEMO and not _PENDING_REGISTRY and not _COALESCE_CACHE
+        stats = cache.stats()
+        for key in ("serve_pending", "serve_coalesce", "serve_batches",
+                    "serve_aot_manifest"):
+            assert stats[key] == 0
+
+
+class TestAOT:
+    def test_record_reduce_roundtrips_through_manifest(self, tmp_path):
+        with set_options(serve_aot_dir=str(tmp_path)):
+            recorded = aot.record_reduce(
+                func="sum", shape=(8,), dtype="float64", by_shape=(8,),
+                by_dtype="int64", ngroups=2, agg_kwargs={"fill_value": None},
+                options={},
+            )
+            assert recorded
+            # duplicate spec: memoized, not re-recorded
+            assert not aot.record_reduce(
+                func="sum", shape=(8,), dtype="float64", by_shape=(8,),
+                by_dtype="int64", ngroups=2, agg_kwargs={"fill_value": None},
+                options={},
+            )
+            payload = json.loads((tmp_path / "manifest.json").read_text())
+            assert payload["version"] == 1 and len(payload["programs"]) == 1
+            cache.clear_all()  # fresh "process": empty memo
+            assert aot.warmup() == 1
+            assert cache.stats()["serve_aot_manifest"] == 1
+
+    def test_unreplayable_specs_are_skipped(self, tmp_path):
+        with set_options(serve_aot_dir=str(tmp_path)):
+            assert not aot.record_reduce(
+                func=lambda x: x, shape=(4,), dtype="float64", by_shape=(4,),
+                by_dtype="int64", ngroups=1, agg_kwargs={}, options={},
+            )
+            assert not aot.record_reduce(
+                func="sum", shape=(4,), dtype="float64", by_shape=(4,),
+                by_dtype="int64", ngroups=1,
+                agg_kwargs={"finalize_kwargs": {"fn": lambda x: x}}, options={},
+            )
+        # and with persistence off, recording is a no-op entirely
+        assert not aot.record_reduce(
+            func="sum", shape=(4,), dtype="float64", by_shape=(4,),
+            by_dtype="int64", ngroups=1, agg_kwargs={}, options={},
+        )
+
+    def test_corrupt_manifest_warns_and_serves(self, tmp_path, caplog):
+        (tmp_path / "manifest.json").write_text("{not json")
+        with set_options(serve_aot_dir=str(tmp_path)):
+            assert aot.warmup() == 0
+            assert any("unreadable AOT manifest" in r.message for r in caplog.records)
+            # a corrupt manifest must not block NEW recordings either
+            assert aot.record_reduce(
+                func="sum", shape=(4,), dtype="float64", by_shape=(4,),
+                by_dtype="int64", ngroups=1, agg_kwargs={}, options={},
+            )
+
+    def test_manifest_save_merges_across_processes(self, tmp_path):
+        """Two replicas sharing one AOT dir union their manifests: a save
+        from a process that never loaded must not clobber the other's."""
+        with set_options(serve_aot_dir=str(tmp_path)):
+            aot.record_reduce(
+                func="sum", shape=(8,), dtype="float64", by_shape=(8,),
+                by_dtype="int64", ngroups=2, agg_kwargs={}, options={},
+            )
+            cache.clear_all()  # fresh "process" with an empty memo
+            aot.record_reduce(
+                func="max", shape=(16,), dtype="float32", by_shape=(16,),
+                by_dtype="int64", ngroups=4, agg_kwargs={}, options={},
+            )
+            payload = json.loads((tmp_path / "manifest.json").read_text())
+            funcs = {spec["func"] for spec in payload["programs"].values()}
+            assert funcs == {"sum", "max"}
+
+    def test_dispatcher_records_served_programs(self, tmp_path):
+        values, labels = _payload()
+        with set_options(serve_aot_dir=str(tmp_path)):
+            async def main():
+                d = Dispatcher()
+                await d.submit(func="sum", array=values, by=labels)
+                await d.close()
+
+            run(main())
+            payload = json.loads((tmp_path / "manifest.json").read_text())
+            (spec,) = payload["programs"].values()
+            assert spec["func"] == "sum"
+            assert tuple(spec["shape"]) == values.shape
+            assert spec["ngroups"] == len(np.unique(labels))
+
+    @pytest.mark.slow
+    def test_two_process_smoke_warm_restart_zero_compiles(self, tmp_path):
+        """The acceptance criterion, via the JSON-lines protocol: process
+        A compiles and persists; process B restarts against the same dir,
+        warms up, and serves its first request with jax.compiles == 0."""
+        outs = _run_serve_cli(tmp_path)
+        assert outs["a"]["response"]["ok"], outs["a"]
+        assert outs["a"]["stats"]["counters"]["jax.compiles"] >= 1
+        assert outs["b"]["warmup"]["compiles"] == 0
+        assert outs["b"]["response"]["ok"], outs["b"]
+        assert outs["b"]["stats"]["counters"]["jax.compiles"] == 0
+        assert outs["b"]["response"]["result"] == outs["a"]["response"]["result"]
+
+
+def _run_serve_cli(tmp_path):
+    """Drive ``python -m flox_tpu.serve`` twice against one AOT dir."""
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", FLOX_TPU_TELEMETRY="1",
+    )
+    env.pop("FLOX_TPU_TELEMETRY_EXPORT_PATH", None)
+    lines = "\n".join(
+        [
+            json.dumps(
+                {
+                    "id": "r", "func": "sum",
+                    "array": [1.0, 2.0, 4.0, 8.0], "by": [0, 0, 1, 1],
+                }
+            ),
+            json.dumps({"op": "drain"}),
+            json.dumps({"op": "stats"}),
+        ]
+    )
+    outs = {}
+    for name, extra in (("a", []), ("b", ["--warmup"])):
+        proc = subprocess.run(
+            [sys.executable, "-m", "flox_tpu.serve",
+             "--aot-dir", str(tmp_path), *extra],
+            input=lines, cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=240,
+        )
+        assert proc.returncode == 0, proc.stderr
+        records = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+        out = {}
+        for rec in records:
+            if "warmed" in rec:
+                out["warmup"] = rec
+            elif rec.get("op") == "stats":
+                out["stats"] = rec
+            elif rec.get("id") == "r":
+                out["response"] = rec
+        outs[name] = out
+    return outs
+
+
+class TestProtocol:
+    def test_jsonl_loop_serves_and_reports_errors(self, tmp_path):
+        script = tmp_path / "requests.jsonl"
+        script.write_text(
+            "\n".join(
+                [
+                    json.dumps(
+                        {"id": "ok", "func": "sum",
+                         "array": [1.0, 2.0, 4.0], "by": [0, 1, 1]}
+                    ),
+                    json.dumps(
+                        {"id": "exec", "func": "no_such_agg",
+                         "array": [1.0, 2.0], "by": [0, 1]}
+                    ),
+                    "this is not json",
+                    json.dumps({"id": "bad", "func": "sum", "bogus_field": 1}),
+                    json.dumps({"op": "nonsense"}),
+                    json.dumps({"op": "drain"}),
+                    json.dumps({"op": "stats"}),
+                ]
+            )
+            + "\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # the CI telemetry leg exports to a shared jsonl: keep this
+        # subprocess out of it (two writers would interleave mid-line)
+        env.pop("FLOX_TPU_TELEMETRY", None)
+        env.pop("FLOX_TPU_TELEMETRY_EXPORT_PATH", None)
+        proc = subprocess.run(
+            [sys.executable, "-m", "flox_tpu.serve", "--input", str(script)],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=240,
+        )
+        assert proc.returncode == 0, proc.stderr
+        records = {
+            rec.get("id", rec.get("op")): rec
+            for rec in (json.loads(l) for l in proc.stdout.splitlines() if l.strip())
+        }
+        assert records["ok"]["ok"] and records["ok"]["result"] == [1.0, 6.0]
+        # a well-formed envelope whose EXECUTION fails reports the real
+        # exception class, never "protocol" (that would send clients
+        # debugging their JSON instead of their aggregation)
+        assert not records["exec"]["ok"]
+        assert records["exec"]["error"] != "protocol"
+        assert records["line-3"]["error"] == "protocol"  # malformed JSON
+        assert records["bad"]["error"] == "protocol"
+        assert "bogus_field" in records["bad"]["message"]
+        assert records["line-5"]["error"] == "protocol"  # unknown op
+        assert records["drain"]["ok"]
+        # the well-formed requests reached the dispatcher; the protocol
+        # failures were rejected before admission
+        assert records["stats"]["counters"]["serve.requests"] == 2
+        assert records["stats"]["cache"]["serve_pending"] == 0

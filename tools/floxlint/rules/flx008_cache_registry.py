@@ -35,7 +35,11 @@ if TYPE_CHECKING:  # pragma: no cover - typing only
 
 _NAME_TOKEN = re.compile(r"CACHE|MEMO|REGISTR|SNAPSHOT|PROBE")
 _CONTAINER_CALLS = frozenset(
-    {"dict", "list", "set", "defaultdict", "OrderedDict", "Counter", "deque"}
+    {"dict", "list", "set", "defaultdict", "OrderedDict", "Counter", "deque",
+     # the in-repo LRU wrapper around OrderedDict (flox_tpu.cache.LRUCache):
+     # the compiled-program caches are bound to it, and swapping a dict for
+     # an LRU must not take a cache off this rule's radar
+     "LRUCache"}
 )
 _MUTATING_METHODS = frozenset(
     {"append", "add", "update", "setdefault", "extend", "insert", "clear",
