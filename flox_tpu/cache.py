@@ -116,9 +116,15 @@ def stats() -> dict:
     from .serve.aot import _MANIFEST_MEMO
     from .serve.dispatcher import _BATCH_REGISTRY, _COALESCE_CACHE, _PENDING_REGISTRY
     from .streaming import _STEP_CACHE
+    from .telemetry import FLIGHT_RECORDER, hbm_by_program
 
     info = _jitted_bundle.cache_info()
     return {
+        # per-program-key peak HBM (telemetry.sample_hbm attribution): the
+        # operator's answer to "which compiled program is eating the chip"
+        # — read through the locked accessor, never the raw table
+        "hbm_by_program": hbm_by_program(),
+        "flight_recorder": len(FLIGHT_RECORDER),
         "cohorts": len(_COHORTS_CACHE),
         "factorize": len(_FACTORIZE_CACHE),
         "mesh_programs": len(_PROGRAM_CACHE),
@@ -172,7 +178,7 @@ def clear_all() -> None:
     from .serve.aot import _MANIFEST_MEMO
     from .serve.dispatcher import _BATCH_REGISTRY, _COALESCE_CACHE, _PENDING_REGISTRY
     from .streaming import _STEP_CACHE
-    from .telemetry import METRICS
+    from .telemetry import FLIGHT_RECORDER, METRICS, _HBM_REGISTRY, _TAIL_REGISTRY
 
     _COHORTS_CACHE.clear()
     _FACTORIZE_CACHE.clear()
@@ -208,4 +214,10 @@ def clear_all() -> None:
     _AUTOTUNE_CACHE.clear()
     _AUTOTUNE_STATE.clear()
     _jitted_bundle.cache_clear()
+    # observability plane (flox_tpu/telemetry.py): the flight-recorder
+    # ring, the per-trace parked tail-detail buffers, and the per-program
+    # HBM attribution table reset with the metrics they annotate
+    FLIGHT_RECORDER.clear()
+    _TAIL_REGISTRY.clear()
+    _HBM_REGISTRY.clear()
     METRICS.reset()

@@ -205,6 +205,13 @@ def chunk_reduce(
             funcs=[p[0] for p in plan if isinstance(p[0], str)],
         ):
             results = bundle(utils.asarray_device(codes), utils.asarray_device(array))
+        if telemetry.enabled():
+            # HBM pressure right after the device dispatch, attributed to
+            # this kernel bundle (cache.stats()["hbm_by_program"]); no-op
+            # off-device, and the label join costs nothing when off
+            telemetry.sample_hbm(
+                program="bundle[" + "+".join(str(p[0]) for p in plan) + "]"
+            )
     else:
         with telemetry.span(
             "dispatch", engine=engine, nkernels=len(plan), size=size,
@@ -771,9 +778,12 @@ def _sparsify_result(result, codes_flat, ngroups: int, agg: Aggregation):
 
     The *compute* stays dense — static shapes are load-bearing for XLA — and
     the sparse container packages the host result, storing only the groups
-    that actually occur in `by` (same nnz the reference's sparse reindex
-    produces). Returns a jax BCOO when the implicit fill is zero, HostCOO
-    otherwise.
+    that actually occur in `by`. Occurrence is the UNION over kept rows: a
+    group found in any kept row of a multi-row `by` is stored for every
+    row (the container's columns are shared), so nnz can exceed what a
+    strictly per-row sparse reindex (the reference's, which stores each
+    block's own groups) would produce; for a single-row `by` the two agree.
+    Returns a jax BCOO when the implicit fill is zero, HostCOO otherwise.
     """
     host = np.asarray(result)
     if host.dtype.kind in "mMOSU":

@@ -22,7 +22,47 @@ import numpy as np
 from . import factorize as fct
 from .aggregations import _initialize_aggregation
 
-__all__ = ["groupby_reduce_device", "codes_device"]
+__all__ = ["groupby_reduce_device", "codes_device", "memory_stats"]
+
+
+def memory_stats(devices: Sequence | None = None) -> dict[str, int] | None:
+    """Aggregate allocator statistics across the local devices.
+
+    Returns ``{"bytes_in_use", "peak_bytes_in_use", "devices"}`` summed
+    over every local device that reports stats (``peak`` falls back to
+    ``bytes_in_use`` for allocators that track no peak), or ``None`` when
+    no device reports any — CPU backends commonly return nothing, and the
+    telemetry HBM gauges (``telemetry.sample_hbm``) simply stay absent
+    there. Never raises: observability must not take a dispatch down.
+    """
+    import jax
+
+    try:
+        devs = list(jax.local_devices()) if devices is None else list(devices)
+    except Exception:  # noqa: BLE001 — no backend at all
+        return None
+    in_use = peak = 0
+    reporting = 0
+    for dev in devs:
+        stats = _device_stats(dev)
+        if not stats:
+            continue
+        reporting += 1
+        dev_in_use = int(stats.get("bytes_in_use", 0))
+        in_use += dev_in_use
+        peak += int(stats.get("peak_bytes_in_use", dev_in_use))
+    if not reporting:
+        return None
+    return {"bytes_in_use": in_use, "peak_bytes_in_use": peak, "devices": reporting}
+
+
+def _device_stats(dev: Any) -> dict | None:
+    """One device's allocator stats, or None where the backend has none."""
+    stats = getattr(dev, "memory_stats", None)
+    try:
+        return stats() if callable(stats) else None
+    except Exception:  # noqa: BLE001 — a backend without the query
+        return None
 
 
 def codes_device(

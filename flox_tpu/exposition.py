@@ -1,0 +1,195 @@
+"""Live metrics exposition: a zero-dependency Prometheus endpoint.
+
+The telemetry registry (PR 4/6) holds counters, gauges, and log-spaced
+latency histograms — but until now they only left the process via file
+export after the fact. A serving replica (``python -m flox_tpu.serve``)
+needs an operator-scrapable surface instead; this module provides it with
+nothing but the stdlib:
+
+* :func:`prometheus_text` renders ``telemetry.METRICS`` in the Prometheus
+  text exposition format (version 0.0.4): counters as ``*_total``, gauges
+  plain, histograms with CUMULATIVE ``_bucket{le=...}`` series over the
+  shared :data:`~flox_tpu.telemetry.HIST_EDGES_MS` edges plus ``_sum`` /
+  ``_count``. Metric names are ``flox_tpu_`` + the registry name with
+  non-identifier characters folded to ``_`` (``serve.request_ms`` ->
+  ``flox_tpu_serve_request_ms``).
+* :class:`MetricsServer` / :func:`start_metrics_server`: a
+  ``ThreadingHTTPServer`` on a daemon background thread serving
+  ``/metrics``, ``/healthz`` (200 while the process lives), and
+  ``/readyz`` (200 only after :func:`set_ready` — the serve loop flips it
+  once the AOT warmup manifest has been replayed, so a load balancer never
+  routes traffic to a replica still paying compiles).
+
+Embedded automatically by ``python -m flox_tpu.serve`` when
+``OPTIONS["metrics_port"]`` (env ``FLOX_TPU_METRICS_PORT``) or
+``--metrics-port`` is nonzero; standalone via
+``python -m flox_tpu.telemetry serve-metrics``.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+__all__ = [
+    "MetricsServer",
+    "prometheus_text",
+    "ready",
+    "set_ready",
+    "start_metrics_server",
+    "stop_metrics_server",
+]
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+#: process-wide endpoint state: the live server (one per process — the
+#: registry it exposes is process-wide too) and the readiness flag
+_SERVER_STATE: dict[str, Any] = {"server": None, "ready": False}
+_STATE_LOCK = threading.Lock()
+
+
+def set_ready(flag: bool = True) -> None:
+    """Flip the ``/readyz`` verdict. The serve loop calls this once its AOT
+    warmup manifest has been replayed (immediately when there is nothing to
+    replay); tests and drains may flip it back."""
+    _SERVER_STATE["ready"] = bool(flag)
+
+
+def ready() -> bool:
+    """Whether ``/readyz`` currently answers 200."""
+    return bool(_SERVER_STATE["ready"])
+
+
+def _metric_name(name: str, suffix: str = "") -> str:
+    return "flox_tpu_" + _NAME_BAD.sub("_", name) + suffix
+
+
+def _fmt(value: float) -> str:
+    value = float(value)
+    if value.is_integer() and abs(value) < 2**63:
+        return str(int(value))
+    return repr(value)
+
+
+def prometheus_text() -> str:
+    """The telemetry registry in Prometheus text exposition format.
+
+    Histogram buckets are cumulative (each ``le`` counts every observation
+    at or below that edge), as the format requires — the registry stores
+    per-bucket counts, so the walk accumulates. The final shared edge
+    absorbs overflow in the registry, so ``le="+Inf"`` equals the total
+    count by construction.
+    """
+    from .telemetry import HIST_EDGES_MS, METRICS
+
+    lines: list[str] = []
+    for name, value in sorted(METRICS.counters().items()):
+        metric = _metric_name(name, "_total")
+        lines += [f"# TYPE {metric} counter", f"{metric} {_fmt(value)}"]
+    for name, value in sorted(METRICS.gauges().items()):
+        metric = _metric_name(name)
+        lines += [f"# TYPE {metric} gauge", f"{metric} {_fmt(value)}"]
+    for name, hist in sorted(METRICS.histograms().items()):
+        metric = _metric_name(name)
+        lines.append(f"# TYPE {metric} histogram")
+        cum = 0
+        for edge, n in zip(HIST_EDGES_MS, hist["counts"]):
+            cum += n
+            lines.append(f'{metric}_bucket{{le="{_fmt(edge)}"}} {cum}')
+        lines.append(f'{metric}_bucket{{le="+Inf"}} {hist["count"]}')
+        lines.append(f"{metric}_sum {_fmt(hist['sum'])}")
+        lines.append(f"{metric}_count {hist['count']}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self) -> None:  # noqa: N802 — http.server's naming contract
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            # count actual scrapes only — health/readiness probes arrive at
+            # probe rate and would swamp the number otherwise
+            from .telemetry import METRICS
+
+            METRICS.inc("metrics.scrapes")
+            body = prometheus_text().encode()
+            status, ctype = 200, "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/healthz":
+            body, status, ctype = b"ok\n", 200, "text/plain; charset=utf-8"
+        elif path == "/readyz":
+            if ready():
+                body, status = b"ready\n", 200
+            else:
+                body, status = b"warming\n", 503
+            ctype = "text/plain; charset=utf-8"
+        else:
+            body, status, ctype = b"not found\n", 404, "text/plain; charset=utf-8"
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, format: str, *args: Any) -> None:
+        # a probe every few seconds must not spam stderr; scrape counts
+        # are visible in the registry itself (metrics.scrapes)
+        pass
+
+
+class MetricsServer:
+    """The background exposition endpoint: a ``ThreadingHTTPServer`` on a
+    daemon thread. ``port=0`` binds an ephemeral port; :attr:`port` is the
+    bound one either way."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1") -> None:
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="flox-tpu-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_metrics_server(port: int | None = None, host: str = "127.0.0.1") -> int | None:
+    """Start (or reuse) the process-wide exposition endpoint.
+
+    ``port=None`` reads ``OPTIONS["metrics_port"]`` — 0 there means the
+    endpoint is off and this returns ``None``. An explicit ``port``
+    argument always starts one (0 = ephemeral). Returns the bound port;
+    idempotent while a server is already running (the registry is
+    process-wide, so one endpoint is the right number of endpoints).
+    """
+    if port is None:
+        from .options import OPTIONS
+
+        port = OPTIONS["metrics_port"]
+        if not port:
+            return None
+    with _STATE_LOCK:
+        server = _SERVER_STATE["server"]
+        if server is not None:
+            return server.port
+        server = MetricsServer(int(port), host=host)
+        _SERVER_STATE["server"] = server
+        return server.port
+
+
+def stop_metrics_server() -> None:
+    """Shut the endpoint down (tests; the serve loop just exits — the
+    thread is a daemon). Readiness resets with it."""
+    with _STATE_LOCK:
+        server = _SERVER_STATE.pop("server", None)
+        _SERVER_STATE["server"] = None
+        _SERVER_STATE["ready"] = False
+    if server is not None:
+        server.close()

@@ -272,6 +272,22 @@ OPTIONS: dict[str, Any] = {
     # fresh replica pointed at a warm dir serves its first request with
     # zero backend compiles. None disables persistence.
     "serve_aot_dir": os.environ.get("FLOX_TPU_SERVE_AOT_DIR") or None,
+    # Observability plane (flox_tpu/exposition.py): TCP port for the
+    # stdlib-HTTP /metrics (Prometheus text format) + /healthz + /readyz
+    # endpoint. 0 (the default) leaves the endpoint off; python -m
+    # flox_tpu.serve starts it automatically when nonzero (or with
+    # --metrics-port).
+    "metrics_port": _env_int("FLOX_TPU_METRICS_PORT", 0, 0, 65535),
+    # Flight recorder (flox_tpu/telemetry.py): dump target for the bounded
+    # ring of recent span/event records on fatal faults, unhandled serve
+    # loop exceptions, and SIGTERM/SIGUSR2 — a JSON-lines file readable by
+    # `python -m flox_tpu.telemetry report`. None disables dumping (the
+    # ring still fills while telemetry is on; telemetry.flight_dump(path)
+    # can dump it anywhere on demand).
+    "flight_recorder_path": os.environ.get("FLOX_TPU_FLIGHT_RECORDER_PATH") or None,
+    # how many recent records the flight-recorder ring retains (a bounded
+    # deque — fixed allocation, the oldest record falls out first)
+    "flight_recorder_size": _env_int("FLOX_TPU_FLIGHT_RECORDER_SIZE", 2048, 16, 1_000_000),
 }
 
 # single source of truth for the accumulation disciplines — referenced by
@@ -330,6 +346,13 @@ _VALIDATORS = {
     "serve_aot_dir": lambda x: x is None or (
         isinstance(x, (str, os.PathLike)) and bool(str(x))
     ),
+    # observability-plane knobs: same at-set-time discipline — a port out
+    # of TCP range or a zero-capacity ring raises here, not at scrape time
+    "metrics_port": lambda x: _is_int(x) and 0 <= x <= 65535,
+    "flight_recorder_path": lambda x: x is None or (
+        isinstance(x, (str, os.PathLike)) and bool(str(x))
+    ),
+    "flight_recorder_size": lambda x: _is_int(x) and 16 <= x <= 1_000_000,
 }
 
 # rebind the literal through the overlay-aware view: same object contents,

@@ -365,12 +365,17 @@ def sharded_groupby_reduce(
             # reference must force blockwise for order statistics
             # (core.py:685-709); this framework does not.
             if method == "cohorts":
-                import logging
+                import warnings
 
-                logging.getLogger("flox_tpu.parallel.mapreduce").debug(
-                    "%s: cohorts has no ownership win for order statistics; "
-                    "running the distributed radix-select map-reduce program",
-                    agg.name,
+                # the caller asked for cohorts BY NAME and is getting a
+                # different execution method — that reroute must be
+                # visible to them, not buried in a debug log (ADVICE r5)
+                warnings.warn(
+                    f"method='cohorts' has no ownership win for order "
+                    f"statistics; {agg.name!r} runs the distributed "
+                    "radix-select 'map-reduce' program instead",
+                    UserWarning,
+                    stacklevel=2,
                 )
             method = "map-reduce"
         else:
@@ -516,14 +521,20 @@ def sharded_groupby_reduce(
             with telemetry.span(
                 "program-build", agg=agg.name, method=method, ndev=ndev, size=size
             ):
-                return fn(arr, codes_dev)
+                result = fn(arr, codes_dev)
+        if telemetry.enabled():
+            telemetry.sample_hbm(program=f"mesh[{agg.name}/{method}]")
+        return result
     telemetry.count("cache.program_hits")
     # the annotation makes the SPMD dispatch visible inside xprof device
     # traces (jax.profiler.TraceAnnotation) as well as in our own trace
     with telemetry.annotated(
         f"flox:mesh-dispatch[{agg.name}/{method}]", ndev=ndev, size=size
     ):
-        return fn(arr, codes_dev)
+        result = fn(arr, codes_dev)
+    if telemetry.enabled():
+        telemetry.sample_hbm(program=f"mesh[{agg.name}/{method}]")
+    return result
 
 
 #: compiled shard_map programs, LRU-bounded: get() renews recency, inserts

@@ -131,6 +131,13 @@ class SlabStager:
         self.policy = RetryPolicy.from_options()
         self._dtype0: Any = None
         self._lock = threading.Lock()
+        # the stream's trace context, frozen at stager construction: the
+        # prefetch pool's worker threads do NOT inherit the consumer's
+        # contextvars, so stage spans and retry events re-bind it per call
+        # — one request's streaming activity stays joinable by trace id
+        from . import telemetry
+
+        self._trace_id = telemetry.current_trace()
 
     def stage_index(self, i: int) -> Slab:
         s, e = i * self.batch_len, min((i + 1) * self.batch_len, self.n)
@@ -139,12 +146,22 @@ class SlabStager:
         )
 
     def stage_range(self, s: int, e: int, pad_to: int | None = None, index: int = -1) -> Slab:
+        from . import telemetry
         from .resilience import call_with_retry
 
-        return call_with_retry(
-            lambda: self._stage_once(s, e, pad_to, index),
-            policy=self.policy, counters=self.counters, what=f"[{s}:{e})",
-        )
+        def _staged() -> Slab:
+            return call_with_retry(
+                lambda: self._stage_once(s, e, pad_to, index),
+                policy=self.policy, counters=self.counters, what=f"[{s}:{e})",
+            )
+
+        if self._trace_id is None or telemetry.current_trace() is not None:
+            return _staged()
+        # worker thread with no trace of its own: rebind the stream's.
+        # observe=False — only the root trace feeds the tail-sampling
+        # histogram; this binding just tags records and parks detail
+        with telemetry.trace(self._trace_id, observe=False):
+            return _staged()
 
     def _stage_once(self, s: int, e: int, pad_to: int | None, index: int) -> Slab:
         import jax
@@ -176,11 +193,14 @@ class SlabStager:
 
         if telemetry.enabled():
             telemetry.METRICS.inc("bytes.h2d", int(slab.nbytes) + int(cfull.nbytes))
-            if telemetry.detailed():
+            if telemetry.tail_detail():
                 # staging runs on the prefetch workers: standalone spans,
-                # interleaved with the consumer's stream span by timestamp
+                # interleaved with the consumer's stream span by timestamp.
+                # detail=True: at level="basic" inside a trace these park on
+                # the trace and survive only when it blows its running p99
                 telemetry.record_span(
                     "stage", t0, t2, attrs={"start": s, "stop": e, "index": index},
+                    detail=True,
                 )
         return Slab(
             index=index, start=s, stop=e, data=data, codes=cdev, codes_host=chost,
@@ -335,6 +355,9 @@ def stream_slabs(
         from . import telemetry
 
         if telemetry.enabled():
+            # HBM pressure right after the pass — in-flight slabs + carry
+            # state is exactly when a streaming run's footprint peaks
+            telemetry.sample_hbm(program=f"stream[{label}]" if label else "stream")
             # one span per streaming pass, carrying the StreamReport totals
             # as attributes — the report object stays the programmatic API,
             # the span is its trace-file view

@@ -14,6 +14,7 @@ xarray adapter's coordinate alignment.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from enum import Enum, auto
 from typing import Any
@@ -70,14 +71,18 @@ class ReindexStrategy:
         ):
             raise ValueError("Setting reindex.blockwise=True not allowed for non-numpy array type.")
 
-    def set_blockwise_for_numpy(self):
-        # parity shim: reference reindex.py:75-76 mutates in place and ported
-        # code may rely on that, so this does too (via object.__setattr__ on
-        # the frozen dataclass, re-validating). Caveat: the by-value hash
-        # changes — don't use an instance as a dict/set key before calling.
+    def set_blockwise_for_numpy(self) -> "ReindexStrategy":
+        """Resolve ``blockwise=None`` to ``True`` for the numpy container
+        path (parity: reference reindex.py:75-76, which mutates in place).
+
+        Returns a NEW strategy via :func:`dataclasses.replace` — the frozen
+        instance is never mutated, so its by-value hash stays stable and an
+        instance already used as a dict/set/cache key keeps meaning what it
+        meant. Call sites rebind: ``strategy = strategy.set_blockwise_for_numpy()``.
+        """
         if self.blockwise is None:
-            object.__setattr__(self, "blockwise", True)
-            self.__post_init__()
+            return dataclasses.replace(self, blockwise=True)
+        return self
 
 
 @dataclass

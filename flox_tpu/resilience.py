@@ -168,6 +168,18 @@ class RetryPolicy:
         return self.backoff * (2.0**attempt)
 
 
+def _flight_on_fatal(exc: BaseException, what: str = "") -> None:
+    """Dump the telemetry flight recorder for a fatal classification — the
+    crash-forensics half of the taxonomy: transient errors retry, oom
+    errors split, fatal ones leave a flight record and surface. A no-op
+    when telemetry is off or no dump path is configured, and never raises
+    (the original exception must surface unmasked)."""
+    from . import telemetry
+
+    telemetry.event("fatal", what=what, error=type(exc).__name__)
+    telemetry.flight_dump(reason=f"fatal:{type(exc).__name__}" + (f" {what}" if what else ""))
+
+
 def call_with_retry(
     fn: Callable[[], Any],
     *,
@@ -189,7 +201,14 @@ def call_with_retry(
         try:
             return fn()
         except Exception as exc:
-            if classify_error(exc) != TRANSIENT:
+            cls = classify_error(exc)
+            if cls != TRANSIENT:
+                if cls == FATAL:
+                    # a programming error is about to surface: leave the
+                    # flight record NOW, while the ring still holds the
+                    # spans/events leading up to it (no-op unless
+                    # FLOX_TPU_FLIGHT_RECORDER_PATH is configured)
+                    _flight_on_fatal(exc, what=what)
                 raise
             if attempt >= policy.retries:
                 raise  # retries exhausted: surface the original exception
@@ -295,7 +314,10 @@ def dispatch_slab(
         faults.poke(sl.start, sl.stop)
         return apply_fn(carry, sl)
     except Exception as exc:
-        if classify_error(exc) != OOM or stager is None:
+        cls = classify_error(exc)
+        if cls != OOM or stager is None:
+            if cls == FATAL:
+                _flight_on_fatal(exc, what=f"[{sl.start}:{sl.stop})")
             raise
         return _split_dispatch(
             apply_fn, carry, sl.start, sl.stop, stager,

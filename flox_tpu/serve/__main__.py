@@ -103,15 +103,26 @@ async def _serve_request(dispatcher: Dispatcher, line_no: int, msg: dict) -> Non
 
 
 async def _amain(args: argparse.Namespace) -> int:
-    from ..options import set_options
+    from .. import exposition
+    from ..options import OPTIONS, set_options
 
     if args.aot_dir:
         set_options(serve_aot_dir=args.aot_dir)
+    metrics_port = (
+        args.metrics_port if args.metrics_port is not None else OPTIONS["metrics_port"]
+    )
+    if metrics_port:
+        bound = exposition.start_metrics_server(port=metrics_port, host=args.metrics_host)
+        _emit({"op": "metrics", "port": bound})
     if args.warmup:
         warmed = await asyncio.to_thread(aot.warmup)
         from ..telemetry import METRICS
 
         _emit({"warmed": warmed, "compiles": METRICS.get("jax.compiles")})
+    # /readyz flips here: the warmup manifest (when requested) has been
+    # replayed, so a load balancer routing on readiness never hands traffic
+    # to a replica still paying compiles
+    exposition.set_ready(True)
     dispatcher = Dispatcher(
         queue_depth=args.queue_depth,
         deadline=args.deadline,
@@ -150,6 +161,7 @@ async def _amain(args: argparse.Namespace) -> int:
                 _emit({"op": "stats", **_counters()})
             elif op == "warmup":
                 warmed = await asyncio.to_thread(aot.warmup)
+                exposition.set_ready(True)
                 from ..telemetry import METRICS
 
                 _emit({"warmed": warmed, "compiles": METRICS.get("jax.compiles")})
@@ -196,8 +208,33 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--deadline", type=float, default=None)
     parser.add_argument("--microbatch-max", type=int, default=None)
     parser.add_argument("--batch-window", type=float, default=None)
+    parser.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve /metrics + /healthz + /readyz on this port "
+        "(overrides FLOX_TPU_METRICS_PORT; 0 keeps the endpoint off)",
+    )
+    parser.add_argument(
+        "--metrics-host", default="127.0.0.1",
+        help="bind address for the metrics endpoint — the loopback default "
+        "suits sidecar scrapers; pass 0.0.0.0 for a remote Prometheus",
+    )
     args = parser.parse_args(argv)
-    return asyncio.run(_amain(args))
+    from .. import telemetry
+
+    # SIGTERM/SIGUSR2 leave a flight-recorder dump (no-op unless telemetry
+    # + FLOX_TPU_FLIGHT_RECORDER_PATH are configured); must be installed on
+    # the main thread, before the loop starts
+    telemetry.install_signal_dumps()
+    try:
+        return asyncio.run(_amain(args))
+    except Exception as exc:
+        # an unhandled serve-loop exception is exactly what the flight
+        # recorder exists for: dump the last N records, then die loudly.
+        # Exception, not BaseException: Ctrl-C / SystemExit are clean
+        # shutdowns and must not overwrite a genuine earlier fatal dump
+        # with a post-shutdown snapshot labeled as a crash
+        telemetry.flight_dump(reason=f"serve-loop:{type(exc).__name__}")
+        raise
 
 
 if __name__ == "__main__":

@@ -303,4 +303,7 @@ def warmup(path: Any = None) -> int:
             except Exception as exc:  # noqa: FLX006
                 logger.warning("AOT warmup skipped %s: %s", spec.get("func"), exc)
         telemetry.count("serve.aot_warmed", warmed)
+        # warmup just materialized every program the replica will serve:
+        # its HBM mark is the replica's standing footprint before traffic
+        telemetry.sample_hbm(program="serve.warmup")
     return warmed

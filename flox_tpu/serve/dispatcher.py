@@ -309,8 +309,23 @@ class Dispatcher:
             )
         rid = next(_IDS)
         _PENDING_REGISTRY[rid] = request
+        # end-to-end trace context: the request_id (or a generated one)
+        # rides a contextvar into every child span/event this request emits
+        # — the batch task and its asyncio.to_thread execution inherit it,
+        # so core phase spans, streaming passes, mesh dispatches, and
+        # resilience events all carry it in both export formats.
+        # observe=False: this layer feeds serve.request_ms itself (always
+        # on — SLO histograms don't ride the telemetry switch); the
+        # tail-sampling verdict compares against the p99 snapshotted at
+        # trace ENTRY, so the request's own mid-trace observation cannot
+        # dilute its own verdict
+        if request.request_id is None:
+            request.request_id = f"req-{rid}"
         try:
-            return await self._submit_admitted(request, t0)
+            with telemetry.trace(
+                request.request_id, hist="serve.request_ms", observe=False
+            ):
+                return await self._submit_admitted(request, t0)
         finally:
             _PENDING_REGISTRY.pop(rid, None)
 
@@ -521,6 +536,17 @@ class Dispatcher:
                     result = np.asarray(result)
                     rows = [result[i] for i in range(len(live))]
         groups = np.asarray(groups)
+        if telemetry.enabled():
+            # HBM pressure right after the dispatch, attributed to THIS
+            # program key (cache.stats()["hbm_by_program"]): the digest
+            # keeps the label bounded while separating shape/dtype/option
+            # variants. Gated: the repr+hash must cost nothing when off.
+            pdigest = _digest_bytes(repr(batch.pkey).encode())[:8]
+            telemetry.sample_hbm(
+                program="serve["
+                + (batch.func if isinstance(batch.func, str) else "custom")
+                + f"#{pdigest}]"
+            )
         device_ms = (time.perf_counter() - t0) * 1e3
         METRICS.observe("serve.device_ms", device_ms)
         for leaf in live:

@@ -34,6 +34,30 @@ observability layer:
 * **Report CLI**: ``python -m flox_tpu.telemetry report <file>`` prints a
   per-phase summary table (count / total / mean / max ms) plus the counter
   snapshot embedded in the export — either format.
+* **Request tracing** (:func:`trace`): a contextvar trace context — the
+  serving layer binds each request's ``request_id``, and every record a
+  traced execution emits (core phase spans, streaming passes, mesh
+  dispatches, resilience events — including ones fired on prefetch worker
+  threads, which re-bind the stream's trace) carries it in both export
+  formats. Tail-based detail: at ``telemetry_level="basic"``,
+  ``detailed``-level records produced inside a trace are parked per trace
+  and kept only when the trace blows its running p99 (or errors), so a
+  slow request's trace is always explainable without paying detailed-level
+  volume on every fast one.
+* **HBM accounting** (:func:`sample_hbm`): ``device.memory_stats()``
+  sampled around dispatches feeds the ``hbm.bytes_in_use`` /
+  ``hbm.peak_bytes_in_use`` gauges plus a per-program-key peak table
+  surfaced through ``cache.stats()["hbm_by_program"]``.
+* **Flight recorder** (:data:`FLIGHT_RECORDER` / :func:`flight_dump`): a
+  bounded ring of the most recent records, always on while telemetry is
+  enabled. :func:`flight_dump` writes it atomically as JSON-lines (readable
+  by the report CLI) to ``OPTIONS["flight_recorder_path"]`` — triggered on
+  fatal-classified faults (``resilience.classify_error``), unhandled serve
+  loop exceptions, and SIGTERM/SIGUSR2 (:func:`install_signal_dumps`).
+* **Live exposition**: ``python -m flox_tpu.telemetry serve-metrics``
+  serves the registry over stdlib HTTP as Prometheus text format
+  (``/metrics`` + ``/healthz`` + ``/readyz`` — :mod:`flox_tpu.exposition`);
+  ``python -m flox_tpu.serve`` embeds the same endpoint.
 
 Knobs (all validated at set time, mirrored from the environment):
 
@@ -59,26 +83,34 @@ import math
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Iterable
 
 __all__ = [
+    "FLIGHT_RECORDER",
     "HIST_EDGES_MS",
     "METRICS",
     "MetricsRegistry",
     "annotated",
     "count",
+    "current_trace",
     "detailed",
     "drain",
     "enabled",
     "event",
     "export_chrome_trace",
     "export_jsonl",
+    "flight_dump",
     "flush",
+    "install_signal_dumps",
     "profile_call",
     "record_span",
     "reset",
+    "sample_hbm",
     "span",
     "spans",
+    "tail_detail",
+    "trace",
 ]
 
 # perf_counter origin for span timestamps; the wall anchor lets exports
@@ -101,10 +133,28 @@ def enabled() -> bool:
 
 
 def detailed() -> bool:
-    """Whether per-slab / per-kernel detail is on (level ``"detailed"``)."""
+    """Whether per-slab / per-kernel detail is on (level ``"detailed"``).
+
+    Counter sites gate on this. It stays a strict level check on purpose:
+    counters cannot be retracted, so a tail-sampled trace must not inflate
+    detailed-only counters (``kernel.trace.*``) — record sites that WANT
+    tail sampling gate on :func:`tail_detail` instead."""
     from .options import OPTIONS
 
     return bool(OPTIONS["telemetry"]) and OPTIONS["telemetry_level"] == "detailed"
+
+
+def tail_detail() -> bool:
+    """Whether detailed-level RECORDS should be produced: level
+    ``"detailed"``, or a live :func:`trace` context at ``"basic"`` — there
+    the records are parked per trace (``detail=True``) and kept only when
+    the trace blows its running p99, so producing them is free for fast
+    requests. Records only; counter sites use :func:`detailed`."""
+    from .options import OPTIONS
+
+    if not OPTIONS["telemetry"]:
+        return False
+    return OPTIONS["telemetry_level"] == "detailed" or _TRACE.get() is not None
 
 
 # ---------------------------------------------------------------------------
@@ -142,7 +192,11 @@ class MetricsRegistry:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # RLock, not Lock: the SIGTERM/SIGUSR2 flight-dump handler runs ON
+        # the main thread between bytecodes and reads the registry — if the
+        # signal lands while that same thread holds the lock in inc(), a
+        # plain Lock would deadlock the dump instead of writing it
+        self._lock = threading.RLock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
         self._hists: dict[str, dict] = {}
@@ -203,6 +257,18 @@ class MetricsRegistry:
                 return None
             return _hist_percentile(hist, q)
 
+    def counters(self) -> dict[str, float]:
+        """A copy of the counters alone — the Prometheus renderer needs the
+        counter/gauge split ``snapshot`` merges away (counters get the
+        ``_total`` suffix and the ``counter`` TYPE, gauges do not)."""
+        with self._lock:
+            return dict(self._counters)
+
+    def gauges(self) -> dict[str, float]:
+        """A copy of the gauges alone (see :meth:`counters`)."""
+        with self._lock:
+            return dict(self._gauges)
+
     def snapshot(self) -> dict[str, float]:
         with self._lock:
             return {**self._counters, **self._gauges}
@@ -250,14 +316,31 @@ def count(name: str, value: float = 1) -> None:
 _CURRENT: contextvars.ContextVar["_Span | None"] = contextvars.ContextVar(
     "flox_tpu_span", default=None
 )
+#: the active trace id (a request_id in the serving layer): every record
+#: emitted while it is set carries it, so one request's spans are joinable
+#: across core/streaming/mesh/resilience in both export formats
+_TRACE: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "flox_tpu_trace", default=None
+)
 _IDS = itertools.count(1)
 
-# finished records (span + event dicts) pending export/drain
+#: per-trace parked detail records (tail-based sampling at level="basic"):
+#: trace id -> records kept only if the trace blows its running p99.
+#: Registered in cache.clear_all (floxlint FLX008).
+_TAIL_REGISTRY: dict[str, list] = {}
+#: detail-record cap per trace — one runaway streaming request must not
+#: hold unbounded parked records hostage while its trace is open
+_TAIL_MAX_PER_TRACE = 1024
+
+# finished records (span + event dicts) pending export/drain. RLock: the
+# signal-handler flight dump (and its flush) may interrupt this very thread
+# mid-_commit — a plain Lock would deadlock the dump (see MetricsRegistry)
 _RECORDS: list[dict] = []
-_RECORDS_LOCK = threading.Lock()
+_RECORDS_LOCK = threading.RLock()
 # serializes file appends: concurrent batch flushes from prefetch-worker
 # and consumer threads must not interleave mid-line in the export file
-_EXPORT_LOCK = threading.Lock()
+# (RLock: the signal-handler flush may interrupt an in-progress append)
+_EXPORT_LOCK = threading.RLock()
 _EXPORT_STATE: dict[str, Any] = {"atexit": False, "listener": False}
 
 
@@ -381,12 +464,15 @@ def record_span(
     t1: float,
     attrs: dict | None = None,
     parent_id: int | None = None,
+    detail: bool = False,
 ) -> None:
     """Record an already-timed span (``t0``/``t1`` from ``perf_counter``).
 
     For code that cannot hold a ``with`` block open across its timing — the
     streaming generator records one span per finished pass this way, with
-    the ``StreamReport`` totals as attributes."""
+    the ``StreamReport`` totals as attributes. ``detail=True`` marks the
+    span as detailed-level: at ``telemetry_level="basic"`` it is parked on
+    the active trace and survives only if the trace blows its running p99."""
     if not enabled():
         return
     _bootstrap()
@@ -403,7 +489,8 @@ def record_span(
             "ts_us": round((t0 - _EPOCH) * 1e6, 1),
             "dur_us": round((t1 - t0) * 1e6, 1),
             "attrs": attrs or {},
-        }
+        },
+        detail=detail,
     )
 
 
@@ -437,26 +524,329 @@ def current_set(**attrs: Any) -> None:
         sp.attrs.update(attrs)
 
 
+# ---------------------------------------------------------------------------
+# request tracing: trace context + tail-based detail sampling
+# ---------------------------------------------------------------------------
+
+
+def current_trace() -> str | None:
+    """The active trace id, or ``None`` outside any :func:`trace` context
+    (worker-thread code rebinds it via ``trace(..., observe=False)`` — a
+    plain thread does not inherit the submitting context's contextvars)."""
+    return _TRACE.get()
+
+
+def trace(trace_id: Any, hist: str = "trace_ms", observe: bool = True):
+    """Bind a trace context: ``with telemetry.trace(request_id): ...``.
+
+    Every record emitted inside (phase spans, streaming passes, mesh
+    dispatches, resilience events) carries ``trace_id``, in the buffer and
+    in both export formats. On exit the trace's duration is compared with
+    the running p99 of the ``hist`` histogram (and observed into it, unless
+    ``observe=False`` — the serving layer feeds ``serve.request_ms``
+    itself): a trace that blew the p99, or errored, promotes its parked
+    ``detailed``-level records into the buffer; a fast one drops them. The
+    no-op singleton is returned when telemetry is disabled — no allocation.
+    """
+    if not enabled():
+        return _NOOP
+    _bootstrap()
+    return _Trace(str(trace_id), hist, observe)
+
+
+class _Trace:
+    __slots__ = ("trace_id", "_hist", "_observe", "_token", "_t0", "_owns_tail", "_p99")
+
+    def __init__(self, trace_id: str, hist: str, observe: bool) -> None:
+        self.trace_id = trace_id
+        self._hist = hist
+        self._observe = observe
+        self._token: contextvars.Token | None = None
+        self._t0 = 0.0
+        self._owns_tail = False
+        self._p99: float | None = None
+
+    def __enter__(self) -> "_Trace":
+        from .options import OPTIONS
+
+        self._token = _TRACE.set(self.trace_id)
+        if OPTIONS["telemetry_level"] != "detailed":
+            # open the tail-parking buffer for this trace; detail records
+            # emitted inside land here instead of the main buffer. Only the
+            # OPENING binding owns the buffer and the keep/drop verdict —
+            # a worker-thread rebinding of a live trace must never pop the
+            # root's parked records mid-request
+            with _RECORDS_LOCK:
+                if self.trace_id not in _TAIL_REGISTRY:
+                    _TAIL_REGISTRY[self.trace_id] = []
+                    self._owns_tail = True
+            if self._owns_tail:
+                # the verdict compares against the distribution this trace
+                # JOINED: snapshot the p99 at entry, so neither this trace
+                # (the serve layer observes its own latency mid-trace with
+                # observe=False) nor its contemporaries dilute the bar a
+                # cold-start outlier is judged against
+                self._p99 = METRICS.percentile(self._hist, 0.99)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        dur_ms = (time.perf_counter() - self._t0) * 1e3
+        if self._token is not None:
+            _TRACE.reset(self._token)
+            self._token = None
+        parked = None
+        if self._owns_tail:
+            with _RECORDS_LOCK:
+                parked = _TAIL_REGISTRY.pop(self.trace_id, None)
+        if self._observe:
+            METRICS.observe(self._hist, dur_ms)
+        if parked:
+            # keep on error, on blowing the entry-time p99, or when there
+            # was no distribution to compare against (the first traced
+            # request after a restart IS the cold-start outlier worth
+            # explaining — dropping it for lack of a baseline would lose
+            # exactly the trace the feature exists for)
+            if exc_type is not None or self._p99 is None or dur_ms > self._p99:
+                METRICS.inc("telemetry.tail_kept", len(parked))
+                for rec in parked:
+                    if rec.get("type") == "span":
+                        # promoted spans feed the per-phase histograms HERE
+                        # — dropped ones never do, so /metrics shows the
+                        # same per-phase distributions whether or not fast
+                        # requests were traced
+                        METRICS.observe(
+                            "span_ms." + rec["name"], rec.get("dur_us", 0.0) / 1e3
+                        )
+                _commit(parked)
+            else:
+                METRICS.inc("telemetry.tail_dropped", len(parked))
+        return False
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: bounded ring of recent records, dumped on crash signals
+# ---------------------------------------------------------------------------
+
+
+class _FlightRecorder:
+    """A bounded ring of the most recent span/event records.
+
+    Always fed while telemetry is enabled (``_emit`` appends every record);
+    the deque's ``maxlen`` (``OPTIONS["flight_recorder_size"]``) makes the
+    allocation fixed — the oldest record falls out first. :func:`flight_dump`
+    snapshots it to disk when the process is about to die."""
+
+    __slots__ = ("_ring", "_lock")
+
+    def __init__(self) -> None:
+        self._ring: deque | None = None
+        # RLock for the same reason as the registry's: the signal-handler
+        # dump snapshots the ring on the thread that may be mid-append
+        self._lock = threading.RLock()
+
+    def append(self, record: dict) -> None:
+        from .options import OPTIONS
+
+        cap = OPTIONS["flight_recorder_size"]
+        with self._lock:
+            if self._ring is None or self._ring.maxlen != cap:
+                self._ring = deque(self._ring or (), maxlen=cap)
+            self._ring.append(record)
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring or ())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring or ())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = None
+
+
+#: the process-wide ring; registered in cache.clear_all (floxlint FLX008)
+FLIGHT_RECORDER = _FlightRecorder()
+
+
+def flight_dump(path: Any = None, reason: str = "") -> str | None:
+    """Dump the flight-recorder ring atomically as JSON-lines.
+
+    ``path`` defaults to ``OPTIONS["flight_recorder_path"]`` (env
+    ``FLOX_TPU_FLIGHT_RECORDER_PATH``); ``None`` there means dumping is off
+    and this is a no-op returning ``None`` (so the fault-path triggers cost
+    nothing unconfigured). The file is a header event + the ring records +
+    a counters line — exactly what ``python -m flox_tpu.telemetry report``
+    reads. Written tmp+rename, so a crash mid-dump never leaves a torn
+    file; never raises (a failing dump must not mask the original fault).
+    """
+    from .options import OPTIONS
+
+    if path is None:
+        path = OPTIONS["flight_recorder_path"]
+    if path is None or not enabled():
+        return None
+    try:
+        METRICS.inc("flight.dumps")
+        records = FLIGHT_RECORDER.records()
+        header = {
+            "type": "event",
+            "name": "flight-recorder",
+            "id": 0,
+            "ts_us": round((time.perf_counter() - _EPOCH) * 1e6, 1),
+            "tid": threading.get_ident(),
+            "attrs": {
+                "reason": reason,
+                "records": len(records),
+                "pid": _PID,
+                "wall": time.time(),
+            },
+        }
+        path = str(path)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = f"{path}.tmp.{_PID}"
+        with open(tmp, "w") as f:
+            for rec in [header, *records, _counters_record()]:
+                f.write(json.dumps(rec, default=str) + "\n")
+        os.replace(tmp, path)
+        return path
+    except Exception as exc:  # noqa: BLE001 — dumping is best-effort by contract
+        import logging
+
+        logging.getLogger(__name__).warning("flight-recorder dump failed: %s", exc)
+        return None
+
+
+def install_signal_dumps() -> None:
+    """Dump the flight recorder on SIGTERM (then die with the default
+    disposition, so exit codes stay honest) and on SIGUSR2 (dump and keep
+    running — the operator's "what are you doing right now" poke). Only
+    callable from the main thread; the serve loop and the standalone
+    metrics endpoint install this at startup. No-op on platforms missing
+    the signals."""
+    import signal
+
+    def _dump(signum: int, frame: Any) -> None:
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        flight_dump(reason=f"signal:{name}")
+        if signum == getattr(signal, "SIGTERM", None):
+            flush()
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    for signame in ("SIGTERM", "SIGUSR2"):
+        signum = getattr(signal, signame, None)
+        if signum is None:
+            continue
+        try:
+            signal.signal(signum, _dump)
+        except (ValueError, OSError):  # not the main thread / exotic platform
+            return
+
+
+# ---------------------------------------------------------------------------
+# device-memory accounting
+# ---------------------------------------------------------------------------
+
+
+#: per-program-key peak HBM: program label -> max bytes_in_use observed
+#: right after one of its dispatches. Surfaced via cache.stats()
+#: ["hbm_by_program"]; registered in cache.clear_all (floxlint FLX008).
+_HBM_REGISTRY: dict[str, float] = {}
+
+
+def sample_hbm(program: str | None = None) -> None:
+    """Sample ``device.memory_stats()`` into the HBM gauges.
+
+    Called around dispatches (eager bundle, mesh program, streaming pass,
+    serving execute). Feeds ``hbm.bytes_in_use`` (latest) and
+    ``hbm.peak_bytes_in_use`` (running max — the allocator's own peak when
+    it reports one); with ``program`` set, also attributes the observed
+    ``bytes_in_use`` to that program key in :data:`_HBM_REGISTRY`, so an
+    operator can see WHICH compiled program is eating the chip. No-op when
+    telemetry is off or the backend exposes no memory stats (CPU)."""
+    if not enabled():
+        return
+    from . import device
+
+    stats = device.memory_stats()
+    if not stats:
+        return
+    in_use = float(stats.get("bytes_in_use", 0.0))
+    peak = float(stats.get("peak_bytes_in_use", in_use))
+    METRICS.set_gauge("hbm.bytes_in_use", in_use)
+    METRICS.max_gauge("hbm.peak_bytes_in_use", peak)
+    if program is not None:
+        with _RECORDS_LOCK:
+            if in_use > _HBM_REGISTRY.get(program, float("-inf")):
+                _HBM_REGISTRY[program] = in_use
+
+
+def hbm_by_program() -> dict[str, float]:
+    """A locked copy of the per-program peak-HBM table — ``cache.stats``
+    reads through this so a stats query on the event-loop thread never
+    races a worker-thread ``sample_hbm`` insertion mid-copy."""
+    with _RECORDS_LOCK:
+        return dict(_HBM_REGISTRY)
+
+
 #: jsonl streaming appends in batches of this many records — one
 #: open/write/close per span would compete with the prefetch workers the
 #: pipeline exists to keep busy (flush() and atexit drain the remainder)
 _JSONL_BATCH = 64
 
 
-def _emit(record: dict) -> None:
+def _emit(record: dict, detail: bool = False) -> None:
     from .options import OPTIONS
 
+    tid = _TRACE.get()
+    if tid is not None:
+        record["trace"] = tid
+    # the flight ring sees EVERY record (bounded: oldest falls out), so a
+    # crash dump always holds the freshest activity regardless of export
+    # configuration or tail-sampling verdicts
+    FLIGHT_RECORDER.append(record)
+    if detail and OPTIONS["telemetry_level"] != "detailed":
+        # tail-based sampling: park the record on its trace WITHOUT feeding
+        # the histograms — a dropped record must leave no registry mark
+        # (promotion observes span_ms then), or traced-but-fast requests
+        # would inflate /metrics with detail the verdict discarded. Detail
+        # without a trace context never reaches here — tail_detail() is
+        # False there.
+        if tid is None:
+            return
+        with _RECORDS_LOCK:
+            buf = _TAIL_REGISTRY.get(tid)
+            if buf is not None and len(buf) < _TAIL_MAX_PER_TRACE:
+                buf.append(record)
+        return
     if record.get("type") == "span":
         # every finished span feeds the per-phase latency histogram — the
         # p50/p99 source for the report CLI, the Perfetto export, and the
         # serving-layer SLO metrics (ROADMAP item 1)
         METRICS.observe("span_ms." + record["name"], record.get("dur_us", 0.0) / 1e3)
+    _commit([record])
+
+
+def _commit(records: list[dict]) -> None:
+    """Append finished records to the buffer (and stream a jsonl batch out
+    when one is due) — the shared tail of :func:`_emit` and the tail-kept
+    promotion in :class:`trace`."""
+    from .options import OPTIONS
+
     path = OPTIONS["telemetry_export_path"]
     with _RECORDS_LOCK:
-        if len(_RECORDS) >= _MAX_RECORDS:
-            METRICS.inc("telemetry.dropped_records")
+        if len(_RECORDS) + len(records) > _MAX_RECORDS:
+            METRICS.inc("telemetry.dropped_records", len(records))
             return
-        _RECORDS.append(record)
+        _RECORDS.extend(records)
         stream_now = (
             path is not None
             and str(path).endswith(".jsonl")
@@ -552,10 +942,14 @@ def drain() -> list[dict]:
 
 
 def reset() -> None:
-    """Clear the record buffer AND the metrics registry (tests;
-    ``cache.clear_all`` calls :meth:`MetricsRegistry.reset` too)."""
+    """Clear the record buffer, the metrics registry, the flight-recorder
+    ring, the parked tail buffers, and the per-program HBM table (tests;
+    ``cache.clear_all`` resets the same state)."""
     with _RECORDS_LOCK:
         _RECORDS.clear()
+        _TAIL_REGISTRY.clear()
+        _HBM_REGISTRY.clear()
+    FLIGHT_RECORDER.clear()
     METRICS.reset()
 
 
@@ -601,6 +995,11 @@ def to_chrome_trace(records: Iterable[dict] | None = None) -> dict:
     records = spans() if records is None else list(records)
     trace_events = []
     for rec in records:
+        # the trace context rides args (Chrome events have no trace field),
+        # so a request_id is searchable in Perfetto exactly like in jsonl
+        args = dict(rec.get("attrs") or {})
+        if rec.get("trace") is not None:
+            args["trace_id"] = rec["trace"]
         if rec.get("type") == "span":
             trace_events.append(
                 {
@@ -610,7 +1009,7 @@ def to_chrome_trace(records: Iterable[dict] | None = None) -> dict:
                     "dur": rec["dur_us"],
                     "pid": _PID,
                     "tid": rec["tid"],
-                    "args": rec.get("attrs") or {},
+                    "args": args,
                 }
             )
         elif rec.get("type") == "event":
@@ -622,7 +1021,7 @@ def to_chrome_trace(records: Iterable[dict] | None = None) -> dict:
                     "ts": rec["ts_us"],
                     "pid": _PID,
                     "tid": rec["tid"],
-                    "args": rec.get("attrs") or {},
+                    "args": args,
                 }
             )
     return {
@@ -860,7 +1259,40 @@ def main(argv: list[str] | None = None) -> int:
         "--histograms", action="store_true",
         help="also print the registry histograms (per-metric p50/p90/p99)",
     )
+    srv = sub.add_parser(
+        "serve-metrics",
+        help="standalone /metrics + /healthz + /readyz HTTP endpoint "
+        "(Prometheus text format, stdlib-only)",
+    )
+    srv.add_argument(
+        "--port", type=int, default=None,
+        help="TCP port (default: OPTIONS['metrics_port'] or 8000; 0 picks "
+        "an ephemeral port and prints it)",
+    )
+    srv.add_argument("--host", default="127.0.0.1")
     args = parser.parse_args(argv)
+    if args.command == "serve-metrics":
+        # a process whose only job is to be scraped (smoke tests,
+        # sidecars): telemetry forced on (an endpoint over a dead registry
+        # is useless), ready immediately (no warmup manifest to replay),
+        # crash-signal dumps installed so SIGTERM leaves a flight record
+        from . import exposition
+        from .options import OPTIONS, set_options
+
+        set_options(telemetry=True)
+        install_signal_dumps()
+        port = args.port if args.port is not None else (OPTIONS["metrics_port"] or 8000)
+        bound = exposition.start_metrics_server(port=port, host=args.host)
+        exposition.set_ready(True)
+        print(f"serving /metrics /healthz /readyz on http://{args.host}:{bound}")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            exposition.stop_metrics_server()
+        return 0
     try:
         lines = _report_lines(args.file, histograms=args.histograms)
     except OSError as exc:

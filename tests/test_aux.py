@@ -58,8 +58,14 @@ def test_reindex_strategy_sparse_supported():
     with pytest.raises(ValueError, match="blockwise=True not allowed"):
         ReindexStrategy(blockwise=True, array_type=ReindexArrayType.SPARSE_COO)
     s2 = ReindexStrategy(blockwise=None)
-    s2.set_blockwise_for_numpy()
-    assert s2.blockwise is True
+    resolved = s2.set_blockwise_for_numpy()
+    assert resolved.blockwise is True
+    # dataclasses.replace semantics (ADVICE r5): the frozen original is
+    # untouched, so instances used as cache keys keep their hash
+    assert s2.blockwise is None
+    assert hash(s2) == hash(ReindexStrategy(blockwise=None))
+    # already-resolved strategies pass through unchanged
+    assert resolved.set_blockwise_for_numpy() is resolved
 
 
 class TestGroupbyReduceReindexParam:

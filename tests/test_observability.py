@@ -1,0 +1,498 @@
+"""Observability-plane test suite (ISSUE 8).
+
+The contract under test: a live process exposes Prometheus-parseable
+``/metrics`` (counters + cumulative histogram buckets + hbm gauges) plus
+``/healthz``/``/readyz``; a request's trace id appears on every child span
+in both export formats (mesh and streaming paths included, worker threads
+included); ``device.memory_stats()`` sampling feeds the hbm gauges and the
+per-program attribution in ``cache.stats()``; fatal faults and signals
+produce an atomic flight-recorder dump that ``python -m flox_tpu.telemetry
+report`` summarizes; and none of it changes results — the disabled path
+stays a no-op.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import flox_tpu
+from flox_tpu import cache, exposition, telemetry
+from flox_tpu.core import groupby_reduce
+from flox_tpu.parallel import make_mesh
+from flox_tpu.streaming import streaming_groupby_reduce
+
+RNG = np.random.default_rng(7)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Each test starts with telemetry OFF, empty buffers/registries, no
+    flight path, and no readiness — even under the CI instrumented leg."""
+    with flox_tpu.set_options(
+        telemetry=False, telemetry_export_path=None, flight_recorder_path=None
+    ):
+        telemetry.reset()
+        exposition.set_ready(False)
+        yield
+        telemetry.reset()
+    exposition.stop_metrics_server()
+    exposition.set_ready(False)
+
+
+def _run_reduce(**kw):
+    vals = np.random.default_rng(0).normal(size=(3, 48)).astype(np.float64)
+    codes = np.arange(48) % 5
+    return groupby_reduce(vals, codes, func="nanmean", engine="jax", **kw)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def _parse_prometheus(text: str) -> tuple[dict, dict]:
+    """Minimal text-format parser: ``{metric-with-labels: value}`` samples
+    plus ``{metric: type}`` from the # TYPE lines. Raises on anything that
+    is not a comment, a blank, or a ``name{labels} value`` sample — the
+    golden-format guarantee the scrape contract rests on."""
+    samples: dict[str, float] = {}
+    types: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        name_part, _, value_part = line.rpartition(" ")
+        assert name_part, f"unparseable sample line: {line!r}"
+        value = float(value_part)  # raises for malformed values
+        if "{" in name_part:
+            assert name_part.endswith("}"), f"unclosed label set: {line!r}"
+        samples[name_part] = value
+    return samples, types
+
+
+class TestPrometheusExposition:
+    def test_golden_format(self):
+        with flox_tpu.set_options(telemetry=True):
+            _run_reduce()
+            telemetry.METRICS.set_gauge("hbm.bytes_in_use", 12345.0)
+        samples, types = _parse_prometheus(exposition.prometheus_text())
+
+        # counters carry the _total suffix and the counter TYPE
+        assert types["flox_tpu_cache_bundle_calls_total"] == "counter"
+        assert samples["flox_tpu_cache_bundle_calls_total"] >= 1
+        # gauges are plain
+        assert types["flox_tpu_hbm_bytes_in_use"] == "gauge"
+        assert samples["flox_tpu_hbm_bytes_in_use"] == 12345.0
+        # histograms: cumulative buckets over the shared edges + sum/count
+        assert types["flox_tpu_span_ms_groupby_reduce"] == "histogram"
+        buckets = [
+            v for k, v in samples.items()
+            if k.startswith('flox_tpu_span_ms_groupby_reduce_bucket{le="')
+        ]
+        assert len(buckets) == len(telemetry.HIST_EDGES_MS) + 1  # edges + +Inf
+        assert buckets == sorted(buckets), "buckets must be cumulative"
+        assert samples['flox_tpu_span_ms_groupby_reduce_bucket{le="+Inf"}'] == (
+            samples["flox_tpu_span_ms_groupby_reduce_count"]
+        )
+        assert samples["flox_tpu_span_ms_groupby_reduce_sum"] > 0
+
+    def test_name_sanitization(self):
+        with flox_tpu.set_options(telemetry=True):
+            telemetry.METRICS.inc("serve.weird-name.v2")
+        samples, _ = _parse_prometheus(exposition.prometheus_text())
+        assert "flox_tpu_serve_weird_name_v2_total" in samples
+
+
+class TestMetricsServer:
+    def _get(self, port, path):
+        return urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5)
+
+    def test_endpoints(self):
+        with flox_tpu.set_options(telemetry=True):
+            _run_reduce()
+        port = exposition.start_metrics_server(port=0)
+        assert port and port > 0
+        # idempotent: a second start reuses the live endpoint
+        assert exposition.start_metrics_server(port=0) == port
+
+        assert self._get(port, "/healthz").status == 200
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._get(port, "/readyz")
+        assert err.value.code == 503  # not ready until warmup is replayed
+        exposition.set_ready(True)
+        assert self._get(port, "/readyz").status == 200
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._get(port, "/nope")
+        assert err.value.code == 404
+
+        resp = self._get(port, "/metrics")
+        assert resp.status == 200
+        assert "text/plain" in resp.headers["Content-Type"]
+        samples, _ = _parse_prometheus(resp.read().decode())
+        assert samples["flox_tpu_cache_bundle_calls_total"] >= 1
+
+    def test_disabled_by_default_option(self):
+        # OPTIONS["metrics_port"]=0 means no endpoint: the option-driven
+        # start is a no-op returning None
+        assert exposition.start_metrics_server() is None
+
+
+# ---------------------------------------------------------------------------
+# request tracing
+# ---------------------------------------------------------------------------
+
+
+class TestRequestTracing:
+    def test_trace_id_on_every_child_span_mesh_and_streaming(self):
+        mesh = make_mesh()
+        n = 512
+        labels = RNG.integers(0, 5, n)
+        vals = RNG.normal(size=n)
+        with flox_tpu.set_options(telemetry=True):
+            with telemetry.trace("req-mesh-1"):
+                groupby_reduce(vals, labels, func="sum", method="map-reduce", mesh=mesh)
+            with telemetry.trace("req-stream-1"):
+                streaming_groupby_reduce(vals, labels, func="sum", batch_len=128)
+            records = telemetry.drain()
+
+        by_trace: dict = {}
+        for rec in records:
+            by_trace.setdefault(rec.get("trace"), []).append(rec)
+        # no record of either request escaped its trace context
+        assert set(by_trace) <= {"req-mesh-1", "req-stream-1"}
+        mesh_names = {r["name"] for r in by_trace["req-mesh-1"]}
+        assert {"groupby_reduce", "factorize", "combine", "finalize"} <= mesh_names
+        assert any(n.startswith(("program-build", "flox:mesh-dispatch")) for n in mesh_names)
+        stream_names = {r["name"] for r in by_trace["req-stream-1"]}
+        assert {"streaming_groupby_reduce", "factorize", "finalize"} <= stream_names
+        assert any(n.startswith("stream[") for n in stream_names)
+
+    def test_trace_id_in_both_export_formats(self, tmp_path):
+        with flox_tpu.set_options(telemetry=True):
+            with telemetry.trace("req-fmt"):
+                _run_reduce()
+            records = telemetry.spans()
+            jsonl = tmp_path / "t.jsonl"
+            chrome = tmp_path / "t.json"
+            telemetry.export_jsonl(str(jsonl), records)
+            telemetry.export_chrome_trace(str(chrome), records)
+        parsed = [json.loads(line) for line in jsonl.read_text().splitlines()]
+        spans = [r for r in parsed if r.get("type") == "span"]
+        assert spans and all(r["trace"] == "req-fmt" for r in spans)
+        payload = json.loads(chrome.read_text())
+        events = payload["traceEvents"]
+        assert events and all(ev["args"].get("trace_id") == "req-fmt" for ev in events)
+
+    def test_trace_reaches_prefetch_worker_records(self):
+        # retry events fire on the prefetch workers; the stager re-binds the
+        # stream's trace there, so they still carry the request's id
+        from flox_tpu import faults
+
+        n, batch = 512, 128
+        labels = RNG.integers(0, 4, n)
+        vals = RNG.normal(size=n)
+        loader = faults.FlakyLoader(lambda s, e: vals[s:e], {batch: OSError}, times=1)
+        with flox_tpu.set_options(telemetry=True, stream_retries=2, stream_backoff=0.0):
+            with telemetry.trace("req-worker"):
+                streaming_groupby_reduce(
+                    loader, labels, func="sum", batch_len=batch
+                )
+            records = telemetry.drain()
+        retries = [r for r in records if r["name"] == "retry"]
+        assert retries, "the flaky loader must have produced a retry event"
+        assert all(r.get("trace") == "req-worker" for r in retries)
+
+    def test_tail_sampling_keeps_only_slow_traces(self):
+        with flox_tpu.set_options(telemetry=True, telemetry_level="basic"):
+            # seed the running distribution: a fleet of ~100ms requests, so
+            # the p99 the verdict reads is ~100ms
+            for _ in range(30):
+                telemetry.METRICS.observe("trace_ms", 100.0)
+
+            # a FAST trace (well under the p99): detail records dropped
+            with telemetry.trace("fast-req"):
+                t0 = 1.0
+                telemetry.record_span("stage", t0, t0 + 0.001, detail=True)
+            fast_records = telemetry.drain()
+            assert not any(r["name"] == "stage" for r in fast_records)
+            assert telemetry.METRICS.get("telemetry.tail_dropped") >= 1
+
+            # a SLOW trace (blows the running p99): detail records survive,
+            # tagged with the trace id
+            import time as _time
+
+            with telemetry.trace("slow-req"):
+                telemetry.record_span("stage", 1.0, 1.5, detail=True)
+                _time.sleep(0.25)
+            slow_records = telemetry.drain()
+            kept = [r for r in slow_records if r["name"] == "stage"]
+            assert kept and kept[0]["trace"] == "slow-req"
+            assert telemetry.METRICS.get("telemetry.tail_kept") >= 1
+
+    def test_detailed_level_bypasses_parking(self):
+        with flox_tpu.set_options(telemetry=True, telemetry_level="detailed"):
+            with telemetry.trace("det-req"):
+                telemetry.record_span("stage", 1.0, 1.001, detail=True)
+            records = telemetry.drain()
+        assert any(r["name"] == "stage" for r in records)
+
+    def test_serve_request_id_becomes_trace(self):
+        import asyncio
+
+        from flox_tpu.serve import AggregationRequest, Dispatcher
+
+        async def go():
+            dispatcher = Dispatcher()
+            req = AggregationRequest(
+                func="sum",
+                array=np.array([1.0, 2.0, 4.0, 8.0]),
+                by=np.array([0, 0, 1, 1]),
+                request_id="req-serve-7",
+            )
+            result = await dispatcher.submit(req)
+            await dispatcher.close()
+            return result
+
+        with flox_tpu.set_options(telemetry=True):
+            result = asyncio.run(go())
+            records = telemetry.drain()
+        np.testing.assert_allclose(np.asarray(result.result), [3.0, 12.0])
+        execute = [r for r in records if r["name"] == "serve.execute"]
+        core = [r for r in records if r["name"] == "groupby_reduce"]
+        request = [r for r in records if r["name"] == "serve.request"]
+        assert execute and execute[0].get("trace") == "req-serve-7"
+        assert core and core[0].get("trace") == "req-serve-7"
+        assert request and request[0].get("trace") == "req-serve-7"
+
+
+# ---------------------------------------------------------------------------
+# HBM accounting
+# ---------------------------------------------------------------------------
+
+
+class TestHbmAccounting:
+    def test_memory_stats_shape(self):
+        from flox_tpu import device
+
+        stats = device.memory_stats()
+        # CPU backends may report nothing; when they do report, the
+        # aggregate keys are fixed
+        if stats is not None:
+            assert {"bytes_in_use", "peak_bytes_in_use", "devices"} <= set(stats)
+
+    def test_fake_memory_stats_feed_gauges_and_attribution(self, monkeypatch):
+        from flox_tpu import device
+
+        feed = iter([
+            {"bytes_in_use": 1000, "peak_bytes_in_use": 1500},
+            {"bytes_in_use": 800, "peak_bytes_in_use": 1500},
+            {"bytes_in_use": 2000, "peak_bytes_in_use": 2500},
+        ])
+        last = {"bytes_in_use": 500, "peak_bytes_in_use": 2500}
+        monkeypatch.setattr(
+            device, "memory_stats", lambda devices=None: next(feed, last)
+        )
+        with flox_tpu.set_options(telemetry=True):
+            telemetry.sample_hbm(program="prog-a")
+            telemetry.sample_hbm(program="prog-a")
+            telemetry.sample_hbm(program="prog-b")
+            telemetry.sample_hbm()
+        # gauge = latest, peak gauge = running max
+        assert telemetry.METRICS.get("hbm.bytes_in_use") == 500
+        assert telemetry.METRICS.get("hbm.peak_bytes_in_use") == 2500
+        # per-program attribution keeps each program's own max
+        attribution = cache.stats()["hbm_by_program"]
+        assert attribution == {"prog-a": 1000.0, "prog-b": 2000.0}
+        cache.clear_all()
+        assert cache.stats()["hbm_by_program"] == {}
+
+    def test_dispatch_paths_attribute_programs(self, monkeypatch):
+        from flox_tpu import device
+
+        monkeypatch.setattr(
+            device,
+            "memory_stats",
+            lambda devices=None: {"bytes_in_use": 4096, "peak_bytes_in_use": 8192},
+        )
+        with flox_tpu.set_options(telemetry=True):
+            _run_reduce()
+        attribution = cache.stats()["hbm_by_program"]
+        assert any(key.startswith("bundle[") for key in attribution), attribution
+
+    def test_disabled_sampling_is_untouched(self, monkeypatch):
+        from flox_tpu import device
+
+        def boom(devices=None):  # pragma: no cover - must never run
+            raise AssertionError("memory_stats consulted while disabled")
+
+        monkeypatch.setattr(device, "memory_stats", boom)
+        telemetry.sample_hbm(program="nope")
+        assert telemetry.METRICS.snapshot() == {}
+        assert cache.stats()["hbm_by_program"] == {}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        with flox_tpu.set_options(telemetry=True, flight_recorder_size=16):
+            for i in range(64):
+                telemetry.event("tick", i=i)
+            records = telemetry.FLIGHT_RECORDER.records()
+        assert len(records) == 16
+        assert records[-1]["attrs"]["i"] == 63  # newest kept, oldest dropped
+
+    def test_dump_on_fatal_fault_roundtrips_through_report(self, tmp_path, capsys):
+        from flox_tpu.resilience import RetryPolicy, call_with_retry
+
+        dump = tmp_path / "flight.jsonl"
+        with flox_tpu.set_options(telemetry=True, flight_recorder_path=str(dump)):
+            _run_reduce()  # populate the ring with real spans
+
+            def fatal():
+                raise ValueError("programming error")
+
+            with pytest.raises(ValueError, match="programming error"):
+                call_with_retry(fatal, policy=RetryPolicy(retries=3, backoff=0.0))
+        assert dump.exists(), "fatal classification must dump the flight recorder"
+        parsed = [json.loads(line) for line in dump.read_text().splitlines()]
+        header = parsed[0]
+        assert header["name"] == "flight-recorder"
+        assert header["attrs"]["reason"].startswith("fatal:ValueError")
+        names = {r.get("name") for r in parsed}
+        assert "groupby_reduce" in names, "ring must hold the pre-fault spans"
+        assert "fatal" in names, "the fatal event itself must be recorded"
+        assert parsed[-1]["type"] == "counters"
+        # the dump is a valid telemetry export: report exits 0 and
+        # summarizes it
+        assert telemetry.main(["report", str(dump)]) == 0
+        out = capsys.readouterr().out
+        assert "groupby_reduce" in out
+
+    def test_transient_fault_does_not_dump(self, tmp_path):
+        from flox_tpu.resilience import RetryPolicy, call_with_retry
+
+        dump = tmp_path / "flight.jsonl"
+        with flox_tpu.set_options(telemetry=True, flight_recorder_path=str(dump)):
+            calls = {"n": 0}
+
+            def flaky():
+                calls["n"] += 1
+                if calls["n"] < 2:
+                    raise OSError("transient hiccup")
+                return "ok"
+
+            assert call_with_retry(flaky, policy=RetryPolicy(retries=3, backoff=0.0)) == "ok"
+        assert not dump.exists()
+
+    def test_dump_on_signal(self, tmp_path):
+        if not hasattr(signal, "SIGUSR2"):
+            pytest.skip("no SIGUSR2 on this platform")
+        dump = tmp_path / "flight-signal.jsonl"
+        # install_signal_dumps registers BOTH signals: restore both, or the
+        # SIGTERM dump handler leaks into every later test in this process
+        previous = {
+            sig: signal.getsignal(sig) for sig in (signal.SIGTERM, signal.SIGUSR2)
+        }
+        try:
+            with flox_tpu.set_options(telemetry=True, flight_recorder_path=str(dump)):
+                telemetry.event("before-signal")
+                telemetry.install_signal_dumps()
+                os.kill(os.getpid(), signal.SIGUSR2)
+            assert dump.exists()
+            parsed = [json.loads(line) for line in dump.read_text().splitlines()]
+            assert parsed[0]["attrs"]["reason"] == "signal:SIGUSR2"
+            assert any(r.get("name") == "before-signal" for r in parsed)
+        finally:
+            for sig, handler in previous.items():
+                signal.signal(sig, handler)
+
+    def test_unconfigured_dump_is_noop(self):
+        with flox_tpu.set_options(telemetry=True):
+            telemetry.event("something")
+            assert telemetry.flight_dump(reason="no path") is None
+
+
+# ---------------------------------------------------------------------------
+# bit-identity + disabled-path contracts
+# ---------------------------------------------------------------------------
+
+
+class TestPlaneNeutrality:
+    def test_bit_identity_with_plane_enabled(self, tmp_path, monkeypatch):
+        from flox_tpu import device
+
+        expected, groups = _run_reduce()
+        monkeypatch.setattr(
+            device,
+            "memory_stats",
+            lambda devices=None: {"bytes_in_use": 1, "peak_bytes_in_use": 2},
+        )
+        with flox_tpu.set_options(
+            telemetry=True,
+            flight_recorder_path=str(tmp_path / "f.jsonl"),
+        ):
+            port = exposition.start_metrics_server(port=0)
+            with telemetry.trace("bit-req"):
+                got, g2 = _run_reduce()
+            assert (
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5
+                ).status
+                == 200
+            )
+        np.testing.assert_array_equal(np.asarray(expected), np.asarray(got))
+        np.testing.assert_array_equal(np.asarray(groups), np.asarray(g2))
+
+    def test_disabled_path_allocates_nothing(self):
+        # trace() and span() hand back the one shared no-op; the registry,
+        # the buffer, and the flight ring stay untouched
+        assert telemetry.trace("req-x") is telemetry.span("anything")
+        with telemetry.trace("req-x"):
+            _run_reduce()
+        assert telemetry.current_trace() is None
+        assert telemetry.spans() == []
+        assert telemetry.METRICS.snapshot() == {}
+        assert len(telemetry.FLIGHT_RECORDER) == 0
+
+
+class TestNewOptions:
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            {"metrics_port": -1},
+            {"metrics_port": 70000},
+            {"metrics_port": 1.5},
+            {"flight_recorder_path": ""},
+            {"flight_recorder_size": 0},
+            {"flight_recorder_size": True},
+        ],
+    )
+    def test_validated_at_set_time(self, bad):
+        with pytest.raises(ValueError):
+            flox_tpu.set_options(**bad)
+
+    def test_env_mirrors_exist(self):
+        # the FLX010 contract, asserted at runtime too: every new knob has
+        # an env mirror spelled FLOX_TPU_<NAME>
+        import inspect
+
+        from flox_tpu import options as opts
+
+        src = inspect.getsource(opts)
+        for name in ("metrics_port", "flight_recorder_path", "flight_recorder_size"):
+            assert f"FLOX_TPU_{name.upper()}" in src

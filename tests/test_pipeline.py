@@ -278,6 +278,9 @@ def test_clear_all_empties_every_named_cache():
             # telemetry.MetricsRegistry (ISSUE 4): bypasses the enabled()
             # gate on purpose — we are testing the reset, not the gate
             obj.inc("__clear_all_probe__")
+        elif hasattr(obj, "records") and hasattr(obj, "append"):
+            # telemetry._FlightRecorder (ISSUE 8): the bounded ring
+            obj.append({"type": "event", "name": "__clear_all_probe__"})
     cache.clear_all()
 
     checked = 0
@@ -297,6 +300,9 @@ def test_clear_all_empties_every_named_cache():
             checked += 1
         elif hasattr(obj, "inc") and hasattr(obj, "snapshot"):
             assert obj.snapshot() == {}, f"{mod.__name__}.{name} not reset"
+            checked += 1
+        elif hasattr(obj, "records") and hasattr(obj, "append"):
+            assert len(obj) == 0, f"{mod.__name__}.{name} not emptied by clear_all"
             checked += 1
         else:
             raise AssertionError(

@@ -148,9 +148,12 @@ class TestDistributedOrderStats:
         labels = RNG.integers(0, 5, 512)
         vals = RNG.normal(size=512)
         eager, _ = groupby_reduce(vals, labels, func="nanmedian")
-        sharded, _ = groupby_reduce(
-            vals, labels, func="nanmedian", method="cohorts", mesh=mesh
-        )
+        # the reroute is a UserWarning, not a debug log (ADVICE r5): the
+        # caller asked for cohorts BY NAME and must hear it ran map-reduce
+        with pytest.warns(UserWarning, match="no ownership win for order statistics"):
+            sharded, _ = groupby_reduce(
+                vals, labels, func="nanmedian", method="cohorts", mesh=mesh
+            )
         np.testing.assert_array_equal(np.asarray(sharded), np.asarray(eager))
 
     def test_int_dtype(self, mesh):
