@@ -113,18 +113,33 @@ def stats() -> dict:
     from .factorize import _FACTORIZE_CACHE
     from .parallel.mapreduce import _PROGRAM_CACHE
     from .parallel.scan import _SCAN_CACHE
+    from .profiling import capture_active
     from .serve.aot import _MANIFEST_MEMO
     from .serve.dispatcher import _BATCH_REGISTRY, _COALESCE_CACHE, _PENDING_REGISTRY
     from .streaming import _STEP_CACHE
-    from .telemetry import FLIGHT_RECORDER, hbm_by_program
+    from .telemetry import (
+        FLIGHT_RECORDER,
+        cost_by_program,
+        cost_by_tenant,
+        hbm_by_program,
+    )
 
     info = _jitted_bundle.cache_info()
     return {
-        # per-program-key peak HBM (telemetry.sample_hbm attribution): the
-        # operator's answer to "which compiled program is eating the chip"
-        # — read through the locked accessor, never the raw table
+        # per-program-key cost ledger (telemetry.observe_cost): dispatches /
+        # device_ms / bytes / compiles / hbm peak / last slow trace per
+        # compiled-program key, plus the per-tenant axis the serve layer
+        # feeds — read through the locked accessors, never the raw table
+        "cost_by_program": cost_by_program(),
+        "cost_by_tenant": cost_by_tenant(),
+        # per-program-key peak HBM: the hbm_peak column of the ledger, kept
+        # as its own view (the operator's answer to "which compiled program
+        # is eating the chip")
         "hbm_by_program": hbm_by_program(),
         "flight_recorder": len(FLIGHT_RECORDER),
+        # the on-demand capture guard: whether a jax.profiler capture is
+        # running right now (profiling.start_capture / /debug/profile)
+        "profile_capture_active": capture_active() is not None,
         "cohorts": len(_COHORTS_CACHE),
         "factorize": len(_FACTORIZE_CACHE),
         "mesh_programs": len(_PROGRAM_CACHE),
@@ -173,12 +188,19 @@ def clear_all() -> None:
     )
     from .parallel.mapreduce import _PROGRAM_CACHE
     from .parallel.scan import _SCAN_CACHE
-    from .pipeline import _DONATION_OK
+    from .pipeline import _DONATION_OK, _PREFETCH_INFLIGHT
+    from .profiling import _CAPTURE_STATE
     from .resilience import _SNAPSHOTS
     from .serve.aot import _MANIFEST_MEMO
     from .serve.dispatcher import _BATCH_REGISTRY, _COALESCE_CACHE, _PENDING_REGISTRY
     from .streaming import _STEP_CACHE
-    from .telemetry import FLIGHT_RECORDER, METRICS, _HBM_REGISTRY, _TAIL_REGISTRY
+    from .telemetry import (
+        FLIGHT_RECORDER,
+        METRICS,
+        _COST_LEDGER,
+        _TAIL_REGISTRY,
+        _TENANT_LABELS,
+    )
 
     _COHORTS_CACHE.clear()
     _FACTORIZE_CACHE.clear()
@@ -214,10 +236,17 @@ def clear_all() -> None:
     _AUTOTUNE_CACHE.clear()
     _AUTOTUNE_STATE.clear()
     _jitted_bundle.cache_clear()
-    # observability plane (flox_tpu/telemetry.py): the flight-recorder
-    # ring, the per-trace parked tail-detail buffers, and the per-program
-    # HBM attribution table reset with the metrics they annotate
+    # observability plane (flox_tpu/telemetry.py + profiling.py +
+    # pipeline.py): the flight-recorder ring, the per-trace parked
+    # tail-detail buffers, the per-program/per-tenant cost ledger (HBM
+    # attribution absorbed into it), the on-demand-capture guard, and the
+    # prefetch-occupancy gauge counter reset with the metrics they
+    # annotate. METRICS.reset() also drops the histograms' exemplar slots
+    # — they live inside the registry's histogram state.
     FLIGHT_RECORDER.clear()
     _TAIL_REGISTRY.clear()
-    _HBM_REGISTRY.clear()
+    _COST_LEDGER.clear()
+    _TENANT_LABELS.clear()
+    _CAPTURE_STATE.clear()
+    _PREFETCH_INFLIGHT[0] = 0
     METRICS.reset()

@@ -200,17 +200,35 @@ def chunk_reduce(
 
         telemetry.count("cache.bundle_calls")
         bundle = _jitted_bundle(funcs_key, size, engine, trace_fingerprint())
+        tm_on = telemetry.enabled()
+        if tm_on:
+            # cost-ledger baseline: dispatch wall + the jax-compile delta
+            # this bundle call provokes, attributed per program key below.
+            # All of it gated so the disabled hot path reads no clock and
+            # builds no label.
+            from time import perf_counter
+
+            compiles0 = telemetry.METRICS.get("jax.compiles")
+            compile_ms0 = telemetry.METRICS.get("jax.compile_ms")
+            t_dispatch0 = perf_counter()
         with telemetry.span(
             "dispatch", engine=engine, nkernels=len(plan), size=size,
             funcs=[p[0] for p in plan if isinstance(p[0], str)],
         ):
             results = bundle(utils.asarray_device(codes), utils.asarray_device(array))
-        if telemetry.enabled():
+        if tm_on:
             # HBM pressure right after the device dispatch, attributed to
             # this kernel bundle (cache.stats()["hbm_by_program"]); no-op
             # off-device, and the label join costs nothing when off
-            telemetry.sample_hbm(
-                program="bundle[" + "+".join(str(p[0]) for p in plan) + "]"
+            prog = "bundle[" + "+".join(str(p[0]) for p in plan) + "]"
+            telemetry.sample_hbm(program=prog)
+            telemetry.observe_cost(
+                prog,
+                device_ms=(perf_counter() - t_dispatch0) * 1e3,
+                nbytes=int(getattr(array, "nbytes", 0))
+                + int(getattr(codes, "nbytes", 0)),
+                compiles=int(telemetry.METRICS.get("jax.compiles") - compiles0),
+                compile_ms=telemetry.METRICS.get("jax.compile_ms") - compile_ms0,
             )
     else:
         with telemetry.span(

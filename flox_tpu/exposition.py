@@ -12,13 +12,25 @@ nothing but the stdlib:
   shared :data:`~flox_tpu.telemetry.HIST_EDGES_MS` edges plus ``_sum`` /
   ``_count``. Metric names are ``flox_tpu_`` + the registry name with
   non-identifier characters folded to ``_`` (``serve.request_ms`` ->
-  ``flox_tpu_serve_request_ms``).
+  ``flox_tpu_serve_request_ms``). A registry name carrying ``|key=value``
+  suffixes renders as a LABELED series of the base metric
+  (``serve.request_ms|tenant=acme`` -> ``flox_tpu_serve_request_ms
+  {tenant="acme"}``) — the serve layer's per-tenant histograms ride this.
+  Histogram buckets that remember an exemplar (the trace id of the max
+  observation that landed there) emit it OpenMetrics-style after the
+  sample: ``..._bucket{le="1.02"} 7 # {trace_id="req-42"} 0.91``.
 * :class:`MetricsServer` / :func:`start_metrics_server`: a
   ``ThreadingHTTPServer`` on a daemon background thread serving
-  ``/metrics``, ``/healthz`` (200 while the process lives), and
-  ``/readyz`` (200 only after :func:`set_ready` — the serve loop flips it
-  once the AOT warmup manifest has been replayed, so a load balancer never
-  routes traffic to a replica still paying compiles).
+  ``/metrics``, ``/healthz`` (200 while the process lives), ``/readyz``
+  (200 only after :func:`set_ready` — the serve loop flips it once the AOT
+  warmup manifest has been replayed, so a load balancer never routes
+  traffic to a replica still paying compiles), ``/debug/costs`` (the
+  per-program / per-tenant cost ledger as JSON — what ``python -m
+  flox_tpu.telemetry costs`` tabulates), and ``/debug/profile?seconds=N``
+  (starts an on-demand on-chip capture; 409 while one runs, 501 on
+  profiler-less backends). Starting the server seeds the saturation
+  gauges to 0 and starts the opt-in saturation sampler
+  (``OPTIONS["metrics_sample_interval"]``).
 
 Embedded automatically by ``python -m flox_tpu.serve`` when
 ``OPTIONS["metrics_port"]`` (env ``FLOX_TPU_METRICS_PORT``) or
@@ -28,8 +40,10 @@ Embedded automatically by ``python -m flox_tpu.serve`` when
 
 from __future__ import annotations
 
+import json
 import re
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
@@ -66,6 +80,29 @@ def _metric_name(name: str, suffix: str = "") -> str:
     return "flox_tpu_" + _NAME_BAD.sub("_", name) + suffix
 
 
+def _escape_label(value: str) -> str:
+    """A label value escaped per the exposition format (backslash, quote,
+    newline) — shared by the ``|key=value`` labels and the exemplar trace
+    ids, both of which can carry client-supplied strings."""
+    return str(value).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _split_labels(name: str) -> tuple[str, str]:
+    """Split a registry name into (base, rendered label pairs).
+
+    Registry names may carry ``|key=value`` suffixes (the serve layer's
+    ``serve.request_ms|tenant=acme``); each becomes a Prometheus label on
+    the base metric."""
+    base, sep, rest = name.partition("|")
+    if not sep:
+        return base, ""
+    pairs = []
+    for part in rest.split("|"):
+        key, _, value = part.partition("=")
+        pairs.append(f'{_NAME_BAD.sub("_", key)}="{_escape_label(value)}"')
+    return base, ",".join(pairs)
+
+
 def _fmt(value: float) -> str:
     value = float(value)
     if value.is_integer() and abs(value) < 2**63:
@@ -73,47 +110,85 @@ def _fmt(value: float) -> str:
     return repr(value)
 
 
-def prometheus_text() -> str:
+def prometheus_text(exemplars: bool = True) -> str:
     """The telemetry registry in Prometheus text exposition format.
 
     Histogram buckets are cumulative (each ``le`` counts every observation
     at or below that edge), as the format requires — the registry stores
     per-bucket counts, so the walk accumulates. The final shared edge
     absorbs overflow in the registry, so ``le="+Inf"`` equals the total
-    count by construction.
+    count by construction. ``|key=value`` registry-name suffixes become
+    labels (one TYPE line per base metric, however many labeled series).
+
+    With ``exemplars`` (the default for programmatic callers), bucket
+    lines carrying an exemplar append it OpenMetrics-style after the
+    sample value. The classic text format (version 0.0.4, what a default
+    Prometheus scrape parses) does NOT allow exemplars — a scrape would
+    abort on the first one — so the HTTP handler serves them only when the
+    scraper asks (``/metrics?exemplars=1``), keeping the default scrape
+    spec-clean.
     """
     from .telemetry import HIST_EDGES_MS, METRICS
 
     lines: list[str] = []
+    typed: set[str] = set()
+
+    def _type_line(metric: str, kind: str) -> None:
+        if metric not in typed:
+            typed.add(metric)
+            lines.append(f"# TYPE {metric} {kind}")
+
     for name, value in sorted(METRICS.counters().items()):
-        metric = _metric_name(name, "_total")
-        lines += [f"# TYPE {metric} counter", f"{metric} {_fmt(value)}"]
+        base, labels = _split_labels(name)
+        metric = _metric_name(base, "_total")
+        _type_line(metric, "counter")
+        label_str = f"{{{labels}}}" if labels else ""
+        lines.append(f"{metric}{label_str} {_fmt(value)}")
     for name, value in sorted(METRICS.gauges().items()):
-        metric = _metric_name(name)
-        lines += [f"# TYPE {metric} gauge", f"{metric} {_fmt(value)}"]
+        base, labels = _split_labels(name)
+        metric = _metric_name(base)
+        _type_line(metric, "gauge")
+        label_str = f"{{{labels}}}" if labels else ""
+        lines.append(f"{metric}{label_str} {_fmt(value)}")
     for name, hist in sorted(METRICS.histograms().items()):
-        metric = _metric_name(name)
-        lines.append(f"# TYPE {metric} histogram")
+        base, labels = _split_labels(name)
+        metric = _metric_name(base)
+        _type_line(metric, "histogram")
+        prefix = f"{labels}," if labels else ""
+        suffix_labels = f"{{{labels}}}" if labels else ""
+        slots = (hist.get("exemplars") or {}) if exemplars else {}
         cum = 0
-        for edge, n in zip(HIST_EDGES_MS, hist["counts"]):
+        for i, (edge, n) in enumerate(zip(HIST_EDGES_MS, hist["counts"])):
             cum += n
-            lines.append(f'{metric}_bucket{{le="{_fmt(edge)}"}} {cum}')
-        lines.append(f'{metric}_bucket{{le="+Inf"}} {hist["count"]}')
-        lines.append(f"{metric}_sum {_fmt(hist['sum'])}")
-        lines.append(f"{metric}_count {hist['count']}")
+            line = f'{metric}_bucket{{{prefix}le="{_fmt(edge)}"}} {cum}'
+            slot = slots.get(i)
+            if slot is not None:
+                # OpenMetrics exemplar: the trace id of the max observation
+                # that landed in THIS bucket — the p99 row names its
+                # request. Escaped: trace ids are client-supplied strings.
+                line += f' # {{trace_id="{_escape_label(slot[0])}"}} {_fmt(slot[1])}'
+            lines.append(line)
+        lines.append(f'{metric}_bucket{{{prefix}le="+Inf"}} {hist["count"]}')
+        lines.append(f"{metric}_sum{suffix_labels} {_fmt(hist['sum'])}")
+        lines.append(f"{metric}_count{suffix_labels} {hist['count']}")
     return "\n".join(lines) + "\n"
 
 
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 — http.server's naming contract
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
             # count actual scrapes only — health/readiness probes arrive at
             # probe rate and would swamp the number otherwise
             from .telemetry import METRICS
 
             METRICS.inc("metrics.scrapes")
-            body = prometheus_text().encode()
+            # exemplars only on request: the classic 0.0.4 text parser (a
+            # default Prometheus scrape) aborts the whole scrape on an
+            # exemplar, so the plain endpoint must stay spec-clean
+            params = urllib.parse.parse_qs(query)
+            with_exemplars = params.get("exemplars", ["0"])[0] == "1"
+            body = prometheus_text(exemplars=with_exemplars).encode()
             status, ctype = 200, "text/plain; version=0.0.4; charset=utf-8"
         elif path == "/healthz":
             body, status, ctype = b"ok\n", 200, "text/plain; charset=utf-8"
@@ -123,6 +198,12 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 body, status = b"warming\n", 503
             ctype = "text/plain; charset=utf-8"
+        elif path == "/debug/costs":
+            body, status = self._costs()
+            ctype = "application/json; charset=utf-8"
+        elif path == "/debug/profile":
+            body, status = self._profile(query)
+            ctype = "application/json; charset=utf-8"
         else:
             body, status, ctype = b"not found\n", 404, "text/plain; charset=utf-8"
         self.send_response(status)
@@ -130,6 +211,46 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    @staticmethod
+    def _costs() -> tuple[bytes, int]:
+        """The cost ledger as JSON — the machine-readable face of
+        ``cache.stats()["cost_by_program"]`` (``python -m flox_tpu.telemetry
+        costs <scrape>`` tabulates exactly this payload)."""
+        from . import telemetry
+
+        payload = {
+            "cost_by_program": telemetry.cost_by_program(),
+            "cost_by_tenant": telemetry.cost_by_tenant(),
+            "hbm_by_program": telemetry.hbm_by_program(),
+        }
+        return (json.dumps(payload, default=str) + "\n").encode(), 200
+
+    @staticmethod
+    def _profile(query: str) -> tuple[bytes, int]:
+        """Start an on-demand on-chip capture (``?seconds=N``, default 5).
+
+        202 with the capture dir on success (the stop runs on a timer
+        thread — the reply never blocks behind the window), 409 while a
+        capture is already running, 501 when the backend has no profiler
+        or no capture root is configured, 400 for a bad window. Never
+        raises into the serve loop."""
+        from . import profiling
+
+        try:
+            params = urllib.parse.parse_qs(query)
+            seconds = float(params.get("seconds", ["5"])[0])
+            capture_dir = profiling.start_capture(seconds=seconds)
+        except profiling.CaptureBusyError as exc:
+            return (json.dumps({"ok": False, "error": str(exc)}) + "\n").encode(), 409
+        except profiling.CaptureUnavailableError as exc:
+            return (json.dumps({"ok": False, "error": str(exc)}) + "\n").encode(), 501
+        except (ValueError, TypeError) as exc:
+            return (json.dumps({"ok": False, "error": str(exc)}) + "\n").encode(), 400
+        except Exception as exc:  # noqa: BLE001 — observability never kills serving
+            return (json.dumps({"ok": False, "error": str(exc)}) + "\n").encode(), 500
+        payload = {"ok": True, "dir": capture_dir, "seconds": seconds}
+        return (json.dumps(payload) + "\n").encode(), 202
 
     def log_message(self, format: str, *args: Any) -> None:
         # a probe every few seconds must not spam stderr; scrape counts
@@ -175,18 +296,30 @@ def start_metrics_server(port: int | None = None, host: str = "127.0.0.1") -> in
         port = OPTIONS["metrics_port"]
         if not port:
             return None
+    from . import telemetry
+
     with _STATE_LOCK:
         server = _SERVER_STATE["server"]
-        if server is not None:
-            return server.port
-        server = MetricsServer(int(port), host=host)
-        _SERVER_STATE["server"] = server
-        return server.port
+        if server is None:
+            server = MetricsServer(int(port), host=host)
+            _SERVER_STATE["server"] = server
+    # a freshly booted replica must EXPOSE the saturation series before its
+    # first request — an absent gauge reads as a broken scrape, a zero
+    # reads as idle. Idempotent (live values are never rewound), and the
+    # opt-in sampler (OPTIONS["metrics_sample_interval"]) starts with the
+    # endpoint it feeds.
+    telemetry.seed_saturation_gauges()
+    telemetry.start_saturation_sampler()
+    return server.port
 
 
 def stop_metrics_server() -> None:
     """Shut the endpoint down (tests; the serve loop just exits — the
-    thread is a daemon). Readiness resets with it."""
+    thread is a daemon). Readiness and the saturation sampler reset with
+    it."""
+    from . import telemetry
+
+    telemetry.stop_saturation_sampler()
     with _STATE_LOCK:
         server = _SERVER_STATE.pop("server", None)
         _SERVER_STATE["server"] = None

@@ -288,6 +288,26 @@ OPTIONS: dict[str, Any] = {
     # how many recent records the flight-recorder ring retains (a bounded
     # deque — fixed allocation, the oldest record falls out first)
     "flight_recorder_size": _env_int("FLOX_TPU_FLIGHT_RECORDER_SIZE", 2048, 16, 1_000_000),
+    # On-chip profiling (flox_tpu/profiling.py): default capture root for
+    # profiling.trace() and the on-demand capture surface (/debug/profile,
+    # the serve "profile" op, SIGUSR1). Captures rotate inside this
+    # directory (profile_keep bounds how many are retained). None means no
+    # default root — trace() then requires an explicit logdir and the
+    # on-demand capture answers "unconfigured".
+    "profile_dir": os.environ.get("FLOX_TPU_PROFILE_DIR") or None,
+    # how many rotated captures profile_dir retains: starting capture K+1
+    # deletes the oldest, so an operator poking /debug/profile in a loop
+    # can never fill the disk
+    "profile_keep": _env_int("FLOX_TPU_PROFILE_KEEP", 8, 1, 1024),
+    # Saturation sampler (flox_tpu/telemetry.py): seconds between samples
+    # of the live saturation gauges (serve.queue_depth, serve.inflight
+    # batches, stream.prefetch_occupancy, periodic device.memory_stats()).
+    # 0 (the default) keeps the daemon thread off — /metrics then shows
+    # only the post-hoc histograms; nonzero makes saturation visible
+    # BETWEEN requests, which is when an operator is staring at a stall.
+    "metrics_sample_interval": _env_float(
+        "FLOX_TPU_METRICS_SAMPLE_INTERVAL", 0.0, 0.0, 3600.0
+    ),
 }
 
 # single source of truth for the accumulation disciplines — referenced by
@@ -353,6 +373,14 @@ _VALIDATORS = {
         isinstance(x, (str, os.PathLike)) and bool(str(x))
     ),
     "flight_recorder_size": lambda x: _is_int(x) and 16 <= x <= 1_000_000,
+    # cost/profiling-plane knobs: same at-set-time discipline — an empty
+    # capture root or a negative sampling interval raises here, not inside
+    # the capture thread or the sampler daemon
+    "profile_dir": lambda x: x is None or (
+        isinstance(x, (str, os.PathLike)) and bool(str(x))
+    ),
+    "profile_keep": lambda x: _is_int(x) and 1 <= x <= 1024,
+    "metrics_sample_interval": lambda x: _is_finite_num(x) and 0 <= x <= 3600,
 }
 
 # rebind the literal through the overlay-aware view: same object contents,

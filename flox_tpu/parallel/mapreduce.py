@@ -30,6 +30,7 @@ reduction with the collectives.
 from __future__ import annotations
 
 import functools
+from time import perf_counter
 from typing import Any
 
 import numpy as np
@@ -494,6 +495,15 @@ def sharded_groupby_reduce(
     )
     from .. import telemetry
 
+    tm_on = telemetry.enabled()
+    if tm_on:
+        # cost-ledger baseline: dispatch wall + the jax-compile delta this
+        # mesh dispatch provokes (the build path's first run pays the
+        # trace+compile; the hit path should read ~0 compiles)
+        compiles0 = telemetry.METRICS.get("jax.compiles")
+        compile_ms0 = telemetry.METRICS.get("jax.compile_ms")
+        t_dispatch0 = perf_counter()
+
     fn = _PROGRAM_CACHE.get(cache_key)
     if fn is None:
         telemetry.count("cache.program_misses")
@@ -522,18 +532,25 @@ def sharded_groupby_reduce(
                 "program-build", agg=agg.name, method=method, ndev=ndev, size=size
             ):
                 result = fn(arr, codes_dev)
-        if telemetry.enabled():
-            telemetry.sample_hbm(program=f"mesh[{agg.name}/{method}]")
-        return result
-    telemetry.count("cache.program_hits")
-    # the annotation makes the SPMD dispatch visible inside xprof device
-    # traces (jax.profiler.TraceAnnotation) as well as in our own trace
-    with telemetry.annotated(
-        f"flox:mesh-dispatch[{agg.name}/{method}]", ndev=ndev, size=size
-    ):
-        result = fn(arr, codes_dev)
-    if telemetry.enabled():
-        telemetry.sample_hbm(program=f"mesh[{agg.name}/{method}]")
+    else:
+        telemetry.count("cache.program_hits")
+        # the annotation makes the SPMD dispatch visible inside xprof device
+        # traces (jax.profiler.TraceAnnotation) as well as in our own trace
+        with telemetry.annotated(
+            f"flox:mesh-dispatch[{agg.name}/{method}]", ndev=ndev, size=size
+        ):
+            result = fn(arr, codes_dev)
+    if tm_on:
+        prog = f"mesh[{agg.name}/{method}]"
+        telemetry.sample_hbm(program=prog)
+        telemetry.observe_cost(
+            prog,
+            device_ms=(perf_counter() - t_dispatch0) * 1e3,
+            nbytes=int(getattr(arr, "nbytes", 0))
+            + int(getattr(codes_dev, "nbytes", 0)),
+            compiles=int(telemetry.METRICS.get("jax.compiles") - compiles0),
+            compile_ms=telemetry.METRICS.get("jax.compile_ms") - compile_ms0,
+        )
     return result
 
 

@@ -69,6 +69,26 @@ __all__ = [
 # cache.clear_all with the other module-level caches.
 _DONATION_OK: dict[str, bool] = {}
 
+# process-wide prefetch-pool occupancy: slabs currently in flight (staging
+# or staged-awaiting-consumption) summed over every live _SlabPrefetcher.
+# The saturation sampler (telemetry.sample_saturation) publishes it as the
+# stream.prefetch_occupancy gauge — a drained pool under a stalled stream
+# is the "loader-bound" verdict at a glance. Single-element list so
+# cache.clear_all can reset it in place (same idiom as
+# factorize._FACTORIZE_CACHE_BYTES).
+_PREFETCH_INFLIGHT: list[int] = [0]
+_PREFETCH_LOCK = threading.Lock()
+
+
+def prefetch_occupancy() -> int:
+    """How many slabs the prefetch pools hold in flight right now."""
+    return max(0, _PREFETCH_INFLIGHT[0])
+
+
+def _prefetch_track(delta: int) -> None:
+    with _PREFETCH_LOCK:
+        _PREFETCH_INFLIGHT[0] += delta
+
 
 @dataclass
 class Slab:
@@ -314,6 +334,17 @@ def stream_slabs(
     else:
         source = (stage(i) for i in order)
 
+    from . import telemetry
+
+    # cost-ledger baseline: compiles the pass provokes are the delta of the
+    # process-wide jax counters across it. tm_cost remembers whether the
+    # baseline was actually taken — telemetry toggled on mid-stream must
+    # not attribute the process-lifetime compile totals to this one pass
+    compiles0 = compile_ms0 = 0.0
+    tm_cost = telemetry.enabled()
+    if tm_cost:
+        compiles0 = telemetry.METRICS.get("jax.compiles")
+        compile_ms0 = telemetry.METRICS.get("jax.compile_ms")
     t_begin = perf_counter()
     try:
         while True:
@@ -342,22 +373,31 @@ def stream_slabs(
         # feed the autotune store (record-only safe): throughput per
         # prefetch depth and slab band, plus the overlap fraction — the
         # StreamReport signal ROADMAP item 4 names
+        nbytes_staged = 0
         if report.slabs and stager._dtype0 is not None:
             from .autotune import observe_stream
 
             lead_elems = int(np.prod(lead_shape)) if lead_shape else 1
             span_elems = lead_elems * sum(s.stop - s.start for s in report.slabs)
-            observe_stream(
-                report,
-                nbytes=span_elems * np.dtype(stager._dtype0).itemsize,
-                nelems=n * lead_elems,
-            )
-        from . import telemetry
-
+            nbytes_staged = span_elems * np.dtype(stager._dtype0).itemsize
+            observe_stream(report, nbytes=nbytes_staged, nelems=n * lead_elems)
         if telemetry.enabled():
+            prog = f"stream[{label}]" if label else "stream"
             # HBM pressure right after the pass — in-flight slabs + carry
             # state is exactly when a streaming run's footprint peaks
-            telemetry.sample_hbm(program=f"stream[{label}]" if label else "stream")
+            telemetry.sample_hbm(program=prog)
+            # the pass's row in the cost ledger: dispatch wall (the
+            # device-time proxy), bytes staged, compiles provoked. Only
+            # when the baseline was taken at pass start (tm_cost) — else
+            # the compile delta would be the process-lifetime totals.
+            if tm_cost:
+                telemetry.observe_cost(
+                    prog,
+                    device_ms=report.dispatch_ms,
+                    nbytes=nbytes_staged,
+                    compiles=int(telemetry.METRICS.get("jax.compiles") - compiles0),
+                    compile_ms=telemetry.METRICS.get("jax.compile_ms") - compile_ms0,
+                )
             # one span per streaming pass, carrying the StreamReport totals
             # as attributes — the report object stays the programmatic API,
             # the span is its trace-file view
@@ -406,6 +446,7 @@ class _SlabPrefetcher:
         except StopIteration:
             return
         self._pending.append(self._pool.submit(self._stage, i))
+        _prefetch_track(1)
 
     def __iter__(self) -> "_SlabPrefetcher":
         return self
@@ -415,6 +456,7 @@ class _SlabPrefetcher:
             self.close()
             raise StopIteration
         fut = self._pending.popleft()
+        _prefetch_track(-1)
         self._submit_next()
         try:
             return fut.result()
@@ -429,6 +471,7 @@ class _SlabPrefetcher:
             return
         for fut in self._pending:
             fut.cancel()
+        _prefetch_track(-len(self._pending))
         self._pending.clear()
         self._pool.shutdown(wait=False, cancel_futures=True)
         self._pool = None

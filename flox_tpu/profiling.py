@@ -7,36 +7,238 @@ reduction without learning the jax API, plus the streaming-pipeline
 instrumentation (:func:`stream_monitor`): every ``streaming_groupby_*``
 call emits one :class:`StreamReport` of per-slab load/stage/wait/dispatch
 timings from which the prefetch overlap is read directly.
+
+On-demand capture (ISSUE 9): a serving replica cannot wrap its hot loop in
+a ``with trace(...)`` block after the fact — the moment an operator wants a
+device profile is exactly while the process is misbehaving. The capture
+surface (:func:`start_capture`) starts a ``jax.profiler`` trace into a
+rotated directory under ``OPTIONS["profile_dir"]`` and stops it after N
+seconds on a timer thread, one capture at a time; it is reachable over
+HTTP (``/debug/profile?seconds=N`` on the metrics endpoint), over the
+serve protocol (``{"op": "profile"}``) and via SIGUSR1
+(:func:`install_capture_signal`), and never raises into the serve loop.
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Iterator
 
 logger = logging.getLogger("flox_tpu.profiling")
 
-__all__ = ["trace", "annotate", "timed", "stream_monitor", "StreamReport"]
+__all__ = [
+    "trace",
+    "annotate",
+    "timed",
+    "stream_monitor",
+    "StreamReport",
+    "CaptureBusyError",
+    "CaptureUnavailableError",
+    "capture_active",
+    "install_capture_signal",
+    "start_capture",
+]
+
+
+class CaptureBusyError(RuntimeError):
+    """A capture is already running — one at a time (the profiler is a
+    process-global singleton; HTTP answers 409)."""
+
+
+class CaptureUnavailableError(RuntimeError):
+    """No capture is possible: the backend has no profiler, or no capture
+    root is configured (HTTP answers 501)."""
+
+
+def _default_logdir() -> Any:
+    from .options import OPTIONS
+
+    return OPTIONS["profile_dir"]
 
 
 @contextlib.contextmanager
-def trace(logdir: str):
+def trace(logdir: str | None = None):
     """Capture a jax profiler trace (view with TensorBoard / xprof).
+
+    ``logdir`` defaults to ``OPTIONS["profile_dir"]`` (env
+    ``FLOX_TPU_PROFILE_DIR``) — the same root the on-demand capture surface
+    rotates under; with neither configured this raises ``ValueError``. A
+    backend without a working profiler warns and no-ops instead of raising:
+    the block still runs, only the trace is missing.
 
     >>> with flox_tpu.profiling.trace("/tmp/flox-trace"):  # doctest: +SKIP
     ...     groupby_reduce(...)
     """
     import jax
 
-    jax.profiler.start_trace(logdir)
+    if logdir is None:
+        logdir = _default_logdir()
+    if logdir is None:
+        raise ValueError(
+            "profiling.trace() needs a logdir: pass one explicitly or set "
+            "OPTIONS['profile_dir'] (env FLOX_TPU_PROFILE_DIR)"
+        )
+    logdir = str(logdir)
+    try:
+        jax.profiler.start_trace(logdir)
+    except Exception as exc:  # noqa: BLE001 — a profiler-less backend must
+        # not take the profiled workload down with it: warn and run untraced
+        logger.warning("profiler unavailable, running untraced: %s", exc)
+        yield
+        return
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
-        logger.info("profiler trace written to %s", logdir)
+        try:
+            jax.profiler.stop_trace()
+            logger.info("profiler trace written to %s", logdir)
+        except Exception as exc:  # noqa: BLE001 — same contract as start
+            logger.warning("profiler stop failed: %s", exc)
+
+
+# ---------------------------------------------------------------------------
+# on-demand capture: bounded, rotated, one at a time
+# ---------------------------------------------------------------------------
+
+
+#: capture-state guard: ``{"active": {...}}`` while a capture runs (dir /
+#: seconds / started), plus the rotation sequence counter. One capture at a
+#: time — the jax profiler is process-global. Every accessor reads through
+#: ``.get()`` with a default, so the empty dict is the reset state
+#: (registered in cache.clear_all; a clear during a live capture only
+#: forgets the guard — the timer thread still stops the profiler).
+_CAPTURE_STATE: dict[str, Any] = {}
+_CAPTURE_LOCK = threading.Lock()
+
+
+def capture_active() -> dict | None:
+    """A copy of the live capture's info (dir/seconds/started), or ``None``."""
+    with _CAPTURE_LOCK:
+        active = _CAPTURE_STATE.get("active")
+        return dict(active) if active else None
+
+
+def _rotate_captures(root: Any, keep: int) -> None:
+    """Delete the oldest ``capture-*`` dirs so at most ``keep - 1`` remain
+    before a new one is created — an operator poking ``/debug/profile`` in
+    a loop must never fill the disk. Timestamped names sort chronologically."""
+    import os
+    import shutil
+
+    try:
+        entries = sorted(
+            e for e in os.listdir(str(root)) if e.startswith("capture-")
+        )
+    except OSError:
+        return
+    excess = len(entries) - (keep - 1)
+    for stale in entries[:excess] if excess > 0 else []:
+        shutil.rmtree(os.path.join(str(root), stale), ignore_errors=True)
+
+
+def start_capture(seconds: float = 5.0, root: Any = None) -> str:
+    """Start an on-chip profiler capture; stop it after ``seconds``.
+
+    The capture lands in a fresh ``capture-<stamp>-<seq>`` dir under
+    ``root`` (default ``OPTIONS["profile_dir"]``), with old captures
+    rotated out past ``OPTIONS["profile_keep"]``. Returns the capture dir
+    immediately — the stop runs on a daemon timer thread, so the caller
+    (the HTTP handler, the serve loop, a signal handler's helper thread)
+    never blocks behind the capture window. Raises
+    :class:`CaptureBusyError` while another capture runs,
+    :class:`CaptureUnavailableError` when no root is configured or the
+    backend has no working profiler, ``ValueError`` for a bad window.
+    """
+    import os
+
+    from . import telemetry
+    from .options import OPTIONS
+
+    seconds = float(seconds)
+    if not 0 < seconds <= 3600:
+        raise ValueError(f"capture window must be in (0, 3600] seconds, got {seconds}")
+    if root is None:
+        root = OPTIONS["profile_dir"]
+    if root is None:
+        raise CaptureUnavailableError(
+            "no capture root configured: set OPTIONS['profile_dir'] "
+            "(env FLOX_TPU_PROFILE_DIR)"
+        )
+    with _CAPTURE_LOCK:
+        if _CAPTURE_STATE.get("active"):
+            raise CaptureBusyError(
+                f"capture already running in {_CAPTURE_STATE['active']['dir']}"
+            )
+        seq = _CAPTURE_STATE.get("seq", 0) + 1
+        _CAPTURE_STATE["seq"] = seq
+        os.makedirs(str(root), exist_ok=True)
+        _rotate_captures(root, int(OPTIONS["profile_keep"]))
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        capture_dir = os.path.join(str(root), f"capture-{stamp}-{seq:03d}")
+        try:
+            import jax
+
+            jax.profiler.start_trace(capture_dir)
+        except Exception as exc:  # noqa: BLE001 — no profiler on this backend
+            raise CaptureUnavailableError(f"profiler unavailable: {exc}") from exc
+        _CAPTURE_STATE["active"] = {
+            "dir": capture_dir, "seconds": seconds, "started": time.time(),
+        }
+
+    def _finish() -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            logger.info("on-demand capture written to %s", capture_dir)
+        except Exception as exc:  # noqa: BLE001 — stopping is best-effort;
+            # the guard must clear either way or no capture ever runs again
+            logger.warning("on-demand capture stop failed: %s", exc)
+        with _CAPTURE_LOCK:
+            if _CAPTURE_STATE.get("active", {}).get("dir") == capture_dir:
+                _CAPTURE_STATE.pop("active", None)
+        telemetry.count("profile.captures")
+        telemetry.event("profile.capture", dir=capture_dir, seconds=seconds)
+
+    timer = threading.Timer(seconds, _finish)
+    timer.daemon = True
+    timer.start()
+    telemetry.count("profile.capture_starts")
+    return capture_dir
+
+
+def install_capture_signal() -> None:
+    """SIGUSR1 -> a 5-second on-demand capture into the configured root.
+
+    Signal-safe: the handler only spawns a daemon thread (no profiler work,
+    no locks in the interrupted frame) and never raises — a busy or
+    unconfigured capture is a log line, not a crash. No-op on platforms
+    without SIGUSR1 or off the main thread."""
+    import signal
+
+    signum = getattr(signal, "SIGUSR1", None)
+    if signum is None:
+        return
+
+    def _capture_bg() -> None:
+        try:
+            start_capture(seconds=5.0)
+        except (CaptureBusyError, CaptureUnavailableError, ValueError) as exc:
+            logger.warning("SIGUSR1 capture not started: %s", exc)
+
+    def _handler(signum: int, frame: Any) -> None:
+        threading.Thread(
+            target=_capture_bg, name="flox-tpu-capture", daemon=True
+        ).start()
+
+    try:
+        signal.signal(signum, _handler)
+    except (ValueError, OSError):  # not the main thread / exotic platform
+        return
 
 
 def annotate(name: str):

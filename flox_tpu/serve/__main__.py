@@ -20,9 +20,18 @@ Control lines use ``op`` instead of ``func``:
 * ``{"op": "warmup"}`` — replay the AOT manifest (:func:`serve.aot.warmup`);
   responds with ``{"warmed": N, "compiles": <jax.compiles so far>}``.
 * ``{"op": "stats"}`` — cache.stats() + the telemetry counter snapshot
-  (``jax.compiles`` included: the two-process AOT smoke asserts on it).
+  (``jax.compiles`` included: the two-process AOT smoke asserts on it;
+  the per-program/per-tenant cost ledger rides ``cache.cost_by_program`` /
+  ``cache.cost_by_tenant``).
+* ``{"op": "profile", "seconds": N}`` — start an on-demand on-chip capture
+  into ``OPTIONS["profile_dir"]`` (409-equivalent ``"busy"`` while one
+  runs, ``"unavailable"`` on profiler-less backends).
 * ``{"op": "drain"}`` — wait for every in-flight request before reading on
   (scripted runs use it to sequence assertions).
+
+Request lines may carry a ``"tenant"`` tag: it feeds the per-tenant cost
+ledger and a ``serve.request_ms{tenant=...}`` histogram on /metrics
+without affecting coalescing or results.
 
 The loop exits at EOF after draining in-flight work. Malformed lines get
 an ``ok: false`` response with ``error: "protocol"`` — one bad client line
@@ -46,6 +55,7 @@ _REQUEST_FIELDS = frozenset(
     {
         "func", "array", "by", "expected_groups", "fill_value", "dtype",
         "min_count", "engine", "finalize_kwargs", "options", "deadline",
+        "tenant",
     }
 )
 
@@ -159,6 +169,28 @@ async def _amain(args: argparse.Namespace) -> int:
             op = msg.get("op")
             if op == "stats":
                 _emit({"op": "stats", **_counters()})
+            elif op == "profile":
+                # on-demand on-chip capture: starts immediately, stops on a
+                # timer thread — the serve loop never blocks behind the
+                # window, and a busy/unavailable capture is an answer, not
+                # a crash (same contract as /debug/profile)
+                from .. import profiling
+
+                try:
+                    capture_dir = profiling.start_capture(
+                        seconds=float(msg.get("seconds", 5.0))
+                    )
+                except profiling.CaptureBusyError as exc:
+                    _emit({"op": "profile", "ok": False, "error": "busy",
+                           "message": str(exc)})
+                except profiling.CaptureUnavailableError as exc:
+                    _emit({"op": "profile", "ok": False, "error": "unavailable",
+                           "message": str(exc)})
+                except (ValueError, TypeError) as exc:
+                    _emit({"op": "profile", "ok": False, "error": "protocol",
+                           "message": str(exc)})
+                else:
+                    _emit({"op": "profile", "ok": True, "dir": capture_dir})
             elif op == "warmup":
                 warmed = await asyncio.to_thread(aot.warmup)
                 exposition.set_ready(True)
@@ -219,12 +251,14 @@ def main(argv: list[str] | None = None) -> int:
         "suits sidecar scrapers; pass 0.0.0.0 for a remote Prometheus",
     )
     args = parser.parse_args(argv)
-    from .. import telemetry
+    from .. import profiling, telemetry
 
     # SIGTERM/SIGUSR2 leave a flight-recorder dump (no-op unless telemetry
-    # + FLOX_TPU_FLIGHT_RECORDER_PATH are configured); must be installed on
-    # the main thread, before the loop starts
+    # + FLOX_TPU_FLIGHT_RECORDER_PATH are configured); SIGUSR1 starts an
+    # on-demand on-chip capture into OPTIONS["profile_dir"]. Both must be
+    # installed on the main thread, before the loop starts
     telemetry.install_signal_dumps()
+    profiling.install_capture_signal()
     try:
         return asyncio.run(_amain(args))
     except Exception as exc:

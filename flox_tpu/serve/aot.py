@@ -37,6 +37,7 @@ import json
 import logging
 import os
 import threading
+import time
 from pathlib import Path
 from typing import Any
 
@@ -290,6 +291,13 @@ def warmup(path: Any = None) -> int:
             specs = list(_MANIFEST_MEMO.values())
         from ..core import groupby_reduce
 
+        # captured ONCE: telemetry toggled on mid-warmup must not make the
+        # post-replay block read baselines that were never taken
+        tm_on = telemetry.enabled()
+        if tm_on:
+            compiles0 = telemetry.METRICS.get("jax.compiles")
+            compile_ms0 = telemetry.METRICS.get("jax.compile_ms")
+            t_warm0 = time.perf_counter()
         warmed = 0
         for spec in specs:
             try:
@@ -306,4 +314,15 @@ def warmup(path: Any = None) -> int:
         # warmup just materialized every program the replica will serve:
         # its HBM mark is the replica's standing footprint before traffic
         telemetry.sample_hbm(program="serve.warmup")
+        if tm_on:
+            # warmup's ledger row: the replica's startup cost in one place
+            # (a warm AOT dir reads compiles == 0 here — the acceptance
+            # criterion — a cold one shows exactly what the fleet paid)
+            telemetry.observe_cost(
+                "serve.warmup",
+                dispatches=warmed,
+                device_ms=(time.perf_counter() - t_warm0) * 1e3,
+                compiles=int(telemetry.METRICS.get("jax.compiles") - compiles0),
+                compile_ms=telemetry.METRICS.get("jax.compile_ms") - compile_ms0,
+            )
     return warmed

@@ -108,6 +108,12 @@ class AggregationRequest:
     #: to ``OPTIONS["serve_deadline"]`` (0 there = no deadline)
     deadline: float | None = None
     request_id: str | None = None
+    #: optional cost-attribution tag: requests carrying one feed the
+    #: per-tenant cost ledger (``cache.stats()["cost_by_tenant"]``) and a
+    #: tenant-labeled ``serve.request_ms{tenant=...}`` latency histogram on
+    #: /metrics. Attribution only — a tenant tag never changes the program
+    #: key, so tagged and untagged requests still coalesce/batch together.
+    tenant: str | None = None
 
 
 @dataclass
@@ -401,8 +407,31 @@ class Dispatcher:
         # clamped: a request that attached to an ALREADY-dispatched leaf
         # waited 0, not a negative interval (t_dispatch predates its t0)
         queue_ms = max(0.0, ((leaf.t_dispatch or t1) - t0) * 1e3)
-        METRICS.observe("serve.request_ms", (t1 - t0) * 1e3)
-        METRICS.observe("serve.queue_ms", queue_ms)
+        request_ms = (t1 - t0) * 1e3
+        METRICS.observe("serve.request_ms", request_ms, exemplar=request.request_id)
+        METRICS.observe("serve.queue_ms", queue_ms, exemplar=request.request_id)
+        if request.tenant is not None:
+            # the tenant axis: a labeled latency series on /metrics plus a
+            # cost-ledger row. The raw tag is client-supplied, so it goes
+            # through tenant_label: unsafe characters fold away (no label
+            # injection into the exposition) and distinct labels are
+            # cardinality-capped (past the cap, "_other"). A coalesced /
+            # batched request is billed its SHARE of the shared dispatch's
+            # wall — dividing by the leaves dispatched together and this
+            # leaf's waiters keeps tenant totals summing to the program
+            # walls instead of multiplying them.
+            label = telemetry.tenant_label(request.tenant)
+            METRICS.observe(
+                f"serve.request_ms|tenant={label}",
+                request_ms,
+                exemplar=request.request_id,
+            )
+            telemetry.observe_cost(
+                tenant=label,
+                device_ms=leaf.device_ms
+                / (max(1, leaf.batch_size) * max(1, leaf.waiters)),
+                nbytes=int(arr.nbytes),
+            )
         telemetry.record_span(
             "serve.request", t0, t1,
             attrs={
@@ -512,6 +541,14 @@ class Dispatcher:
         # (or retrieved) — idempotent no-op when serve_aot_dir is unset
         aot.configure()
         METRICS.inc("serve.dispatches")
+        # captured ONCE: a set_options(telemetry=True) landing mid-dispatch
+        # must not make the post-dispatch block read baselines that were
+        # never taken (same discipline as core.chunk_reduce)
+        tm_on = telemetry.enabled()
+        if tm_on:
+            # cost-ledger baseline for this dispatch's compile delta
+            compiles0 = telemetry.METRICS.get("jax.compiles")
+            compile_ms0 = telemetry.METRICS.get("jax.compile_ms")
         t0 = time.perf_counter()
         from ..core import groupby_reduce
 
@@ -536,19 +573,32 @@ class Dispatcher:
                     result = np.asarray(result)
                     rows = [result[i] for i in range(len(live))]
         groups = np.asarray(groups)
-        if telemetry.enabled():
+        device_ms = (time.perf_counter() - t0) * 1e3
+        if tm_on:
             # HBM pressure right after the dispatch, attributed to THIS
             # program key (cache.stats()["hbm_by_program"]): the digest
             # keeps the label bounded while separating shape/dtype/option
             # variants. Gated: the repr+hash must cost nothing when off.
             pdigest = _digest_bytes(repr(batch.pkey).encode())[:8]
-            telemetry.sample_hbm(
-                program="serve["
+            prog = (
+                "serve["
                 + (batch.func if isinstance(batch.func, str) else "custom")
                 + f"#{pdigest}]"
             )
-        device_ms = (time.perf_counter() - t0) * 1e3
-        METRICS.observe("serve.device_ms", device_ms)
+            telemetry.sample_hbm(program=prog)
+            # the program's cost-ledger row: one dispatch (however many
+            # coalesced/batched waiters it served), its device wall, the
+            # bytes it staged, and the compiles it provoked
+            telemetry.observe_cost(
+                prog,
+                device_ms=device_ms,
+                nbytes=int(np.asarray(dispatched).nbytes) + int(batch.by.nbytes),
+                compiles=int(telemetry.METRICS.get("jax.compiles") - compiles0),
+                compile_ms=telemetry.METRICS.get("jax.compile_ms") - compile_ms0,
+            )
+        METRICS.observe(
+            "serve.device_ms", device_ms, exemplar=telemetry.current_trace()
+        )
         for leaf in live:
             leaf.device_ms = device_ms
         aot.record_reduce(

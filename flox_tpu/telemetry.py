@@ -81,6 +81,7 @@ import itertools
 import json
 import math
 import os
+import re
 import threading
 import time
 from collections import deque
@@ -90,8 +91,11 @@ __all__ = [
     "FLIGHT_RECORDER",
     "HIST_EDGES_MS",
     "METRICS",
+    "SATURATION_GAUGES",
     "MetricsRegistry",
     "annotated",
+    "cost_by_program",
+    "cost_by_tenant",
     "count",
     "current_trace",
     "detailed",
@@ -103,12 +107,17 @@ __all__ = [
     "flight_dump",
     "flush",
     "install_signal_dumps",
+    "observe_cost",
     "profile_call",
     "record_span",
     "reset",
     "sample_hbm",
+    "sample_saturation",
+    "seed_saturation_gauges",
     "span",
     "spans",
+    "start_saturation_sampler",
+    "stop_saturation_sampler",
     "tail_detail",
     "trace",
 ]
@@ -220,8 +229,14 @@ class MetricsRegistry:
                 return self._counters[name]
             return self._gauges.get(name, default)
 
-    def observe(self, name: str, value: float) -> None:
-        """Count one observation into ``name``'s log-spaced histogram."""
+    def observe(self, name: str, value: float, exemplar: str | None = None) -> None:
+        """Count one observation into ``name``'s log-spaced histogram.
+
+        ``exemplar`` (a trace/request id) is remembered per BUCKET for the
+        max observation that landed there — the exposition layer emits it
+        OpenMetrics-style on the ``_bucket`` line, so an operator reading a
+        p99 blow-up on /metrics gets the trace id of the request that put
+        the worst observation in that bucket, not just a count."""
         with self._lock:
             hist = self._hists.get(name)
             if hist is None:
@@ -231,19 +246,36 @@ class MetricsRegistry:
                     "sum": 0.0,
                     "min": float("inf"),
                     "max": float("-inf"),
+                    # bucket index -> [trace id, value] of the bucket's max
+                    # exemplar-carrying observation (sparse: only buckets
+                    # that ever saw a traced observation hold a slot)
+                    "exemplars": {},
                 }
-            hist["counts"][_hist_bucket(float(value))] += 1
+            value = float(value)
+            bucket = _hist_bucket(value)
+            hist["counts"][bucket] += 1
             hist["count"] += 1
-            hist["sum"] += float(value)
-            hist["min"] = min(hist["min"], float(value))
-            hist["max"] = max(hist["max"], float(value))
+            hist["sum"] += value
+            hist["min"] = min(hist["min"], value)
+            hist["max"] = max(hist["max"], value)
+            if exemplar is not None:
+                slot = hist["exemplars"].get(bucket)
+                if slot is None or value >= slot[1]:
+                    hist["exemplars"][bucket] = [str(exemplar), value]
 
     def histograms(self) -> dict[str, dict]:
-        """A deep copy of every histogram (name -> counts/count/sum/min/max);
-        bucket upper edges are the shared :data:`HIST_EDGES_MS`."""
+        """A deep copy of every histogram (name -> counts/count/sum/min/max/
+        exemplars); bucket upper edges are the shared :data:`HIST_EDGES_MS`."""
         with self._lock:
             return {
-                name: {**hist, "counts": list(hist["counts"])}
+                name: {
+                    **hist,
+                    "counts": list(hist["counts"]),
+                    "exemplars": {
+                        b: list(slot)
+                        for b, slot in hist.get("exemplars", {}).items()
+                    },
+                }
                 for name, hist in self._hists.items()
             }
 
@@ -600,7 +632,7 @@ class _Trace:
             with _RECORDS_LOCK:
                 parked = _TAIL_REGISTRY.pop(self.trace_id, None)
         if self._observe:
-            METRICS.observe(self._hist, dur_ms)
+            METRICS.observe(self._hist, dur_ms, exemplar=self.trace_id)
         if parked:
             # keep on error, on blowing the entry-time p99, or when there
             # was no distribution to compare against (the first traced
@@ -616,7 +648,9 @@ class _Trace:
                         # same per-phase distributions whether or not fast
                         # requests were traced
                         METRICS.observe(
-                            "span_ms." + rec["name"], rec.get("dur_us", 0.0) / 1e3
+                            "span_ms." + rec["name"],
+                            rec.get("dur_us", 0.0) / 1e3,
+                            exemplar=rec.get("trace"),
                         )
                 _commit(parked)
             else:
@@ -752,14 +786,135 @@ def install_signal_dumps() -> None:
 
 
 # ---------------------------------------------------------------------------
-# device-memory accounting
+# cost ledger + device-memory accounting
 # ---------------------------------------------------------------------------
 
 
-#: per-program-key peak HBM: program label -> max bytes_in_use observed
-#: right after one of its dispatches. Surfaced via cache.stats()
-#: ["hbm_by_program"]; registered in cache.clear_all (floxlint FLX008).
-_HBM_REGISTRY: dict[str, float] = {}
+#: per-program / per-tenant cost ledger: ``(axis, label)`` ->
+#: dispatches / device_ms (total + max) / bytes / compiles / compile_ms /
+#: hbm_peak / last_slow_trace. ``axis`` is ``"program"`` (the compiled-
+#: program key the caches and the serve coalescer share — the unit of cost
+#: in a system whose native speed all lives in XLA programs) or
+#: ``"tenant"`` (the serve layer's optional request tag). Absorbs the old
+#: per-program HBM table: :func:`sample_hbm` writes its peaks into the
+#: same entries the dispatch sites feed, so "which program is eating the
+#: chip" and "which program is eating device time" are one row. Surfaced
+#: via ``cache.stats()["cost_by_program"]`` / ``/debug/costs`` /
+#: ``python -m flox_tpu.telemetry costs``; registered in cache.clear_all
+#: (floxlint FLX008).
+_COST_LEDGER: dict[tuple[str, str], dict] = {}
+
+
+def _cost_entry(axis: str, label: str) -> dict:
+    """The (axis, label) ledger row, created empty on first touch.
+    Callers hold ``_RECORDS_LOCK``."""
+    entry = _COST_LEDGER.get((axis, label))
+    if entry is None:
+        entry = _COST_LEDGER[(axis, label)] = {
+            "dispatches": 0,
+            "device_ms": 0.0,
+            "device_ms_max": 0.0,
+            "bytes": 0,
+            "compiles": 0,
+            "compile_ms": 0.0,
+            "hbm_peak": 0.0,
+            "last_slow_trace": None,
+        }
+    return entry
+
+
+def observe_cost(
+    program: str | None = None,
+    *,
+    tenant: str | None = None,
+    dispatches: int = 1,
+    device_ms: float = 0.0,
+    nbytes: int | float = 0,
+    compiles: int = 0,
+    compile_ms: float = 0.0,
+) -> None:
+    """Attribute one dispatch's cost to its program key (and tenant).
+
+    Called from the same sites that sample HBM — the eager kernel bundle,
+    the mesh program dispatch, the streaming pass end, the serve execute,
+    and AOT warmup. ``device_ms`` is host-observed dispatch wall (the
+    serving layer's device-time proxy), ``nbytes`` the payload staged for
+    the dispatch, ``compiles``/``compile_ms`` the ``jax.compiles`` /
+    ``jax.compile_ms`` delta the dispatch provoked. A dispatch that sets a
+    new ``device_ms_max`` inside a live :func:`trace` records the trace id
+    as ``last_slow_trace`` — the ledger row links straight to the flight /
+    export records of the worst request it ever served. No-op (no lock, no
+    allocation) when telemetry is off."""
+    if not enabled():
+        return
+    trace_id = _TRACE.get()
+    with _RECORDS_LOCK:
+        for axis, label in (("program", program), ("tenant", tenant)):
+            if label is None:
+                continue
+            entry = _cost_entry(axis, str(label))
+            entry["dispatches"] += dispatches
+            entry["device_ms"] += float(device_ms)
+            entry["bytes"] += int(nbytes)
+            entry["compiles"] += int(compiles)
+            entry["compile_ms"] += float(compile_ms)
+            if float(device_ms) >= entry["device_ms_max"]:
+                entry["device_ms_max"] = float(device_ms)
+                if trace_id is not None:
+                    entry["last_slow_trace"] = trace_id
+
+
+def _ledger_axis(axis: str) -> dict[str, dict]:
+    """A locked deep copy of one ledger axis (label -> row) — stats queries
+    on the event-loop thread never race a worker-thread dispatch mid-copy."""
+    with _RECORDS_LOCK:
+        return {
+            label: dict(entry)
+            for (ax, label), entry in _COST_LEDGER.items()
+            if ax == axis
+        }
+
+
+def cost_by_program() -> dict[str, dict]:
+    """The per-program-key cost ledger (a locked copy)."""
+    return _ledger_axis("program")
+
+
+def cost_by_tenant() -> dict[str, dict]:
+    """The per-tenant cost ledger (a locked copy; populated only by serve
+    requests that carry a ``tenant`` tag)."""
+    return _ledger_axis("tenant")
+
+
+#: distinct tenant labels admitted so far — the cardinality bound for the
+#: tenant ledger axis AND the labeled /metrics histograms. Client-supplied
+#: tags past the cap fold into "_other" instead of allocating a fresh
+#: histogram per unique string (an untrusted client must not be able to
+#: grow registry memory without bound). Registered in cache.clear_all.
+_TENANT_LABELS: dict[str, bool] = {}
+_TENANT_MAX = 64
+#: characters allowed through in a tenant label — everything else folds to
+#: ``_`` so a client-chosen tag can never inject label syntax (quotes,
+#: the registry's ``|key=value`` separator, newlines) into the exposition
+_TENANT_UNSAFE = re.compile(r"[^A-Za-z0-9_.:\-]")
+
+
+def tenant_label(tenant: Any) -> str:
+    """The sanitized, cardinality-bounded label for a client tenant tag.
+
+    The serve layer passes every request's raw ``tenant`` through here
+    before using it as a ledger key or a metric label: unsafe characters
+    fold to ``_``, length is capped, and once :data:`_TENANT_MAX` distinct
+    labels exist, new ones collapse into ``"_other"`` (their cost is still
+    counted — just not per-tenant)."""
+    label = _TENANT_UNSAFE.sub("_", str(tenant))[:64] or "_"
+    with _RECORDS_LOCK:
+        if label in _TENANT_LABELS:
+            return label
+        if len(_TENANT_LABELS) >= _TENANT_MAX:
+            return "_other"
+        _TENANT_LABELS[label] = True
+    return label
 
 
 def sample_hbm(program: str | None = None) -> None:
@@ -769,9 +924,9 @@ def sample_hbm(program: str | None = None) -> None:
     serving execute). Feeds ``hbm.bytes_in_use`` (latest) and
     ``hbm.peak_bytes_in_use`` (running max — the allocator's own peak when
     it reports one); with ``program`` set, also attributes the observed
-    ``bytes_in_use`` to that program key in :data:`_HBM_REGISTRY`, so an
-    operator can see WHICH compiled program is eating the chip. No-op when
-    telemetry is off or the backend exposes no memory stats (CPU)."""
+    ``bytes_in_use`` to that program's cost-ledger row (``hbm_peak``), so
+    an operator can see WHICH compiled program is eating the chip. No-op
+    when telemetry is off or the backend exposes no memory stats (CPU)."""
     if not enabled():
         return
     from . import device
@@ -785,16 +940,124 @@ def sample_hbm(program: str | None = None) -> None:
     METRICS.max_gauge("hbm.peak_bytes_in_use", peak)
     if program is not None:
         with _RECORDS_LOCK:
-            if in_use > _HBM_REGISTRY.get(program, float("-inf")):
-                _HBM_REGISTRY[program] = in_use
+            entry = _cost_entry("program", program)
+            if in_use > entry["hbm_peak"]:
+                entry["hbm_peak"] = in_use
 
 
 def hbm_by_program() -> dict[str, float]:
-    """A locked copy of the per-program peak-HBM table — ``cache.stats``
-    reads through this so a stats query on the event-loop thread never
-    races a worker-thread ``sample_hbm`` insertion mid-copy."""
-    with _RECORDS_LOCK:
-        return dict(_HBM_REGISTRY)
+    """Per-program peak HBM — the ``hbm_peak`` column of the cost ledger,
+    kept as its own view because "which program is eating the chip" is the
+    question an OOM postmortem starts with. Only rows that ever observed a
+    sample appear (a CPU backend with no memory stats contributes none)."""
+    return {
+        label: entry["hbm_peak"]
+        for label, entry in cost_by_program().items()
+        if entry["hbm_peak"] > 0.0
+    }
+
+
+# ---------------------------------------------------------------------------
+# saturation sampler: live gauges between requests
+# ---------------------------------------------------------------------------
+
+
+#: the live saturation gauges the sampler publishes. Seeded to 0 when the
+#: metrics endpoint starts (exposition.start_metrics_server), so a freshly
+#: booted replica exposes the series BEFORE its first request — an absent
+#: series reads as a broken scrape, a zero reads as idle.
+SATURATION_GAUGES: tuple[str, ...] = (
+    "serve.queue_depth",
+    "serve.inflight_batches",
+    "stream.prefetch_occupancy",
+)
+
+_SAMPLER_LOCK = threading.Lock()
+_SAMPLER_STATE: dict[str, Any] = {"thread": None, "stop": None}
+
+
+def seed_saturation_gauges() -> None:
+    """Publish every saturation gauge at 0 unless it is already live — a
+    metrics-endpoint restart must never rewind a gauge the sampler (or a
+    dispatcher) is actively feeding. No-op while telemetry is off (the
+    disabled path leaves the registry untouched, as everywhere)."""
+    if not enabled():
+        return
+    live = METRICS.gauges()
+    for name in SATURATION_GAUGES:
+        if name not in live:
+            METRICS.set_gauge(name, 0)
+
+
+def sample_saturation() -> None:
+    """One sample of the live saturation gauges: serve queue depth and
+    open micro-batches, prefetch-pool occupancy, and the HBM gauges.
+
+    The histograms answer "how did requests do"; these answer "what is the
+    process doing RIGHT NOW" — queue building, prefetch pool drained, HBM
+    climbing — which is visible between requests, exactly when the
+    post-hoc histograms are silent. Never raises (sampler contract)."""
+    if not enabled():
+        return
+    try:
+        from .serve.dispatcher import _BATCH_REGISTRY, _PENDING_REGISTRY
+
+        METRICS.set_gauge("serve.queue_depth", len(_PENDING_REGISTRY))
+        METRICS.set_gauge("serve.inflight_batches", len(_BATCH_REGISTRY))
+    except Exception:  # noqa: BLE001 — sampling must never take serving down
+        pass
+    try:
+        from .pipeline import prefetch_occupancy
+
+        METRICS.set_gauge("stream.prefetch_occupancy", prefetch_occupancy())
+    except Exception:  # noqa: BLE001
+        pass
+    sample_hbm()
+
+
+def start_saturation_sampler(interval: float | None = None) -> bool:
+    """Start the opt-in saturation-sampler daemon thread.
+
+    ``interval`` defaults to ``OPTIONS["metrics_sample_interval"]`` — 0
+    there (the default) means the sampler stays off and this returns
+    ``False``. Idempotent while a sampler is running; the thread is a
+    daemon fed by an Event, so :func:`stop_saturation_sampler` (and
+    process exit) never hang on it. Returns whether a sampler is live."""
+    from .options import OPTIONS
+
+    if interval is None:
+        interval = OPTIONS["metrics_sample_interval"]
+    if not interval or not enabled():
+        return False
+    with _SAMPLER_LOCK:
+        thread = _SAMPLER_STATE["thread"]
+        if thread is not None and thread.is_alive():
+            return True
+        stop = threading.Event()
+        period = float(interval)
+
+        def _run() -> None:
+            while not stop.wait(period):
+                sample_saturation()
+
+        thread = threading.Thread(
+            target=_run, name="flox-tpu-saturation", daemon=True
+        )
+        _SAMPLER_STATE.update(thread=thread, stop=stop)
+        thread.start()
+    return True
+
+
+def stop_saturation_sampler() -> None:
+    """Stop the sampler thread (tests; the endpoint teardown calls this)."""
+    with _SAMPLER_LOCK:
+        stop = _SAMPLER_STATE["stop"]
+        thread = _SAMPLER_STATE["thread"]
+        _SAMPLER_STATE.update(thread=None, stop=None)
+    if stop is not None:
+        stop.set()
+    if thread is not None:
+        thread.join(timeout=2)
 
 
 #: jsonl streaming appends in batches of this many records — one
@@ -830,8 +1093,13 @@ def _emit(record: dict, detail: bool = False) -> None:
     if record.get("type") == "span":
         # every finished span feeds the per-phase latency histogram — the
         # p50/p99 source for the report CLI, the Perfetto export, and the
-        # serving-layer SLO metrics (ROADMAP item 1)
-        METRICS.observe("span_ms." + record["name"], record.get("dur_us", 0.0) / 1e3)
+        # serving-layer SLO metrics (ROADMAP item 1). The trace id rides as
+        # the bucket exemplar, so a /metrics p99 row names the request
+        METRICS.observe(
+            "span_ms." + record["name"],
+            record.get("dur_us", 0.0) / 1e3,
+            exemplar=tid,
+        )
     _commit([record])
 
 
@@ -942,13 +1210,14 @@ def drain() -> list[dict]:
 
 
 def reset() -> None:
-    """Clear the record buffer, the metrics registry, the flight-recorder
-    ring, the parked tail buffers, and the per-program HBM table (tests;
-    ``cache.clear_all`` resets the same state)."""
+    """Clear the record buffer, the metrics registry (exemplar slots
+    included), the flight-recorder ring, the parked tail buffers, and the
+    cost ledger (tests; ``cache.clear_all`` resets the same state)."""
     with _RECORDS_LOCK:
         _RECORDS.clear()
         _TAIL_REGISTRY.clear()
-        _HBM_REGISTRY.clear()
+        _COST_LEDGER.clear()
+        _TENANT_LABELS.clear()
     FLIGHT_RECORDER.clear()
     METRICS.reset()
 
@@ -1177,19 +1446,29 @@ def summarize(records: list[dict]) -> list[dict]:
     """Aggregate span records per name: count / total / mean / p50 / p99 /
     max ms, sorted by total descending. Percentiles here are EXACT (from
     the raw durations) — the registry histograms trade that exactness for
-    a bounded, mergeable representation."""
+    a bounded, mergeable representation. ``max_trace`` is the trace id of
+    the slowest span of the name (when it carried one): the link from a
+    p99 row to the flight/export records of the request that caused it."""
     agg: dict[str, dict] = {}
     durs: dict[str, list[float]] = {}
     for rec in records:
         if rec.get("type") != "span":
             continue
         row = agg.setdefault(
-            rec["name"], {"name": rec["name"], "count": 0, "total_ms": 0.0, "max_ms": 0.0}
+            rec["name"],
+            {
+                "name": rec["name"], "count": 0, "total_ms": 0.0,
+                "max_ms": 0.0, "max_trace": None,
+            },
         )
         dur_ms = rec.get("dur_us", 0.0) / 1e3
         row["count"] += 1
         row["total_ms"] += dur_ms
-        row["max_ms"] = max(row["max_ms"], dur_ms)
+        if dur_ms >= row["max_ms"]:
+            row["max_ms"] = dur_ms
+            trace_id = rec.get("trace") or (rec.get("attrs") or {}).get("trace_id")
+            if trace_id is not None:
+                row["max_trace"] = trace_id
         durs.setdefault(rec["name"], []).append(dur_ms)
     out = sorted(agg.values(), key=lambda r: -r["total_ms"])
     for row in out:
@@ -1211,14 +1490,15 @@ def _report_lines(path: str, histograms: bool = False) -> list[str]:
         f"{len(records) - nevents} span(s), {nevents} event(s)",
         "",
         f"{'phase':<36} {'count':>7} {'total ms':>12} {'mean ms':>10} "
-        f"{'p50 ms':>10} {'p99 ms':>10} {'max ms':>10}",
-        "-" * 100,
+        f"{'p50 ms':>10} {'p99 ms':>10} {'max ms':>10}  slowest trace",
+        "-" * 116,
     ]
     for row in rows:
+        trace_col = str(row.get("max_trace") or "-")
         lines.append(
             f"{row['name'][:36]:<36} {row['count']:>7} {row['total_ms']:>12.2f} "
             f"{row['mean_ms']:>10.3f} {row['p50_ms']:>10.3f} "
-            f"{row['p99_ms']:>10.3f} {row['max_ms']:>10.2f}"
+            f"{row['p99_ms']:>10.3f} {row['max_ms']:>10.2f}  {trace_col[:24]}"
         )
     if histograms:
         lines += ["", "histograms (registry, log-spaced buckets):"]
@@ -1232,10 +1512,18 @@ def _report_lines(path: str, histograms: bool = False) -> list[str]:
             p50, p90, p99 = (
                 _hist_percentile(hist, q) for q in (0.50, 0.90, 0.99)
             )
-            lines.append(
+            line = (
                 f"  {name[:38]:<38} {count:>7} obs "
                 f"p50 {p50:>10.3f}  p90 {p90:>10.3f}  p99 {p99:>10.3f}"
             )
+            # the exemplar of the highest populated bucket IS the request
+            # behind the histogram's tail — name it next to the p99 (the
+            # exposition layer emits the same ids per bucket on /metrics)
+            exemplars = hist.get("exemplars") or {}
+            if exemplars:
+                top_bucket = max(exemplars, key=lambda b: int(b))
+                line += f"  p99 trace {exemplars[top_bucket][0]}"
+            lines.append(line)
     if counters:
         lines += ["", "counters/gauges:"]
         for name in sorted(counters):
@@ -1243,6 +1531,80 @@ def _report_lines(path: str, histograms: bool = False) -> list[str]:
             shown = f"{value:.2f}" if isinstance(value, float) and value % 1 else f"{int(value)}"
             lines.append(f"  {name:<40} {shown:>14}")
     return lines
+
+
+def _load_costs(path: str | None) -> tuple[dict, dict]:
+    """(cost_by_program, cost_by_tenant) — from a file (a ``/debug/costs``
+    scrape, a serve ``stats`` line, or a bare ``{label: row}`` mapping) or,
+    with no file, from the live in-process ledger."""
+    if path is None:
+        return cost_by_program(), cost_by_tenant()
+    with open(path) as f:
+        payload = json.load(f)
+    if not isinstance(payload, dict):
+        raise ValueError(f"{path}: expected a JSON object, got {type(payload).__name__}")
+    if "cost_by_program" in payload:
+        return payload.get("cost_by_program") or {}, payload.get("cost_by_tenant") or {}
+    # a serve `stats` response line carries the ledger under cache stats
+    stats = payload.get("cache") or {}
+    if "cost_by_program" in stats:
+        return stats.get("cost_by_program") or {}, stats.get("cost_by_tenant") or {}
+    return payload, {}
+
+
+def _cost_lines(
+    programs: dict, tenants: dict, top: int | None = None, source: str = "live process"
+) -> list[str]:
+    """The ``costs`` CLI table: ledger rows ranked by total device time —
+    the operator's top-N answer to "which compiled program (and which
+    tenant) is the chip actually spending itself on"."""
+    lines = [f"cost ledger — {source}"]
+    for title, table in (("program", programs), ("tenant", tenants)):
+        if title == "tenant" and not table:
+            continue  # tenants are opt-in; an all-untagged run has none
+        ranked = sorted(
+            table.items(),
+            key=lambda kv: (-float(kv[1].get("device_ms", 0.0)),
+                            -int(kv[1].get("dispatches", 0))),
+        )
+        if top is not None:
+            dropped = max(0, len(ranked) - top)
+            ranked = ranked[:top]
+        else:
+            dropped = 0
+        lines += [
+            "",
+            f"{'%s key' % title:<44} {'disp':>6} {'device ms':>11} {'max ms':>9} "
+            f"{'MBytes':>9} {'compiles':>8} {'cmpl ms':>9} {'hbm peak':>10}  slow trace",
+            "-" * 132,
+        ]
+        if not ranked:
+            lines.append(f"  (no {title} entries recorded)")
+        for label, row in ranked:
+            lines.append(
+                f"{label[:44]:<44} {int(row.get('dispatches', 0)):>6} "
+                f"{float(row.get('device_ms', 0.0)):>11.2f} "
+                f"{float(row.get('device_ms_max', 0.0)):>9.2f} "
+                f"{float(row.get('bytes', 0)) / 1e6:>9.2f} "
+                f"{int(row.get('compiles', 0)):>8} "
+                f"{float(row.get('compile_ms', 0.0)):>9.1f} "
+                f"{_fmt_bytes(row.get('hbm_peak', 0.0)):>10}  "
+                f"{str(row.get('last_slow_trace') or '-')[:24]}"
+            )
+        if dropped:
+            lines.append(f"  ... {dropped} more {title} row(s) below --top")
+    return lines
+
+
+def _fmt_bytes(value: Any) -> str:
+    value = float(value or 0.0)
+    if value <= 0:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if value < 1024 or unit == "TiB":
+            return f"{value:.1f}{unit}" if unit != "B" else f"{int(value)}B"
+        value /= 1024
+    return f"{value:.1f}TiB"
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -1259,6 +1621,20 @@ def main(argv: list[str] | None = None) -> int:
         "--histograms", action="store_true",
         help="also print the registry histograms (per-metric p50/p90/p99)",
     )
+    costs = sub.add_parser(
+        "costs",
+        help="per-program (and per-tenant) cost-ledger table, ranked by "
+        "device time — reads a /debug/costs scrape or serve stats JSON, "
+        "or the live in-process ledger when no file is given",
+    )
+    costs.add_argument(
+        "file", nargs="?", default=None,
+        help="a /debug/costs JSON scrape (default: the in-process ledger)",
+    )
+    costs.add_argument(
+        "--top", type=int, default=None, metavar="K",
+        help="show only the K most expensive rows per axis",
+    )
     srv = sub.add_parser(
         "serve-metrics",
         help="standalone /metrics + /healthz + /readyz HTTP endpoint "
@@ -1271,16 +1647,33 @@ def main(argv: list[str] | None = None) -> int:
     )
     srv.add_argument("--host", default="127.0.0.1")
     args = parser.parse_args(argv)
+    if args.command == "costs":
+        if args.top is not None and args.top < 1:
+            parser.error("--top must be >= 1")
+        try:
+            programs, tenants = _load_costs(args.file)
+            lines = _cost_lines(
+                programs, tenants, top=args.top,
+                source=args.file or "live process",
+            )
+        except OSError as exc:
+            parser.error(f"cannot read {args.file}: {exc}")
+        except (ValueError, KeyError, TypeError, AttributeError) as exc:
+            parser.error(f"{args.file} is not a readable cost export: {exc}")
+        print("\n".join(lines))
+        return 0
     if args.command == "serve-metrics":
         # a process whose only job is to be scraped (smoke tests,
         # sidecars): telemetry forced on (an endpoint over a dead registry
         # is useless), ready immediately (no warmup manifest to replay),
         # crash-signal dumps installed so SIGTERM leaves a flight record
-        from . import exposition
+        from . import exposition, profiling
         from .options import OPTIONS, set_options
 
         set_options(telemetry=True)
         install_signal_dumps()
+        # SIGUSR1 -> on-demand on-chip capture into OPTIONS["profile_dir"]
+        profiling.install_capture_signal()
         port = args.port if args.port is not None else (OPTIONS["metrics_port"] or 8000)
         bound = exposition.start_metrics_server(port=port, host=args.host)
         exposition.set_ready(True)

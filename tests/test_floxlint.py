@@ -237,6 +237,54 @@ def test_unregistered_serve_container_fails_flx008(tmp_path):
     assert not [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
 
 
+def test_unregistered_cost_ledger_fails_flx008(tmp_path):
+    # ISSUE 9 satellite: the cost-attribution tables accrete per program
+    # key exactly like a cache — a LEDGER-named container mutated at
+    # runtime (here one level through a helper, like telemetry._cost_entry)
+    # without the matching clear_all registration must be flagged
+    pkg = tmp_path / "minipkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "telemetry.py").write_text(
+        '"""Mini telemetry with a cost ledger."""\n\n'
+        "_COST_LEDGER: dict = {}\n\n\n"
+        "def _cost_entry(axis, label):\n"
+        "    return _COST_LEDGER.setdefault((axis, label), {})\n\n\n"
+        "def observe_cost(program, device_ms=0.0):\n"
+        "    entry = _cost_entry('program', program)\n"
+        "    entry['device_ms'] = entry.get('device_ms', 0.0) + device_ms\n"
+    )
+    (pkg / "cache.py").write_text(
+        '"""clear_all that forgets the ledger."""\n\n\ndef clear_all():\n    pass\n'
+    )
+    findings = [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+    assert len(findings) == 1
+    assert "_COST_LEDGER" in findings[0].message
+    # registering it makes the package clean again — the spelling the real
+    # flox_tpu.cache.clear_all uses
+    (pkg / "cache.py").write_text(
+        '"""clear_all that registers the ledger."""\n\n\n'
+        "def clear_all():\n"
+        "    from .telemetry import _COST_LEDGER\n\n"
+        "    _COST_LEDGER.clear()\n"
+    )
+    assert not [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+
+
+def test_real_cost_ledger_is_registered():
+    # the runtime complement: the REAL ledger must be reachable from the
+    # real clear_all (named here so a refactor cannot lose it silently)
+    import flox_tpu
+    import flox_tpu.cache as flox_cache
+    from flox_tpu.telemetry import _COST_LEDGER, observe_cost
+
+    with flox_tpu.set_options(telemetry=True):
+        observe_cost("probe[prog]", device_ms=1.0, nbytes=8)
+    assert len(_COST_LEDGER) >= 1
+    flox_cache.clear_all()
+    assert _COST_LEDGER == {}
+
+
 def test_lru_bound_cache_is_flx008_candidate(tmp_path):
     # the compiled-program caches are LRUCache instances now (ISSUE 7
     # eviction fix) — swapping dict for LRUCache must not take a cache off

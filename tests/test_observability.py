@@ -57,13 +57,16 @@ def _run_reduce(**kw):
 # ---------------------------------------------------------------------------
 
 
-def _parse_prometheus(text: str) -> tuple[dict, dict]:
-    """Minimal text-format parser: ``{metric-with-labels: value}`` samples
-    plus ``{metric: type}`` from the # TYPE lines. Raises on anything that
-    is not a comment, a blank, or a ``name{labels} value`` sample — the
-    golden-format guarantee the scrape contract rests on."""
+def _parse_prometheus(text: str) -> tuple[dict, dict, dict]:
+    """Minimal text-format parser: ``{metric-with-labels: value}`` samples,
+    ``{metric: type}`` from the # TYPE lines, and ``{metric-with-labels:
+    (labels, value)}`` for OpenMetrics-style exemplars hanging off
+    ``_bucket`` lines (`` # {trace_id="..."} <value>``). Raises on anything
+    that is not a comment, a blank, or a ``name{labels} value [exemplar]``
+    sample — the golden-format guarantee the scrape contract rests on."""
     samples: dict[str, float] = {}
     types: dict[str, str] = {}
+    exemplars: dict[str, tuple[str, float]] = {}
     for line in text.splitlines():
         if not line.strip():
             continue
@@ -72,13 +75,21 @@ def _parse_prometheus(text: str) -> tuple[dict, dict]:
             if len(parts) >= 4 and parts[1] == "TYPE":
                 types[parts[2]] = parts[3]
             continue
-        name_part, _, value_part = line.rpartition(" ")
+        sample_part, sep, exemplar_part = line.partition(" # ")
+        name_part, _, value_part = sample_part.rpartition(" ")
         assert name_part, f"unparseable sample line: {line!r}"
         value = float(value_part)  # raises for malformed values
         if "{" in name_part:
             assert name_part.endswith("}"), f"unclosed label set: {line!r}"
         samples[name_part] = value
-    return samples, types
+        if sep:
+            # exemplar syntax: `# {label="value"} observed-value`
+            labels_part, _, obs_part = exemplar_part.rpartition(" ")
+            assert labels_part.startswith("{") and labels_part.endswith("}"), (
+                f"malformed exemplar on: {line!r}"
+            )
+            exemplars[name_part] = (labels_part, float(obs_part))
+    return samples, types, exemplars
 
 
 class TestPrometheusExposition:
@@ -86,7 +97,7 @@ class TestPrometheusExposition:
         with flox_tpu.set_options(telemetry=True):
             _run_reduce()
             telemetry.METRICS.set_gauge("hbm.bytes_in_use", 12345.0)
-        samples, types = _parse_prometheus(exposition.prometheus_text())
+        samples, types, _ = _parse_prometheus(exposition.prometheus_text())
 
         # counters carry the _total suffix and the counter TYPE
         assert types["flox_tpu_cache_bundle_calls_total"] == "counter"
@@ -110,7 +121,7 @@ class TestPrometheusExposition:
     def test_name_sanitization(self):
         with flox_tpu.set_options(telemetry=True):
             telemetry.METRICS.inc("serve.weird-name.v2")
-        samples, _ = _parse_prometheus(exposition.prometheus_text())
+        samples, _, _ = _parse_prometheus(exposition.prometheus_text())
         assert "flox_tpu_serve_weird_name_v2_total" in samples
 
 
@@ -139,7 +150,7 @@ class TestMetricsServer:
         resp = self._get(port, "/metrics")
         assert resp.status == 200
         assert "text/plain" in resp.headers["Content-Type"]
-        samples, _ = _parse_prometheus(resp.read().decode())
+        samples, _, _ = _parse_prometheus(resp.read().decode())
         assert samples["flox_tpu_cache_bundle_calls_total"] >= 1
 
     def test_disabled_by_default_option(self):
@@ -480,6 +491,11 @@ class TestNewOptions:
             {"flight_recorder_path": ""},
             {"flight_recorder_size": 0},
             {"flight_recorder_size": True},
+            {"profile_dir": ""},
+            {"profile_keep": 0},
+            {"profile_keep": True},
+            {"metrics_sample_interval": -1.0},
+            {"metrics_sample_interval": float("inf")},
         ],
     )
     def test_validated_at_set_time(self, bad):
@@ -494,5 +510,573 @@ class TestNewOptions:
         from flox_tpu import options as opts
 
         src = inspect.getsource(opts)
-        for name in ("metrics_port", "flight_recorder_path", "flight_recorder_size"):
+        for name in (
+            "metrics_port", "flight_recorder_path", "flight_recorder_size",
+            "profile_dir", "profile_keep", "metrics_sample_interval",
+        ):
             assert f"FLOX_TPU_{name.upper()}" in src
+
+
+# ---------------------------------------------------------------------------
+# cost ledger (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+class TestCostLedger:
+    def test_eager_dispatch_feeds_program_ledger(self):
+        cache.clear_all()  # fresh bundle: the first call must pay a compile
+        with flox_tpu.set_options(telemetry=True):
+            _run_reduce()
+            _run_reduce()
+        costs = cache.stats()["cost_by_program"]
+        bundle = [k for k in costs if k.startswith("bundle[")]
+        assert bundle, costs
+        row = costs[bundle[0]]
+        assert row["dispatches"] == 2
+        assert row["device_ms"] > 0
+        assert row["device_ms_max"] <= row["device_ms"]
+        assert row["bytes"] > 0
+        # the first call compiled, the second was a cache hit
+        assert row["compiles"] >= 1
+        cache.clear_all()
+        assert cache.stats()["cost_by_program"] == {}
+
+    def test_mesh_and_streaming_dispatches_attributed(self):
+        mesh = make_mesh()
+        n = 512
+        labels = RNG.integers(0, 5, n)
+        vals = RNG.normal(size=n)
+        with flox_tpu.set_options(telemetry=True):
+            groupby_reduce(vals, labels, func="sum", method="map-reduce", mesh=mesh)
+            streaming_groupby_reduce(vals, labels, func="sum", batch_len=128)
+        costs = cache.stats()["cost_by_program"]
+        assert any(k.startswith("mesh[") for k in costs), costs
+        assert any(k.startswith("stream[") for k in costs), costs
+        stream_rows = [v for k, v in costs.items() if k.startswith("stream[")]
+        assert stream_rows[0]["bytes"] > 0  # staged slab bytes attributed
+
+    def test_slow_trace_id_lands_in_ledger(self):
+        with flox_tpu.set_options(telemetry=True):
+            with telemetry.trace("req-slowest"):
+                _run_reduce()
+        costs = cache.stats()["cost_by_program"]
+        bundle = [v for k, v in costs.items() if k.startswith("bundle[")]
+        assert bundle and bundle[0]["last_slow_trace"] == "req-slowest"
+
+    def test_hbm_peak_absorbed_into_ledger(self, monkeypatch):
+        from flox_tpu import device
+
+        monkeypatch.setattr(
+            device,
+            "memory_stats",
+            lambda devices=None: {"bytes_in_use": 4096, "peak_bytes_in_use": 8192},
+        )
+        with flox_tpu.set_options(telemetry=True):
+            _run_reduce()
+        costs = cache.stats()["cost_by_program"]
+        bundle = [k for k in costs if k.startswith("bundle[")]
+        assert costs[bundle[0]]["hbm_peak"] == 4096
+        # the hbm_by_program view is the ledger's hbm_peak column
+        assert cache.stats()["hbm_by_program"][bundle[0]] == 4096
+
+    def test_disabled_path_records_nothing(self):
+        telemetry.observe_cost("nope", device_ms=1.0, nbytes=10)
+        assert cache.stats()["cost_by_program"] == {}
+        assert cache.stats()["cost_by_tenant"] == {}
+
+    def test_costs_cli_live_and_file(self, tmp_path, capsys):
+        with flox_tpu.set_options(telemetry=True):
+            _run_reduce()
+        assert telemetry.main(["costs"]) == 0
+        out = capsys.readouterr().out
+        assert "bundle[" in out and "program key" in out
+        # a /debug/costs-shaped scrape file round-trips through the CLI
+        scrape = tmp_path / "costs.json"
+        scrape.write_text(json.dumps({
+            "cost_by_program": telemetry.cost_by_program(),
+            "cost_by_tenant": telemetry.cost_by_tenant(),
+        }))
+        assert telemetry.main(["costs", str(scrape), "--top", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "bundle[" in out
+
+    def test_costs_cli_rejects_garbage(self, tmp_path, capsys):
+        bad = tmp_path / "bad.json"
+        bad.write_text("not json")
+        with pytest.raises(SystemExit):
+            telemetry.main(["costs", str(bad)])
+
+
+class TestTenantAxis:
+    def _submit(self, tenant=None, request_id=None):
+        import asyncio
+
+        from flox_tpu.serve import AggregationRequest, Dispatcher
+
+        async def go():
+            dispatcher = Dispatcher()
+            result = await dispatcher.submit(AggregationRequest(
+                func="sum",
+                array=np.array([1.0, 2.0, 4.0, 8.0]),
+                by=np.array([0, 0, 1, 1]),
+                tenant=tenant,
+                request_id=request_id,
+            ))
+            await dispatcher.close()
+            return result
+
+        return asyncio.run(go())
+
+    def test_tenant_feeds_ledger_and_labeled_histogram(self):
+        with flox_tpu.set_options(telemetry=True):
+            result = self._submit(tenant="acme", request_id="req-t1")
+        np.testing.assert_allclose(np.asarray(result.result), [3.0, 12.0])
+        tenants = cache.stats()["cost_by_tenant"]
+        assert "acme" in tenants, tenants
+        assert tenants["acme"]["dispatches"] == 1
+        assert tenants["acme"]["bytes"] > 0
+        samples, types, _ = _parse_prometheus(exposition.prometheus_text())
+        labeled = [
+            k for k in samples
+            if k.startswith('flox_tpu_serve_request_ms_bucket{tenant="acme",le="')
+        ]
+        assert len(labeled) == len(telemetry.HIST_EDGES_MS) + 1  # edges + +Inf
+        assert samples['flox_tpu_serve_request_ms_count{tenant="acme"}'] == 1
+        # ONE TYPE line covers the base metric and its labeled series
+        assert types["flox_tpu_serve_request_ms"] == "histogram"
+        text = exposition.prometheus_text()
+        assert text.count("# TYPE flox_tpu_serve_request_ms histogram") == 1
+
+    def test_untagged_requests_leave_no_tenant_rows(self):
+        with flox_tpu.set_options(telemetry=True):
+            self._submit()
+        assert cache.stats()["cost_by_tenant"] == {}
+
+    def test_tenant_label_sanitized_against_injection(self):
+        # a client-chosen tag must not be able to inject label syntax into
+        # the exposition (a raw `|le=5` would render a duplicate le label
+        # and poison the whole scrape for every consumer)
+        with flox_tpu.set_options(telemetry=True):
+            self._submit(tenant='evil|le=5"x')
+        tenants = cache.stats()["cost_by_tenant"]
+        assert list(tenants) == ["evil_le_5_x"]
+        text = exposition.prometheus_text()
+        assert 'tenant="evil_le_5_x"' in text
+        # every bucket line still carries exactly ONE le label
+        for line in text.splitlines():
+            if "_bucket{" in line:
+                assert line.count('le="') == 1, line
+        _parse_prometheus(text)  # and the whole exposition still parses
+
+    def test_tenant_cardinality_is_bounded(self):
+        # unique client tags past the cap fold into "_other" instead of
+        # allocating a fresh histogram per string
+        with flox_tpu.set_options(telemetry=True):
+            for i in range(telemetry._TENANT_MAX + 5):
+                assert telemetry.tenant_label(f"t{i}") == (
+                    f"t{i}" if i < telemetry._TENANT_MAX else "_other"
+                )
+            # known labels keep resolving to themselves past the cap
+            assert telemetry.tenant_label("t0") == "t0"
+        cache.clear_all()
+        assert telemetry.tenant_label("fresh") == "fresh"
+
+    def test_coalesced_tenant_billing_sums_to_dispatch_wall(self):
+        # K coalesced requests share ONE dispatch; the tenant axis bills
+        # each its share, so tenant totals never exceed program totals
+        import asyncio
+
+        from flox_tpu.serve import AggregationRequest, Dispatcher
+
+        async def go():
+            dispatcher = Dispatcher(batch_window=0.05)
+            arr = np.array([1.0, 2.0, 4.0, 8.0])
+            by = np.array([0, 0, 1, 1])
+            results = await asyncio.gather(*[
+                dispatcher.submit(AggregationRequest(
+                    func="sum", array=arr, by=by, tenant="acme"
+                ))
+                for _ in range(3)
+            ])
+            await dispatcher.close()
+            return results
+
+        with flox_tpu.set_options(telemetry=True):
+            results = asyncio.run(go())
+        assert len(results) == 3
+        assert telemetry.METRICS.get("serve.dispatches") == 1
+        stats = cache.stats()
+        program_ms = sum(
+            row["device_ms"] for key, row in stats["cost_by_program"].items()
+            if key.startswith("serve[")
+        )
+        tenant_ms = stats["cost_by_tenant"]["acme"]["device_ms"]
+        assert tenant_ms <= program_ms * 1.001 + 1e-6, (tenant_ms, program_ms)
+
+    def test_tenant_does_not_change_results(self):
+        with flox_tpu.set_options(telemetry=True):
+            tagged = self._submit(tenant="acme")
+            untagged = self._submit()
+        np.testing.assert_array_equal(
+            np.asarray(tagged.result), np.asarray(untagged.result)
+        )
+
+
+# ---------------------------------------------------------------------------
+# exemplars (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+class TestExemplars:
+    def test_bucket_lines_parse_with_and_without_exemplars(self):
+        with flox_tpu.set_options(telemetry=True):
+            # one traced observation (carries an exemplar) and one bare
+            telemetry.METRICS.observe("demo_ms", 0.5, exemplar="req-ex-1")
+            telemetry.METRICS.observe("demo_ms", 700.0)
+        text = exposition.prometheus_text()
+        samples, _, exemplars = _parse_prometheus(text)
+        with_ex = [k for k in exemplars if k.startswith("flox_tpu_demo_ms_bucket")]
+        assert len(with_ex) == 1
+        labels, observed = exemplars[with_ex[0]]
+        assert labels == '{trace_id="req-ex-1"}'
+        assert observed == 0.5
+        # the untraced observation's bucket line carries none, and both
+        # still parse as ordinary cumulative samples
+        buckets = [
+            v for k, v in samples.items()
+            if k.startswith('flox_tpu_demo_ms_bucket{le="')
+        ]
+        assert buckets == sorted(buckets)
+        assert samples['flox_tpu_demo_ms_bucket{le="+Inf"}'] == 2
+
+    def test_exemplar_keeps_max_observation_per_bucket(self):
+        with flox_tpu.set_options(telemetry=True):
+            # both land in the same bucket; the larger wins the slot
+            telemetry.METRICS.observe("demo_ms", 0.40, exemplar="req-small")
+            telemetry.METRICS.observe("demo_ms", 0.51, exemplar="req-big")
+            telemetry.METRICS.observe("demo_ms", 0.45, exemplar="req-mid")
+        _, _, exemplars = _parse_prometheus(exposition.prometheus_text())
+        (labels, observed), = exemplars.values()
+        assert labels == '{trace_id="req-big"}'
+        assert observed == 0.51
+
+    def test_http_scrape_clean_by_default_exemplars_on_request(self):
+        # the classic 0.0.4 text parser (a default Prometheus scrape)
+        # aborts on exemplars, so the plain endpoint must omit them; a
+        # scraper that wants them asks with ?exemplars=1
+        with flox_tpu.set_options(telemetry=True):
+            telemetry.METRICS.observe("demo_ms", 0.5, exemplar="req-http")
+            port = exposition.start_metrics_server(port=0)
+            plain = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+            rich = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics?exemplars=1", timeout=5
+            ).read().decode()
+        assert " # {trace_id=" not in plain
+        assert ' # {trace_id="req-http"}' in rich
+        _parse_prometheus(plain)
+        _parse_prometheus(rich)
+
+    def test_exemplar_trace_id_is_escaped(self):
+        # trace ids are client-supplied (request ids): quotes/backslashes
+        # must not produce malformed label syntax on the bucket line
+        with flox_tpu.set_options(telemetry=True):
+            telemetry.METRICS.observe("demo_ms", 0.5, exemplar='r"1\\x')
+        text = exposition.prometheus_text()
+        assert ' # {trace_id="r\\"1\\\\x"}' in text
+        _parse_prometheus(text)
+
+    def test_traced_spans_carry_exemplars_to_metrics(self):
+        with flox_tpu.set_options(telemetry=True):
+            with telemetry.trace("req-exemplar"):
+                _run_reduce()
+        _, _, exemplars = _parse_prometheus(exposition.prometheus_text())
+        span_ex = {
+            k: v for k, v in exemplars.items()
+            if k.startswith("flox_tpu_span_ms_groupby_reduce_bucket")
+        }
+        assert span_ex
+        assert all(v[0] == '{trace_id="req-exemplar"}' for v in span_ex.values())
+
+    def test_report_links_slowest_trace(self, tmp_path, capsys):
+        with flox_tpu.set_options(telemetry=True):
+            with telemetry.trace("req-linked"):
+                _run_reduce()
+            export = tmp_path / "t.jsonl"
+            telemetry.export_jsonl(str(export))
+        assert telemetry.main(["report", str(export), "--histograms"]) == 0
+        out = capsys.readouterr().out
+        assert "slowest trace" in out
+        assert "req-linked" in out
+
+
+# ---------------------------------------------------------------------------
+# on-demand capture (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProfiler:
+    """Deterministic stand-in for jax.profiler: records start/stop calls
+    without touching the real (backend-dependent) profiler."""
+
+    def __init__(self, fail_start=False):
+        self.fail_start = fail_start
+        self.starts: list[str] = []
+        self.stops = 0
+
+    def install(self, monkeypatch):
+        import jax
+
+        def start_trace(logdir):
+            if self.fail_start:
+                raise RuntimeError("no profiler on this backend")
+            os.makedirs(logdir, exist_ok=True)
+            self.starts.append(logdir)
+
+        monkeypatch.setattr(jax.profiler, "start_trace", start_trace)
+        monkeypatch.setattr(jax.profiler, "stop_trace", lambda: setattr(
+            self, "stops", self.stops + 1
+        ))
+        return self
+
+
+class TestOnDemandCapture:
+    @pytest.fixture(autouse=True)
+    def _fresh_capture_state(self):
+        from flox_tpu import profiling
+
+        profiling._CAPTURE_STATE.clear()
+        yield
+        profiling._CAPTURE_STATE.clear()
+
+    def test_unconfigured_root_is_unavailable(self):
+        from flox_tpu import profiling
+
+        with pytest.raises(profiling.CaptureUnavailableError):
+            profiling.start_capture(seconds=0.05)
+
+    def test_capture_runs_and_guard_clears(self, tmp_path, monkeypatch):
+        import time as _time
+
+        from flox_tpu import profiling
+
+        fake = _FakeProfiler().install(monkeypatch)
+        with flox_tpu.set_options(telemetry=True, profile_dir=str(tmp_path)):
+            capture_dir = profiling.start_capture(seconds=0.05)
+            assert capture_dir.startswith(str(tmp_path))
+            assert cache.stats()["profile_capture_active"] is True
+            # a second capture while one runs is refused (HTTP 409)
+            with pytest.raises(profiling.CaptureBusyError):
+                profiling.start_capture(seconds=0.05)
+            for _ in range(100):
+                if profiling.capture_active() is None:
+                    break
+                _time.sleep(0.02)
+        assert profiling.capture_active() is None
+        assert fake.starts == [capture_dir]
+        assert fake.stops == 1
+        assert telemetry.METRICS.get("profile.captures") == 1
+
+    def test_profiler_less_backend_is_unavailable(self, tmp_path, monkeypatch):
+        from flox_tpu import profiling
+
+        _FakeProfiler(fail_start=True).install(monkeypatch)
+        with flox_tpu.set_options(profile_dir=str(tmp_path)):
+            with pytest.raises(profiling.CaptureUnavailableError):
+                profiling.start_capture(seconds=0.05)
+        # the guard did not leak: a later capture may start
+        assert profiling.capture_active() is None
+
+    def test_capture_dir_rotation(self, tmp_path, monkeypatch):
+        import time as _time
+
+        from flox_tpu import profiling
+
+        _FakeProfiler().install(monkeypatch)
+        with flox_tpu.set_options(profile_dir=str(tmp_path), profile_keep=2):
+            for _ in range(4):
+                profiling.start_capture(seconds=0.01)
+                for _ in range(100):
+                    if profiling.capture_active() is None:
+                        break
+                    _time.sleep(0.02)
+        captures = sorted(p.name for p in tmp_path.iterdir())
+        assert len(captures) <= 2, captures
+
+    def test_http_endpoint_409_and_501(self, tmp_path, monkeypatch):
+        import time as _time
+
+        from flox_tpu import profiling
+
+        _FakeProfiler().install(monkeypatch)
+        port = exposition.start_metrics_server(port=0)
+        with flox_tpu.set_options(telemetry=True, profile_dir=str(tmp_path)):
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profile?seconds=0.3", timeout=5
+            )
+            assert resp.status == 202
+            payload = json.loads(resp.read())
+            assert payload["ok"] and payload["dir"].startswith(str(tmp_path))
+            # concurrent second request: 409, and the reply names the clash
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/profile?seconds=0.3", timeout=5
+                )
+            assert err.value.code == 409
+            for _ in range(100):
+                if profiling.capture_active() is None:
+                    break
+                _time.sleep(0.02)
+        # unconfigured root -> clean 501, never an exception in the server
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profile", timeout=5
+            )
+        assert err.value.code == 501
+
+    def test_trace_defaults_to_profile_dir_and_warns_without_profiler(
+        self, tmp_path, monkeypatch, caplog
+    ):
+        import logging as _logging
+
+        from flox_tpu import profiling
+
+        with pytest.raises(ValueError, match="profile_dir"):
+            with profiling.trace():
+                pass
+        fake = _FakeProfiler().install(monkeypatch)
+        with flox_tpu.set_options(profile_dir=str(tmp_path)):
+            with profiling.trace():
+                pass
+        assert fake.starts == [str(tmp_path)]
+        # a profiler-less backend warns and runs the block untraced
+        _FakeProfiler(fail_start=True).install(monkeypatch)
+        ran = []
+        with caplog.at_level(_logging.WARNING, logger="flox_tpu.profiling"):
+            with profiling.trace(str(tmp_path)):
+                ran.append(True)
+        assert ran == [True]
+        assert any("untraced" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# /debug/costs + saturation gauges (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+class TestDebugCostsEndpoint:
+    def test_scrape_matches_cache_stats(self, tmp_path):
+        with flox_tpu.set_options(telemetry=True):
+            _run_reduce()
+            port = exposition.start_metrics_server(port=0)
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/costs", timeout=5
+            )
+            assert resp.status == 200
+            assert "application/json" in resp.headers["Content-Type"]
+            payload = json.loads(resp.read())
+            stats = cache.stats()
+        assert set(payload) >= {"cost_by_program", "cost_by_tenant", "hbm_by_program"}
+        assert payload["cost_by_program"].keys() == stats["cost_by_program"].keys()
+        bundle = [k for k in payload["cost_by_program"] if k.startswith("bundle[")]
+        assert payload["cost_by_program"][bundle[0]]["dispatches"] >= 1
+        # the scrape is exactly what `telemetry costs` tabulates
+        scrape = tmp_path / "scrape.json"
+        scrape.write_text(json.dumps(payload))
+        assert telemetry.main(["costs", str(scrape), "--top", "3"]) == 0
+
+
+class TestSaturationGauges:
+    def test_seeded_to_zero_at_server_start(self):
+        with flox_tpu.set_options(telemetry=True):
+            port = exposition.start_metrics_server(port=0)
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            )
+            samples, types, _ = _parse_prometheus(resp.read().decode())
+        for name in telemetry.SATURATION_GAUGES:
+            metric = "flox_tpu_" + name.replace(".", "_")
+            assert samples[metric] == 0, f"{metric} not seeded"
+            assert types[metric] == "gauge"
+
+    def test_seeding_never_rewinds_a_live_gauge(self):
+        with flox_tpu.set_options(telemetry=True):
+            telemetry.METRICS.set_gauge("serve.queue_depth", 7)
+            telemetry.seed_saturation_gauges()
+            assert telemetry.METRICS.get("serve.queue_depth") == 7
+
+    def test_sample_saturation_reads_live_state(self):
+        from flox_tpu import pipeline
+        from flox_tpu.serve.dispatcher import _PENDING_REGISTRY
+
+        with flox_tpu.set_options(telemetry=True):
+            _PENDING_REGISTRY[991] = object()
+            pipeline._PREFETCH_INFLIGHT[0] = 3
+            try:
+                telemetry.sample_saturation()
+            finally:
+                _PENDING_REGISTRY.pop(991, None)
+                pipeline._PREFETCH_INFLIGHT[0] = 0
+            assert telemetry.METRICS.get("serve.queue_depth") == 1
+            assert telemetry.METRICS.get("stream.prefetch_occupancy") == 3
+
+    def test_sampler_thread_runs_and_stops(self):
+        import time as _time
+
+        with flox_tpu.set_options(telemetry=True, metrics_sample_interval=0.01):
+            assert telemetry.start_saturation_sampler() is True
+            # idempotent while live
+            assert telemetry.start_saturation_sampler() is True
+            for _ in range(200):
+                if telemetry.METRICS.gauges().get("serve.queue_depth") is not None:
+                    break
+                _time.sleep(0.01)
+            assert telemetry.METRICS.gauges().get("serve.queue_depth") == 0
+        telemetry.stop_saturation_sampler()
+        assert telemetry._SAMPLER_STATE["thread"] is None
+
+    def test_sampler_off_by_default(self):
+        with flox_tpu.set_options(telemetry=True):
+            assert telemetry.start_saturation_sampler() is False
+
+    def test_prefetch_occupancy_returns_to_zero_after_stream(self):
+        from flox_tpu import pipeline
+
+        n = 512
+        labels = RNG.integers(0, 4, n)
+        vals = RNG.normal(size=n)
+        with flox_tpu.set_options(telemetry=True, stream_prefetch=2):
+            streaming_groupby_reduce(vals, labels, func="sum", batch_len=64)
+        assert pipeline.prefetch_occupancy() == 0
+
+
+class TestFullPlaneBitIdentity:
+    def test_bit_identity_with_cost_plane_enabled(self, tmp_path, monkeypatch):
+        # the whole ISSUE 9 plane at once: cost ledger feeding, exemplars,
+        # tenant axis off, saturation sampler live, capture state guarded —
+        # results must stay bit-identical to the disabled run
+        from flox_tpu import device
+
+        expected, groups = _run_reduce()
+        monkeypatch.setattr(
+            device,
+            "memory_stats",
+            lambda devices=None: {"bytes_in_use": 1, "peak_bytes_in_use": 2},
+        )
+        with flox_tpu.set_options(
+            telemetry=True,
+            metrics_sample_interval=0.01,
+            profile_dir=str(tmp_path),
+            flight_recorder_path=str(tmp_path / "f.jsonl"),
+        ):
+            port = exposition.start_metrics_server(port=0)
+            with telemetry.trace("bit-req-9"):
+                got, g2 = _run_reduce()
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/costs", timeout=5
+            )
+            assert resp.status == 200
+        np.testing.assert_array_equal(np.asarray(expected), np.asarray(got))
+        np.testing.assert_array_equal(np.asarray(groups), np.asarray(g2))
+        assert cache.stats()["cost_by_program"]
