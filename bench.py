@@ -403,6 +403,53 @@ def main() -> None:
         "prefetch_speedup": round(t_sync / t_pre, 2),
     }
 
+    # --- multi-statistic fusion: one pass vs N sequential passes ----------
+    # (flox_tpu/fusion.py) the climatology family set {mean, var, min, max}
+    # through groupby_aggregate_many (one program, bytes staged once) vs
+    # four sequential groupby_reduce passes. GB/s is against ONE logical
+    # read of the bytes for BOTH, so the sequential number directly shows
+    # the bytes-touched penalty; the measurements seed the "fused"
+    # autotune family that arbitrates the dispatch.
+    fused_info = None
+    try:
+        f_funcs = ("mean", "var", "min", "max")
+        f_rows = min(nlat * nlon, max(1, int(256e6) // (ntime * 4)))
+        f_data = dev_data[:f_rows]
+        f_bytes = f_rows * ntime * 4
+        f_reps = max(2, reps // 2)
+
+        def _t_fused():
+            t0 = time.perf_counter()
+            outs, _ = flox_tpu.groupby_aggregate_many(f_data, month, funcs=f_funcs)
+            for v in outs.values():
+                np.asarray(v)
+            return time.perf_counter() - t0
+
+        def _t_seq():
+            t0 = time.perf_counter()
+            for f in f_funcs:
+                np.asarray(flox_tpu.groupby_reduce(f_data, month, func=f)[0])
+            return time.perf_counter() - t0
+
+        _t_fused()  # compile + warm both paths outside the timed reps
+        _t_seq()
+        t_fused = min(_t_fused() for _ in range(f_reps))
+        t_seq = min(_t_seq() for _ in range(f_reps))
+        fused_info = {
+            "funcs": list(f_funcs),
+            # the band the sweep actually measured (f_rows may be far
+            # below the headline workload) — autotune records key on it
+            "nelems": f_rows * ntime,
+            "fused_sweep_gbps": {
+                "fused": round(f_bytes / t_fused / 1e9, 3),
+                "sequential": round(f_bytes / t_seq / 1e9, 3),
+            },
+            "speedup": round(t_seq / t_fused, 2),
+        }
+    except Exception as exc:  # noqa: BLE001 — keep the headline alive
+        print(f"flox-tpu bench: fused sweep failed: {exc}",
+              file=sys.stderr, flush=True)
+
     # --- telemetry profile of the headline reduction (ISSUE 4) ------------
     # one instrumented pass, OUTSIDE the timed reps so the numbers above
     # stay clean: compile counts + span-phase breakdown make this round
@@ -445,6 +492,16 @@ def main() -> None:
             if q_gbps:
                 autotune.record("quantile", qimpl, q_gbps, dtype="float32",
                                 ngroups=size, nelems=nelems_bench, source="bench")
+        # the fused sweep seeds the fused-vs-sequential dispatch family —
+        # under the band it MEASURED (its bounded row subset), not the
+        # headline workload's
+        for cand, f_gbps in ((fused_info or {}).get("fused_sweep_gbps") or {}).items():
+            if f_gbps:
+                autotune.record(
+                    "fused", cand, f_gbps, dtype="float32", ngroups=size,
+                    nelems=(fused_info or {}).get("nelems", nelems_bench),
+                    source="bench",
+                )
         autotune.save()  # no-op without a configured autotune_cache_path
         families = {"headline": gbps}
         families.update({f"segment_sum[{k}]": v for k, v in sweep_gbps.items()})
@@ -453,6 +510,11 @@ def main() -> None:
         )
         families["streaming[sync]"] = streaming["gbps_sync"]
         families["streaming[prefetch]"] = streaming["gbps_prefetch"]
+        families.update(
+            {f"fused[{k}]": v
+             for k, v in ((fused_info or {}).get("fused_sweep_gbps") or {}).items()
+             if v}
+        )
         regressions = autotune.regression_sentinel(
             families, history_path=HISTORY_PATH, platform=backend,
             workload={"nlat": nlat, "nlon": nlon, "ntime": ntime,
@@ -478,6 +540,7 @@ def main() -> None:
         "impl_sweep_gbps": sweep_gbps,
         "quantile_gbps": quantile_gbps,
         "streaming": streaming,
+        "fused": fused_info,
         "telemetry": telemetry_profile,
         "autotune": autotune_record,
         "regressions": regressions,

@@ -149,6 +149,58 @@ def bench_random_big(engine: str, scale: str):
     return [{"bench": f"random_big_array[{engine}]", "value": round(gbps, 2), "unit": "GB/s"}]
 
 
+def bench_fused(engine: str, scale: str):
+    """fused_sweep_gbps: groupby_aggregate_many's one-pass multi-statistic
+    dispatch vs N sequential groupby_reduce passes on the climatology
+    family set (impl_sweep_gbps style — GB/s against ONE logical read of
+    the bytes for both, so the sequential row shows the bytes-touched
+    penalty directly). The measurements feed the "fused" autotune family."""
+    from flox_tpu import groupby_aggregate_many, groupby_reduce
+
+    funcs = ("mean", "var", "min", "max")
+    nt = 8760 if scale == "full" else 2000
+    rows = 64 if scale == "full" else 16
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(rows, nt)).astype(np.float32)
+    labels = (np.arange(nt) // 31) % 12
+    nbytes = vals.nbytes
+
+    def run_fused():
+        outs, _ = groupby_aggregate_many(vals, labels, funcs=funcs, engine=engine)
+        for v in outs.values():
+            _block(v)
+
+    def run_seq():
+        for f in funcs:
+            _block(groupby_reduce(vals, labels, func=f, engine=engine)[0])
+
+    t_fused = _timeit(run_fused)
+    t_seq = _timeit(run_seq)
+    out = [
+        {"bench": f"fused_sweep_gbps[fused-{engine}]",
+         "value": round(nbytes / t_fused / 1e9, 3), "unit": "GB/s"},
+        {"bench": f"fused_sweep_gbps[sequential-{engine}]",
+         "value": round(nbytes / t_seq / 1e9, 3), "unit": "GB/s"},
+        {"bench": f"fused_speedup[{engine}]",
+         "value": round(t_seq / t_fused, 2), "unit": "x"},
+    ]
+    if engine == "jax":
+        # only device-path measurements feed the dispatch family: the
+        # store keys carry no engine axis, and host-numpy ratios say
+        # nothing about the jax fused-vs-sequential decision
+        try:
+            from flox_tpu import autotune
+
+            for cand, t in (("fused", t_fused), ("sequential", t_seq)):
+                autotune.record(
+                    "fused", cand, nbytes / t / 1e9, dtype=str(vals.dtype),
+                    ngroups=12, nelems=vals.size, source="bench",
+                )
+        except Exception:  # noqa: BLE001 — recording is best-effort
+            pass
+    return out
+
+
 def bench_mesh_methods(scale: str):
     """Mesh execution-method comparison (the analogue of the reference's
     time_combine: _simple_combine vs _grouped_combine, combine.py:27-77 —
@@ -453,6 +505,7 @@ def main() -> None:
             results += bench_era5_resampling(engine, args.scale)
             results += bench_nwm_zonal(engine, args.scale)
             results += bench_random_big(engine, args.scale)
+            results += bench_fused(engine, args.scale)
             results += bench_scan(engine, args.scale)
         if "jax" in engines:
             # mesh benchmarks need a working jax backend; keep --engine numpy

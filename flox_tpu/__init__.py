@@ -13,8 +13,13 @@ from .rechunk import rechunk_for_blockwise, rechunk_for_cohorts, reshard_for_blo
 from .reindex import ReindexArrayType, ReindexStrategy
 from .core import groupby_reduce
 from .device import codes_device, groupby_reduce_device
+from .fusion import FUSABLE_FUNCS, groupby_aggregate_many
 from .scan import groupby_scan
-from .streaming import streaming_groupby_reduce, streaming_groupby_scan
+from .streaming import (
+    streaming_groupby_aggregate_many,
+    streaming_groupby_reduce,
+    streaming_groupby_scan,
+)
 from .dtypes import INF, NA, NINF
 from .factorize import factorize_, factorize_single
 from .multiarray import MultiArray
@@ -22,6 +27,7 @@ from .options import set_options
 
 __all__ = [
     "Aggregation",
+    "FUSABLE_FUNCS",
     "INF",
     "NA",
     "NINF",
@@ -34,6 +40,7 @@ __all__ = [
     "factorize_single",
     "faults",
     "codes_device",
+    "groupby_aggregate_many",
     "groupby_reduce",
     "groupby_reduce_device",
     "groupby_scan",
@@ -48,6 +55,7 @@ __all__ = [
     "resilience",
     "serve",
     "set_options",
+    "streaming_groupby_aggregate_many",
     "streaming_groupby_reduce",
     "streaming_groupby_scan",
     "telemetry",

@@ -29,10 +29,14 @@ from .multiarray import MultiArray
 
 __all__ = [
     "Aggregation",
+    "FusedAggregation",
     "Scan",
     "AGGREGATIONS",
     "SCANS",
+    "FUSABLE_FUNCS",
     "generic_aggregate",
+    "plan_fused",
+    "fused_chunk_stats",
     "_initialize_aggregation",
     "_initialize_scan",
     "is_supported_aggregation",
@@ -349,6 +353,14 @@ def _initialize_aggregation(
 ) -> Aggregation:
     """Resolve a registry template into a concrete plan
     (parity: aggregations.py:925-1030)."""
+    if isinstance(func, FusedAggregation):
+        # a fused plan is already fully resolved (per-statistic fills and
+        # dtypes live in its member aggs); re-resolving would mangle it
+        raise TypeError(
+            "FusedAggregation plans run through groupby_aggregate_many / "
+            "streaming_groupby_aggregate_many, not single-statistic entry "
+            "points"
+        )
     if isinstance(func, Aggregation):
         agg = copy.deepcopy(func)
     else:
@@ -419,6 +431,377 @@ def _chunk_names(agg: Aggregation) -> tuple[str, ...]:
         elif isinstance(c, str):
             out.append(c)
     return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# multi-statistic fusion: one chunk pass serving N requested statistics
+# ---------------------------------------------------------------------------
+
+#: statistics the fusion planner can merge into one multi-output chunk plan:
+#: everything whose chunk intermediates merge with the elementwise/Chan
+#: combines. Argreductions and first/last carry position channels with
+#: order-dependent merges, and order statistics are multi-pass — they stay
+#: on the sequential path.
+FUSABLE_FUNCS = frozenset(
+    {
+        "sum", "nansum", "prod", "nanprod", "count",
+        "min", "nanmin", "max", "nanmax",
+        "mean", "nanmean", "var", "nanvar", "std", "nanstd",
+        "all", "any",
+    }
+)
+
+_SKIPNA_FUNCS = frozenset(
+    {"nansum", "nanprod", "count", "nanmin", "nanmax", "nanmean",
+     "nanvar", "nanstd"}
+)
+
+
+@dataclass
+class FusedAggregation(Aggregation):
+    """A multi-output aggregation: one deduplicated chunk plan serving N
+    requested statistics.
+
+    The planner (:func:`plan_fused`) merges the requested ``Aggregation``
+    blueprints: identical chunk kernels collapse to one leg (mean's
+    sum+count, min_count's nanlen, every presence count), and when a
+    var-family statistic is requested its Chan triple's (total, count)
+    leaves serve mean directly — the data is touched once for the whole
+    statistic set. ``chunk`` / ``combine`` / ``fill_value`` hold the
+    deduplicated legs in the exact layout the generic runtimes consume
+    (``_local_chunk`` iteration, ``_combine_intermediates`` psum/pmax/Chan
+    merges, the streaming ``_merge_into`` carry), so ONE mesh program /
+    ONE streaming carry covers all N statistics. ``slots`` maps each
+    statistic to its legs; :meth:`finalize_fused` folds the combined legs
+    into the per-statistic results.
+    """
+
+    #: resolved per-statistic blueprints, request order
+    aggs: tuple = ()
+    #: requested names, request order (the output dict keys)
+    funcs: tuple = ()
+    #: per-statistic addressing into the deduplicated legs (see plan_fused)
+    slots: tuple = ()
+    #: per-leg eager-path dtype requests (None on the mesh/streaming paths,
+    #: which never request dtypes — mirroring _local_chunk vs chunk_reduce)
+    eager_dtypes: tuple = ()
+
+    def finalize_fused(self, inters, counts=None):
+        """Combined legs -> tuple of finalized per-statistic results.
+
+        ``counts`` (the runtimes' generic count channel) is ignored: every
+        statistic reads its OWN presence leg, because skipna and
+        propagating statistics disagree about what "empty" means. Works on
+        jax arrays (traced — the eager program and the mesh programs call
+        it in-jit) and on host numpy (the numpy engine).
+        """
+        results = []
+        for agg, slot in zip(self.aggs, self.slots):
+            results.append(_finalize_slot(agg, slot, inters, self.min_count))
+        return tuple(results)
+
+
+def _read_leg(inters, addr):
+    """Resolve a leg address: an int (whole leg) or (leg, leaf) into a
+    MultiArray leg (the var triple's total/count leaves)."""
+    if isinstance(addr, tuple):
+        leg, leaf = addr
+        return inters[leg].arrays[leaf]
+    return inters[addr]
+
+
+def _xp_for(x):
+    if _is_jaxish(x):
+        import jax.numpy as jnp
+
+        return jnp
+    return np
+
+
+def _masked_fill(result, empty, fill_value):
+    """Apply a final fill where ``empty`` — THE final-fill promotion rules
+    (NaN fills promote int results to float, identity fills cast to the
+    result dtype, complex counts as inexact), dual-mode jax/numpy. The
+    single implementation behind the fused finalize AND the mesh programs'
+    ``_apply_final_fill`` (parallel/mapreduce.py), so fused/sequential
+    parity cannot drift."""
+    if fill_value is None:
+        return result
+    xp = _xp_for(result)
+    try:
+        fill_is_nan = bool(np.isnan(fill_value))
+    except (TypeError, ValueError):
+        fill_is_nan = False
+    fv = xp.asarray(fill_value)
+    res_inexact = xp.issubdtype(result.dtype, xp.floating) or xp.issubdtype(
+        result.dtype, xp.complexfloating
+    )
+    if xp.issubdtype(fv.dtype, xp.floating) and not res_inexact:
+        if fill_is_nan:
+            promoted = (
+                xp.float64 if (xp is np or utils.x64_enabled()) else xp.float32
+            )
+            result = result.astype(promoted)
+        else:
+            fv = fv.astype(result.dtype)
+    empty_b = xp.broadcast_to(xp.asarray(empty), result.shape)
+    return xp.where(empty_b, fv.astype(result.dtype), result)
+
+
+def _finalize_slot(agg: Aggregation, slot: dict, inters, min_count: int):
+    """One statistic's result from the combined legs."""
+    kind = slot["kind"]
+    if kind == "var":
+        ma = inters[slot["leg"]]
+        fin = _std_finalize if slot["std"] else _var_finalize
+        out = fin(ma, **agg.finalize_kwargs)
+        present = ma.arrays[2] > 0
+    elif kind == "mean":
+        total = _read_leg(inters, slot["sum"])
+        cnt = _read_leg(inters, slot["count"])
+        cntf = cnt.astype(total.dtype) if cnt.dtype != total.dtype else cnt
+        if _is_jaxish(total):
+            out = total / cntf
+        else:
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out = total / cntf
+        present = cnt > 0
+    elif kind == "count":
+        out = inters[slot["leg"]]
+        present = out > 0
+    else:  # "direct": sum/prod/min/max/all/any — the leg IS the value
+        out = inters[slot["leg"]]
+        present = _read_leg(inters, slot["present"]) > 0
+    xp = _xp_for(out)
+    out = _masked_fill(out, ~xp.asarray(present), agg.final_fill_value)
+    if min_count > 0:
+        nn = inters[slot["nanlen"]]
+        out = _masked_fill(out, nn < min_count, agg.final_fill_value)
+    return out
+
+
+def plan_fused(
+    funcs,
+    dtype,
+    array_dtype,
+    fill_value,
+    min_count: int,
+    finalize_kwargs,
+) -> FusedAggregation:
+    """The fusion planner: merge N statistic blueprints into one
+    multi-output chunk plan (the generalization of the reference's
+    mean = sum+count single-pass blueprint, aggregations.py:161, to an
+    arbitrary statistic set).
+
+    ``funcs``: statistic names (see :data:`FUSABLE_FUNCS`). ``fill_value``
+    and ``finalize_kwargs`` may be per-statistic dicts (``{"var": ...}``)
+    or a single value applied to all. Deduplication: identical chunk legs
+    collapse; when a var-family statistic shares its skipna mode with
+    mean, mean reads the Chan triple's (total, count) leaves instead of
+    adding legs — min/max ride free next to them.
+    """
+    funcs = tuple(funcs)
+    if len(funcs) == 0:
+        raise ValueError("groupby_aggregate_many needs at least one func")
+    if len(set(funcs)) != len(funcs):
+        raise ValueError(f"duplicate funcs in {funcs!r}")
+    bad = [f for f in funcs if not isinstance(f, str) or f not in FUSABLE_FUNCS]
+    if bad:
+        raise NotImplementedError(
+            f"cannot fuse {bad!r}: fusable statistics are "
+            f"{sorted(FUSABLE_FUNCS)} (argreductions, first/last and order "
+            "statistics keep their sequential paths)"
+        )
+
+    def per_func(v, f):
+        if isinstance(v, dict):
+            return v.get(f)
+        return v
+
+    aggs = []
+    for f in funcs:
+        agg = _initialize_aggregation(
+            f, per_func(dtype, f), array_dtype, per_func(fill_value, f),
+            min_count, per_func(finalize_kwargs, f) or {},
+        )
+        if agg.appended_count:
+            # the fused plan carries ONE shared nanlen leg for min_count;
+            # the per-agg appended count would otherwise mask the combine
+            # signature (var's ("var",) becomes ("var", "sum")) and
+            # misclassify the Chan triple below
+            agg.chunk = agg.chunk[:-1]
+            agg.combine = agg.combine[:-1]
+            agg.fill_value["intermediate"] = agg.fill_value["intermediate"][:-1]
+            agg.appended_count = False
+        aggs.append(agg)
+    aggs = tuple(aggs)
+
+    legs: list[dict] = []  # {"entry", "combine", "fill", "eager_dtype"}
+    index: dict[tuple, int] = {}
+
+    def add_leg(entry, combine, fill, eager_dtype=None):
+        if isinstance(entry, tuple):
+            name, kw = entry[0], tuple(sorted(dict(entry[1]).items()))
+        else:
+            name, kw = entry, ()
+        key = (
+            name, kw, repr(fill),
+            None if eager_dtype is None else np.dtype(eager_dtype).name,
+        )
+        if key in index:
+            return index[key]
+        index[key] = len(legs)
+        legs.append(
+            {"entry": entry, "combine": combine, "fill": fill,
+             "eager_dtype": eager_dtype}
+        )
+        return index[key]
+
+    # pass 1: var-family triples first, so mean can alias into them
+    var_leg: dict[bool, int] = {}  # skipna -> leg index
+    for f, agg in zip(funcs, aggs):
+        if agg.combine == ("var",):
+            skipna = f in _SKIPNA_FUNCS
+            var_leg.setdefault(
+                skipna,
+                add_leg(("var_chunk", {"skipna": skipna}), "var",
+                        agg.fill_value["intermediate"][0]),
+            )
+
+    nanlen_leg = add_leg("nanlen", "sum", 0) if min_count > 0 else None
+
+    slots: list[dict] = []
+    for f, agg in zip(funcs, aggs):
+        skipna = f in _SKIPNA_FUNCS
+        # presence ("no fill needed") semantics per statistic: nanmin/nanmax
+        # of an all-NaN group is missing (nanlen), but nansum/nanprod of one
+        # is the identity — numpy semantics: only zero-TOTAL-element groups
+        # take the fill there (kernels._make_addlike's comment)
+        presence_entry = "nanlen" if f in ("nanmin", "nanmax") else "len"
+        if agg.combine == ("var",):
+            slot = {
+                "kind": "var", "leg": var_leg[skipna],
+                "std": f in ("std", "nanstd"),
+            }
+        elif f in ("mean", "nanmean"):
+            if skipna in var_leg:
+                # sum/count feed mean AND var: read the Chan triple's
+                # (total, count) leaves — zero extra legs
+                tleg = var_leg[skipna]
+                slot = {
+                    "kind": "mean",
+                    "sum": (tleg, 1), "count": (tleg, 2),
+                    "present": (tleg, 2),
+                }
+            else:
+                sum_k, len_k = agg.chunk[0], agg.chunk[1]
+                # the float work dtype, so int inputs promote exactly as
+                # the direct eager mean kernel does
+                s = add_leg(sum_k, "sum", 0, eager_dtype=agg.final_dtype)
+                c = add_leg(len_k, "sum", 0)
+                slot = {"kind": "mean", "sum": s, "count": c, "present": c}
+        elif f == "count":
+            leg = add_leg("nanlen", "sum", 0)
+            slot = {"kind": "count", "leg": leg}
+        else:
+            entry = agg.chunk[0]
+            fill = agg.fill_value["intermediate"][0]
+            edt = None
+            if f in ("sum", "nansum", "prod", "nanprod") and not agg.preserves_dtype:
+                edt = agg.final_dtype  # chunk_reduce's kdtypes[0] rule
+            leg = add_leg(entry, agg.combine[0], fill, eager_dtype=edt)
+            p = add_leg(presence_entry, "sum", 0)
+            slot = {"kind": "direct", "leg": leg, "present": p}
+        if min_count > 0:
+            slot["nanlen"] = nanlen_leg
+        slots.append(slot)
+
+    fused = FusedAggregation(
+        name="fused[" + "+".join(funcs) + "]",
+        numpy=funcs,
+        chunk=tuple(leg["entry"] for leg in legs),
+        combine=tuple(leg["combine"] for leg in legs),
+        fill_value={"intermediate": tuple(leg["fill"] for leg in legs)},
+        final_fill_value=0,
+        min_count=min_count,
+        aggs=aggs,
+        funcs=funcs,
+        slots=tuple(slots),
+        eager_dtypes=tuple(leg["eager_dtype"] for leg in legs),
+    )
+    return fused
+
+
+def fused_chunk_stats(
+    agg: FusedAggregation, group_idx, array, *, size: int, engine: str = "jax",
+    eager: bool = False,
+):
+    """Run the fused chunk plan: one intermediate per leg.
+
+    The jax-engine path routes the megakernel-eligible legs (sums, counts,
+    min/max over the same float data) through
+    ``kernels.fused_segment_stats`` — ONE Pallas pass with every
+    accumulator resident in VMEM — and falls back to per-leg XLA
+    ``segment_*`` kernels otherwise (still one jitted program, fused by
+    XLA). ``eager=True`` applies the per-leg dtype requests the eager
+    bundle makes (mesh/streaming never request dtypes — parity with
+    ``_local_chunk``)."""
+    from . import kernels
+
+    names = [leg[0] if isinstance(leg, tuple) else leg for leg in agg.chunk]
+    # resolved BEFORE any array-derived name exists: only dtype NAMES are
+    # compared below, so no traced value ever reaches a numpy call
+    # (FLX011-clean — .dtype is a host attribute on tracers too)
+    req_names = [
+        None if _rd is None else np.dtype(_rd).name for _rd in agg.eager_dtypes
+    ]
+    dtype_name = str(array.dtype)
+
+    mega: dict[int, Any] = {}
+    if engine == "jax":
+        mega_mask = [
+            n in ("sum", "nansum", "min", "nanmin", "max", "nanmax",
+                  "len", "nanlen")
+            # a pending dtype-request cast would change what the one-pass
+            # kernel sums; only no-op requests may ride it
+            and (not eager or req_names[i] is None or req_names[i] == dtype_name)
+            for i, n in enumerate(names)
+        ]
+        wanted = tuple(dict.fromkeys(
+            names[i] for i, ok in enumerate(mega_mask) if ok
+        ))
+        if len(wanted) >= 2:
+            got = kernels.fused_segment_stats(
+                group_idx, array, size=size, want=wanted
+            )
+            if got is not None:
+                for i, ok in enumerate(mega_mask):
+                    if ok and names[i] in got:
+                        mega[i] = got[names[i]]
+
+    inters = []
+    for i, (entry, fv) in enumerate(zip(agg.chunk, agg.fill_value["intermediate"])):
+        if i in mega:
+            inters.append(mega[i])
+            continue
+        if isinstance(entry, tuple):
+            name, extra = entry[0], dict(entry[1])
+        else:
+            name, extra = entry, {}
+        dt = agg.eager_dtypes[i] if eager else None
+        if engine == "jax" and not eager and name in (
+            "sum", "nansum", "prod", "nanprod"
+        ):
+            # bf16/f16 intermediates travel and merge in the f32
+            # accumulator (parity: _local_chunk's keep_acc)
+            extra["keep_acc"] = True
+        inters.append(
+            generic_aggregate(
+                group_idx, array, engine=engine, func=name, size=size,
+                fill_value=fv, dtype=dt, **extra,
+            )
+        )
+    return inters
 
 
 # ---------------------------------------------------------------------------

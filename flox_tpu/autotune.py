@@ -119,7 +119,9 @@ _REGRESSION_THRESHOLD = 0.15
 #: crossover is sharply size-dependent (numpy wins only for small hosts
 #: arrays), so its records must not stretch; kernel-lowering winners are
 #: stable across decades of size, so seeds from bench-scale workloads may
-#: serve interactive-scale calls.
+#: serve interactive-scale calls. The "fused" family (fused-vs-sequential
+#: multi-statistic dispatch, fed by bench.py's fused_sweep_gbps) rides the
+#: stretchy default for the same reason.
 _NEAREST_TOLERANCE = {"engine": 1}
 _NEAREST_TOLERANCE_DEFAULT = 6
 
@@ -571,6 +573,23 @@ def _seed_from_bench_record(payload: Mapping[str, Any]) -> int:
                     ngroups=ngroups, nelems=nelems, platform=plat, source="seed",
                 )
                 count += 1
+    fused = payload.get("fused")
+    if isinstance(fused, Mapping):
+        sweep_f = fused.get("fused_sweep_gbps")
+        # the fused sweep may have measured a bounded row subset: its
+        # record carries the band it actually timed
+        fused_nelems = fused.get("nelems")
+        if not isinstance(fused_nelems, int) or fused_nelems <= 0:
+            fused_nelems = nelems
+        if isinstance(sweep_f, Mapping):
+            for cand, gbps in sweep_f.items():
+                if isinstance(gbps, (int, float)) and gbps > 0:
+                    record(
+                        "fused", str(cand), float(gbps), dtype="float32",
+                        ngroups=ngroups, nelems=fused_nelems, platform=plat,
+                        source="seed",
+                    )
+                    count += 1
     return count
 
 

@@ -111,6 +111,7 @@ def stats() -> dict:
     from .cohorts import _COHORTS_CACHE
     from .core import _jitted_bundle
     from .factorize import _FACTORIZE_CACHE
+    from .fusion import _FUSED_PROGRAM_CACHE
     from .parallel.mapreduce import _PROGRAM_CACHE
     from .parallel.scan import _SCAN_CACHE
     from .profiling import capture_active
@@ -145,6 +146,7 @@ def stats() -> dict:
         "mesh_programs": len(_PROGRAM_CACHE),
         "scan_programs": len(_SCAN_CACHE),
         "stream_steps": len(_STEP_CACHE),
+        "fused_programs": len(_FUSED_PROGRAM_CACHE),
         "autotune": len(_AUTOTUNE_CACHE),
         # capacity evictions of the compiled-program LRUs: a serving
         # process alarms on these climbing (program-cache thrash shows up
@@ -152,6 +154,7 @@ def stats() -> dict:
         "evictions": {
             "mesh_programs": _PROGRAM_CACHE.evictions,
             "stream_steps": _STEP_CACHE.evictions,
+            "fused_programs": _FUSED_PROGRAM_CACHE.evictions,
         },
         # serving layer: queued/in-flight requests, open coalescing
         # entries + micro-batches, and AOT programs pending manifest save
@@ -178,10 +181,13 @@ def clear_all() -> None:
     from .cohorts import _COHORTS_CACHE
     from .core import _jitted_bundle
     from .factorize import _FACTORIZE_CACHE, _FACTORIZE_CACHE_BYTES
+    from .fusion import _FUSED_PROGRAM_CACHE
     from .kernels import (
         _PALLAS_COMPILE_PROBE,
         _PALLAS_MINMAX_COMPILE_PROBE,
         _PALLAS_MINMAX_PROBE_RESULT,
+        _PALLAS_MULTISTAT_COMPILE_PROBE,
+        _PALLAS_MULTISTAT_PROBE_RESULT,
         _PALLAS_PROBE_RESULT,
         _PALLAS_SCAN_COMPILE_PROBE,
         _PALLAS_SCAN_PROBE_RESULT,
@@ -208,6 +214,7 @@ def clear_all() -> None:
     _PROGRAM_CACHE.clear()
     _SCAN_CACHE.clear()
     _STEP_CACHE.clear()
+    _FUSED_PROGRAM_CACHE.clear()
     _DONATION_OK.clear()
     _SNAPSHOTS.clear()
     # serving layer (flox_tpu/serve/): admission/pending table, coalescing
@@ -228,6 +235,8 @@ def clear_all() -> None:
     _PALLAS_MINMAX_COMPILE_PROBE.clear()
     _PALLAS_SCAN_PROBE_RESULT.clear()
     _PALLAS_SCAN_COMPILE_PROBE.clear()
+    _PALLAS_MULTISTAT_PROBE_RESULT.clear()
+    _PALLAS_MULTISTAT_COMPILE_PROBE.clear()
     # autotune measurement store + its counters/lazy-load flag: clearing
     # returns the tuner to the unloaded state, so the next consult reloads
     # the persisted file (or runs plain heuristics when no path is set) —

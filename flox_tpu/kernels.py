@@ -48,7 +48,7 @@ import numpy as np
 
 from .multiarray import MultiArray
 
-__all__ = ["KERNELS", "generic_kernel"]
+__all__ = ["KERNELS", "generic_kernel", "fused_segment_stats"]
 
 _BIG = np.iinfo(np.int32).max
 
@@ -148,6 +148,24 @@ def _use_matmul_path(op: str, data, size: int) -> bool:
 def _seg_matmul_sum(data, codes, size: int, *, skipna: bool = False, return_nan_counts: bool = False):
     """(N, ...) × one-hot(N, size) -> (size, ...) on the MXU.
 
+    A thin IEEE-reapply wrapper over :func:`_seg_matmul_raw`."""
+    sums, nan_c, pos_c, neg_c = _seg_matmul_raw(data, codes, size)
+    from .utils import reapply_nonfinite
+
+    out_v = reapply_nonfinite(sums, nan_c, pos_c, neg_c, skipna=skipna)
+    if return_nan_counts:
+        # lets nanmean fuse its count: non-NaN count = rowcount - nan_c,
+        # with rowcount a codes-only (no data traffic) segment sum
+        return out_v, nan_c
+    return out_v
+
+
+def _seg_matmul_raw(data, codes, size: int):
+    """The GEMM core: raw zero-filled sums plus NaN/±inf marker counts,
+    each shaped ``(size,) + data.shape[1:]`` — callers re-apply IEEE
+    propagation per skipna mode (one GEMM pass can serve BOTH the sum and
+    nansum variants of the fused multi-statistic plan).
+
     codes may contain the missing sentinel (== size); the one-hot row is all
     zeros there, so missing labels drop out for free.
 
@@ -233,19 +251,12 @@ def _seg_matmul_sum(data, codes, size: int, *, skipna: bool = False, return_nan_
                 [parts, stats_gemm(flat_t[nfull * kb_max :])], axis=0
             )
 
-    sums = parts[:, 0].T  # (size, K)
-    nan_c = parts[:, 1].T
-    pos_c = parts[:, 2].T
-    neg_c = parts[:, 3].T
-    from .utils import reapply_nonfinite
-
-    out_v = reapply_nonfinite(sums, nan_c, pos_c, neg_c, skipna=skipna)
-    out_v = out_v.reshape((size,) + data.shape[1:])
-    if return_nan_counts:
-        # lets nanmean fuse its count: non-NaN count = rowcount - nan_c,
-        # with rowcount a codes-only (no data traffic) segment sum
-        return out_v, nan_c.reshape((size,) + data.shape[1:])
-    return out_v
+    trail = (size,) + data.shape[1:]
+    sums = parts[:, 0].T.reshape(trail)  # (size, K) -> (size, ...)
+    nan_c = parts[:, 1].T.reshape(trail)
+    pos_c = parts[:, 2].T.reshape(trail)
+    neg_c = parts[:, 3].T.reshape(trail)
+    return sums, nan_c, pos_c, neg_c
 
 
 _PALLAS_PROBE_RESULT: list = []  # memoized one-time runtime validation
@@ -677,32 +688,149 @@ def len_(group_idx, array, *, axis=-1, size, fill_value=None, dtype=None, **kw):
     return _from_leading(out)
 
 
-def _fused_sum_counts(cast, codes, size: int):
-    """Single-pass skipna (total, non-NaN count) on the marker paths.
+_PALLAS_MULTISTAT_PROBE_RESULT: list = []
+_PALLAS_MULTISTAT_COMPILE_PROBE: list = []
 
-    The GEMM/Pallas kernels zero non-finite values themselves and emit NaN
-    marker counts, so non-NaN counts are ``rowcount(codes) - nan_c`` —
-    rowcount touches only the codes, and HBM sees the data ONCE (no
-    pre-mask pass, no data-shaped count accumulation). Returns None when
-    the policy resolves to scatter or the f32 marker-count exactness guard
-    (2^24 contributions) fails.
+
+def _pallas_multistat_runtime_ok() -> bool:
+    from .pallas_kernels import probe_compile_multistat, segment_multistat_pallas
+
+    def _exec():
+        data = jnp.arange(8 * 128, dtype=jnp.float32).reshape(8, 128)
+        sums, _nan, _pos, _neg, mins, maxs = segment_multistat_pallas(
+            data, jnp.zeros(8, jnp.int32), 2
+        )
+        return (
+            np.asarray(sums)[0, 0] == float(sum(range(0, 8 * 128, 128)))
+            and np.asarray(mins)[0, 0] == 0.0
+            and np.asarray(maxs)[0, 0] == 7 * 128.0
+        )
+
+    return _probed_ok(
+        _PALLAS_MULTISTAT_PROBE_RESULT, _PALLAS_MULTISTAT_COMPILE_PROBE,
+        _exec, probe_compile_multistat, "multistat",
+    )
+
+
+_FUSABLE_LEG_NAMES = frozenset(
+    {"sum", "nansum", "len", "nanlen", "min", "nanmin", "max", "nanmax"}
+)
+
+
+def _fused_stats_leading(data, codes, size: int, want: tuple):
+    """Multi-output single-pass segment statistics on the marker paths —
+    the general form of the old ``_fused_sum_counts`` special case, shared
+    by the mean/var kernels and the multi-statistic fusion planner
+    (aggregations.fused_chunk_stats).
+
+    ``data`` (N, ...) in the leading kernel layout, ``codes`` already
+    sentinel-safe; ``want`` ⊆ {sum, nansum, len, nanlen, min, nanmin, max,
+    nanmax}. The GEMM/Pallas kernels zero non-finite values themselves and
+    emit NaN/±inf marker counts, so ONE pass yields every sum variant
+    (IEEE re-applied per skipna mode), non-NaN counts as
+    ``rowcount(codes) - nan_c`` (rowcount touches only the codes), and —
+    on the Pallas megakernel — grouped min/max with all accumulators
+    resident in VMEM across the sequential grid. Returns ``{name: (size,
+    ...)}`` or None when the policy resolves to scatter or a guard fails
+    (callers then run the per-leg kernels, which XLA still fuses into one
+    program).
     """
-    if not jnp.issubdtype(cast.dtype, jnp.floating) or cast.shape[0] >= 2**24:
+    want = tuple(want)
+    if not set(want) <= _FUSABLE_LEG_NAMES:
         return None
-    impl = _segment_sum_impl(cast, size)
-    if impl == "matmul":
-        total, nan_c = _seg_matmul_sum(cast, codes, size, skipna=True, return_nan_counts=True)
-    elif impl == "pallas":
-        from .pallas_kernels import segment_sum_pallas
+    sumish = [w for w in want if w in ("sum", "nansum")]
+    minmaxish = [w for w in want if w in ("min", "nanmin", "max", "nanmax")]
+    if not (sumish or minmaxish):
+        return None  # counts alone never justify a fused data pass
+    if not jnp.issubdtype(data.dtype, jnp.floating) or data.shape[0] >= 2**24:
+        # 2^24: the f32 marker-count exactness guard
+        return None
+    impl = _segment_sum_impl(data, size)
+    mins = maxs = None
+    if minmaxish:
+        from .options import OPTIONS
 
-        total, nan_c = segment_sum_pallas(
-            cast, codes, size, skipna=True, return_nan_counts=True,
-            interpret=not _on_tpu(),
+        ok = (
+            str(data.dtype) in ("float32", "bfloat16")
+            and size <= min(
+                OPTIONS["pallas_num_groups_max"],
+                OPTIONS["pallas_minmax_num_groups_max"],
+            )
+            and data.shape[0] >= 8
+            and impl == "pallas"
+            and (not _on_tpu() or _pallas_multistat_runtime_ok())
+        )
+        if not ok:
+            return None
+        from .pallas_kernels import segment_multistat_pallas
+
+        sums, nan_c, pos_c, neg_c, mins, maxs = segment_multistat_pallas(
+            data, codes, size, interpret=not _on_tpu()
+        )
+    elif impl == "matmul":
+        sums, nan_c, pos_c, neg_c = _seg_matmul_raw(data, codes, size)
+    elif impl == "pallas":
+        from .pallas_kernels import segment_sum_raw_pallas
+
+        sums, nan_c, pos_c, neg_c = segment_sum_raw_pallas(
+            data, codes, size, interpret=not _on_tpu()
         )
     else:
         return None
-    rowcount = _bcast_present(_counts(codes, size), total)  # codes-only
-    return total, rowcount.astype(total.dtype) - nan_c.astype(total.dtype)
+
+    from .utils import reapply_nonfinite
+
+    out: dict = {}
+    acc = sums.dtype
+    if "sum" in want:
+        out["sum"] = reapply_nonfinite(sums, nan_c, pos_c, neg_c, skipna=False)
+    if "nansum" in want:
+        out["nansum"] = reapply_nonfinite(sums, nan_c, pos_c, neg_c, skipna=True)
+    if "len" in want or "nanlen" in want:
+        rowcount = _bcast_present(_counts(codes, size), sums)  # codes-only
+        if "len" in want:
+            out["len"] = jnp.broadcast_to(rowcount, sums.shape)
+        if "nanlen" in want:
+            out["nanlen"] = (
+                jnp.broadcast_to(rowcount, sums.shape).astype(acc)
+                - nan_c.astype(acc)
+            )
+    if minmaxish:
+        # the megakernel computes min/max NaN-masked; the propagating
+        # variants re-inject NaN exactly as _make_minmax does (its
+        # has_nan flag IS nan_c > 0 here)
+        has_nan = nan_c > 0
+        nanv = jnp.asarray(jnp.nan, mins.dtype)
+        if "nanmin" in want:
+            out["nanmin"] = mins
+        if "min" in want:
+            out["min"] = jnp.where(has_nan, nanv, mins)
+        if "nanmax" in want:
+            out["nanmax"] = maxs
+        if "max" in want:
+            out["max"] = jnp.where(has_nan, nanv, maxs)
+    return out
+
+
+def fused_segment_stats(group_idx, array, *, size: int, want: tuple):
+    """Plugin-layout entry to :func:`_fused_stats_leading`: ``array``
+    (..., N) in, ``{name: (..., size)}`` out (or None) — what the fusion
+    planner's chunk executor calls."""
+    codes = _safe_codes(group_idx, size)
+    data = _to_leading(array)
+    raw = _fused_stats_leading(data, codes, size, tuple(want))
+    if raw is None:
+        return None
+    return {k: _from_leading(v) for k, v in raw.items()}
+
+
+def _fused_sum_counts(cast, codes, size: int):
+    """Single-pass skipna (total, non-NaN count): the mean/var fast path,
+    now one ``want`` set of the general fused primitive."""
+    got = _fused_stats_leading(cast, codes, size, ("nansum", "nanlen"))
+    if got is None:
+        return None
+    return got["nansum"], got["nanlen"]
 
 
 def _mean_impl(group_idx, array, *, size, fill_value, dtype, skipna):

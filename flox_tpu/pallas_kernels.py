@@ -37,7 +37,13 @@ import functools
 
 import numpy as np
 
-__all__ = ["segment_sum_pallas", "segment_minmax_pallas", "pallas_available"]
+__all__ = [
+    "segment_sum_pallas",
+    "segment_sum_raw_pallas",
+    "segment_minmax_pallas",
+    "segment_multistat_pallas",
+    "pallas_available",
+]
 
 
 def pallas_available() -> bool:
@@ -313,6 +319,166 @@ def segment_minmax_pallas(data, codes, size: int, op: str, *, interpret: bool = 
     )
     out = fn(codes_p, flat_t)
     return out[:size, :k].reshape((size,) + orig_shape[1:])
+
+
+def _minmax_accumulate(codes_ref, data_ref, out_ref, *, size, size_p, op):
+    """The min/max accumulation of the multi-statistic megakernel: the
+    ``_minmax_kernel`` select-reduce, but over RAW data (the megakernel
+    stages each tile once for every statistic), so NaN lanes are parked at
+    the op's identity here — the skipna semantics; the propagating
+    variants re-inject NaN outside from the kernel's NaN marker counts."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    ident = jnp.asarray(_minmax_identity(op, out_ref.dtype), out_ref.dtype)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.full_like(out_ref, ident)
+
+    codes = codes_ref[0, :]  # (n_tile,)
+    data = data_ref[:]  # (k_tile, n_tile)
+    data = jnp.where(jnp.isnan(data), ident, data)
+    combine = jnp.maximum if op == "max" else jnp.minimum
+    reduce_ = jnp.max if op == "max" else jnp.min
+
+    rows = []
+    for g in range(size):  # static unroll (size is gated small)
+        # edge-block garbage lanes carry the sentinel code -> identity
+        masked = jnp.where((codes == g)[None, :], data, ident)
+        rows.append(reduce_(masked, axis=1))  # (k_tile,)
+    tile_red = jnp.stack(rows)  # (size, k_tile)
+    if size_p > size:
+        tile_red = jnp.concatenate(
+            [tile_red, jnp.full((size_p - size, data.shape[0]), ident, out_ref.dtype)]
+        )
+    out_ref[:] = combine(out_ref[:], tile_red)
+
+
+def _multistat_kernel(
+    codes_ref, data_ref, out_ref, nan_ref, pos_ref, neg_ref, min_ref, max_ref,
+    comp_ref=None, *, size, size_p, n_tile, accum,
+):
+    """The fused multi-statistic megakernel: ONE HBM→VMEM pass per tile
+    feeds (a) the compensated one-hot sum contraction with its NaN/±inf
+    marker outputs (:func:`_kernel`, verbatim — the sums are bit-identical
+    to ``segment_sum_pallas`` at the same tiling) and (b) the VPU
+    select-reduce grouped min AND max. Every accumulator — sums,
+    compensation, markers, min, max — is an output block revisited across
+    the sequential n grid, i.e. resident in VMEM for the whole pass; the
+    data is read from HBM exactly once for the entire statistic set."""
+    _kernel(
+        codes_ref, data_ref, out_ref, nan_ref, pos_ref, neg_ref, comp_ref,
+        size_p=size_p, n_tile=n_tile, accum=accum,
+    )
+    _minmax_accumulate(codes_ref, data_ref, min_ref, size=size, size_p=size_p, op="min")
+    _minmax_accumulate(codes_ref, data_ref, max_ref, size=size, size_p=size_p, op="max")
+
+
+@functools.lru_cache(maxsize=128)
+def _build_multistat(
+    k_pad: int, n_pad: int, size: int, size_p: int, dtype_str: str, acc_str: str,
+    n_tile: int, k_tile: int, interpret: bool, accum: str,
+):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    kern = functools.partial(
+        _multistat_kernel, size=size, size_p=size_p, n_tile=n_tile, accum=accum
+    )
+    grid = (k_pad // k_tile, n_pad // n_tile)
+    acc = jnp.dtype(acc_str)
+    dt = jnp.dtype(dtype_str)
+    # sums + 3 markers in the accumulator dtype, min/max in the data dtype,
+    # then the optional Kahan/double-double compensation block
+    out_shape = (
+        [jax.ShapeDtypeStruct((size_p, k_pad), acc)] * 4
+        + [jax.ShapeDtypeStruct((size_p, k_pad), dt)] * 2
+        + ([] if accum == "plain" else [jax.ShapeDtypeStruct((size_p, k_pad), acc)])
+    )
+    fn = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, n_tile), lambda i, j: (0, j)),  # codes
+            pl.BlockSpec((k_tile, n_tile), lambda i, j: (i, j)),  # data (K, N)
+        ],
+        out_specs=[pl.BlockSpec((size_p, k_tile), lambda i, j: (0, i))] * len(out_shape),
+        out_shape=out_shape,
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def segment_multistat_pallas(
+    data, codes, size: int, *, interpret: bool = False, accum: str | None = None,
+):
+    """One-pass multi-statistic segment reduction: ``data`` (N, K...) by
+    ``codes`` (N,) -> ``(sums, nan_c, pos_c, neg_c, mins, maxs)``, each
+    ``(size, K...)``.
+
+    Sums are raw zero-filled compensated totals (apply
+    ``utils.reapply_nonfinite`` per skipna mode — one kernel pass serves
+    sum AND nansum); min/max are NaN-skipping with empty groups at the
+    op's identity (re-inject NaN from ``nan_c`` for the propagating
+    variants). Same tiling as ``segment_sum_pallas``, so the sums are
+    bit-identical to it; f32/bf16 only.
+    """
+    import jax.numpy as jnp
+
+    from .options import OPTIONS, VALID_ACCUMS
+
+    if accum is None:
+        accum = OPTIONS["pallas_accum"]
+    if accum not in VALID_ACCUMS:
+        raise ValueError(f"accum must be one of {VALID_ACCUMS}; got {accum!r}")
+
+    data = jnp.asarray(data)
+    orig_shape = data.shape
+    n = data.shape[0]
+    flat = data.reshape(n, -1)
+    k = flat.shape[1]
+    flat_t = flat.T  # (K, N) — cancels the caller's moveaxis; no copy
+
+    n_tile, k_tile, n_pad, k_pad, size_p = _tiles(n, k, size)
+
+    codes = jnp.asarray(codes).astype(jnp.int32).reshape(-1)
+    codes = jnp.where((codes < 0) | (codes >= size), size_p, codes)
+    codes_p = jnp.pad(codes, (0, n_pad - n), constant_values=size_p).reshape(1, n_pad)
+
+    from .kernels import _acc_dtype
+
+    fn = _build_multistat(
+        k_pad, n_pad, size, size_p, str(flat.dtype),
+        str(jnp.dtype(_acc_dtype(flat.dtype))), n_tile, k_tile, interpret,
+        str(accum),
+    )
+    sums, nan_c, pos_c, neg_c, mins, maxs, *_comp = fn(codes_p, flat_t)
+
+    def crop(x):
+        return x[:size, :k].reshape((size,) + orig_shape[1:])
+
+    return crop(sums), crop(nan_c), crop(pos_c), crop(neg_c), crop(mins), crop(maxs)
+
+
+def probe_compile_multistat() -> None:
+    """Compile-only probe for the multi-statistic megakernel (see
+    probe_compile)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .options import OPTIONS
+
+    fn = _build_multistat(
+        128, 128, 2, 8, "float32", "float32", 128, 128, False,
+        str(OPTIONS["pallas_accum"]),
+    )
+    fn.lower(
+        jax.ShapeDtypeStruct((1, 128), jnp.int32),
+        jax.ShapeDtypeStruct((128, 128), jnp.float32),
+    ).compile()
 
 
 def _scan_kernel(
@@ -681,6 +847,24 @@ def segment_sum_pallas(
     caller-side ``moveaxis(-1, 0)`` cancels and the kernel streams the
     original HBM buffer with no transposed copy.
     """
+    sums, nan_c, pos_c, neg_c = segment_sum_raw_pallas(
+        data, codes, size, interpret=interpret, accum=accum
+    )
+    from .utils import reapply_nonfinite
+
+    out = reapply_nonfinite(sums, nan_c, pos_c, neg_c, skipna=skipna)
+    if return_nan_counts:
+        return out, nan_c
+    return out
+
+
+def segment_sum_raw_pallas(
+    data, codes, size: int, *, interpret: bool = False, accum: str | None = None,
+):
+    """The kernel pass of :func:`segment_sum_pallas` without the IEEE
+    re-application: raw zero-filled compensated sums plus the NaN/±inf
+    marker counts, each ``(size, K...)`` — one pass can serve both the
+    sum and nansum legs of a fused multi-statistic plan."""
     import jax.numpy as jnp
 
     from .options import OPTIONS, VALID_ACCUMS
@@ -716,10 +900,7 @@ def segment_sum_pallas(
     )
     sums, nan_c, pos_c, neg_c, *_comp = fn(codes_p, flat_t)
 
-    from .utils import reapply_nonfinite
+    def crop(x):
+        return x[:size, :k].reshape((size,) + orig_shape[1:])
 
-    out = reapply_nonfinite(sums, nan_c, pos_c, neg_c, skipna=skipna)
-    out = out[:size, :k].reshape((size,) + orig_shape[1:])
-    if return_nan_counts:
-        return out, nan_c[:size, :k].reshape((size,) + orig_shape[1:])
-    return out
+    return crop(sums), crop(nan_c), crop(pos_c), crop(neg_c)

@@ -56,7 +56,13 @@ def _local_chunk(agg: Aggregation, codes_sh, arr_sh, size: int, nat: bool):
     signature ``f(group_idx, array, *, axis, size, fill_value, dtype, **kw)``
     (the reference's custom-Aggregation contract, aggregations.py:161-301).
     """
+    from ..aggregations import FusedAggregation, fused_chunk_stats
     from ..kernels import generic_kernel
+
+    if isinstance(agg, FusedAggregation):
+        # the multi-statistic plan has its own executor: deduplicated legs,
+        # megakernel-eligible subsets collapsed into one Pallas pass
+        return fused_chunk_stats(agg, codes_sh, arr_sh, size=size, engine="jax")
 
     inters = []
     fills = agg.fill_value.get("intermediate", ())
@@ -236,6 +242,13 @@ def _combine_intermediates(agg: Aggregation, inters, axis_name, nat: bool):
 def _finalize_combined(agg: Aggregation, combined, counts):
     """Pick/fold the combined intermediates into the result and apply the
     final fill — shared by every mesh program and the streaming runtime."""
+    from ..aggregations import FusedAggregation
+
+    if isinstance(agg, FusedAggregation):
+        # multi-output: one tuple entry per requested statistic, each with
+        # its own presence/fill semantics (the generic counts channel is
+        # advisory here — every slot reads its own presence leg)
+        return agg.finalize_fused(combined, counts)
     if agg.reduction_type == "argreduce":
         result = combined[1]
     elif agg.finalize is not None:
@@ -578,6 +591,18 @@ def _agg_cache_key(agg: Aggregation):
             return (getattr(v, "__qualname__", repr(v)), id(v))
         return repr(v) if isinstance(v, np.generic) else v
 
+    from ..aggregations import FusedAggregation
+
+    # a fused plan's per-statistic identity (final fill/dtype/kwargs per
+    # slot) lives in its member aggs, not the shared legs — two plans with
+    # identical legs but different per-stat fills must not share a program
+    fused_extra = ()
+    if isinstance(agg, FusedAggregation):
+        fused_extra = tuple(
+            (a.name, h(a.final_fill_value), str(a.final_dtype), h(a.finalize_kwargs))
+            for a in agg.aggs
+        )
+
     return (
         agg.name,
         h(agg.chunk),
@@ -589,41 +614,29 @@ def _agg_cache_key(agg: Aggregation):
         h(agg.finalize_kwargs),
         agg.min_count,
         agg.reduction_type,
+        fused_extra,
     )
 
 
 def _apply_final_fill(result, counts, agg: Aggregation):
     """Mask groups below the contribution threshold with the final fill.
 
-    Shared by every mesh program (map-reduce/cohorts finalize AND blockwise)
-    so the promotion rules cannot drift apart.
+    Shared by every mesh program (map-reduce/cohorts finalize AND
+    blockwise), with the promotion+where core in ONE place —
+    ``aggregations._masked_fill``, which the fused multi-statistic
+    finalize also uses — so the promotion rules cannot drift apart.
+    Counts are (..., size) with the group axis LAST, exactly like the
+    trailing dims of the result, so standard right-aligned broadcasting
+    (inside ``_masked_fill``) covers both extra leading dims (quantile's
+    q) and matching shapes.
     """
-    import jax.numpy as jnp
+    from ..aggregations import _masked_fill
 
     final_fill = agg.final_fill_value
     if isinstance(final_fill, str):
         raise TypeError("string fill values are not supported on device")
     threshold = max(agg.min_count, 1)
-    empty = counts < threshold
-    # counts are (..., size) with the group axis LAST, exactly like the
-    # trailing dims of the result — standard right-aligned broadcasting
-    # covers both extra leading dims (quantile's q) and matching shapes.
-    # (Padding with trailing 1s here would mis-align the group axis.)
-    empty_b = jnp.broadcast_to(empty, result.shape)
-    # host-side NaN check: under shard_map tracing even constants are tracers
-    try:
-        fill_is_nan = bool(np.isnan(final_fill))
-    except (TypeError, ValueError):
-        fill_is_nan = False
-    fv = jnp.asarray(final_fill)
-    if jnp.issubdtype(fv.dtype, jnp.floating) and not jnp.issubdtype(
-        result.dtype, jnp.floating
-    ):
-        if not fill_is_nan:
-            fv = fv.astype(result.dtype)  # identity fills stay integral
-        else:
-            result = result.astype(jnp.float64 if utils.x64_enabled() else jnp.float32)
-    return jnp.where(empty_b, fv.astype(result.dtype), result)
+    return _masked_fill(result, counts < threshold, final_fill)
 
 
 def _build_program(

@@ -98,11 +98,17 @@ async def _serve_request(dispatcher: Dispatcher, line_no: int, msg: dict) -> Non
             {"id": rid, "ok": False, "error": type(exc).__name__, "message": str(exc)}
         )
     else:
+        # multi-statistic requests (func = a list of names) answer with a
+        # {func: values} object; single statistics stay a flat list
+        if isinstance(result.result, dict):
+            payload = {k: np.asarray(v).tolist() for k, v in result.result.items()}
+        else:
+            payload = np.asarray(result.result).tolist()
         _emit(
             {
                 "id": rid,
                 "ok": True,
-                "result": np.asarray(result.result).tolist(),
+                "result": payload,
                 "groups": np.asarray(result.groups).tolist(),
                 "coalesced": result.coalesced,
                 "batch": result.batch_size,

@@ -169,12 +169,15 @@ def record_reduce(
     the manifest immediately (merge-on-save), so a replica killed mid-run
     still leaves every program it served warmable.
     """
-    if _aot_dir() is None or not isinstance(func, str):
+    multi = isinstance(func, (tuple, list)) and all(
+        isinstance(f, str) for f in func
+    )
+    if _aot_dir() is None or not (isinstance(func, str) or multi):
         return False
     try:
         spec = _jsonable(
             {
-                "func": func,
+                "func": list(func) if multi else func,
                 "shape": list(shape),
                 "dtype": str(dtype),
                 "by_shape": list(by_shape),
@@ -304,7 +307,15 @@ def warmup(path: Any = None) -> int:
                 arr, labels = _synthesize(spec)
                 kwargs = dict(spec.get("agg_kwargs") or {})
                 with options.scoped(**(spec.get("options") or {})):
-                    groupby_reduce(arr, labels, func=spec["func"], **kwargs)
+                    if isinstance(spec["func"], list):
+                        # multi-statistic spec: warm the fused program
+                        from ..fusion import groupby_aggregate_many
+
+                        groupby_aggregate_many(
+                            arr, labels, funcs=tuple(spec["func"]), **kwargs
+                        )
+                    else:
+                        groupby_reduce(arr, labels, func=spec["func"], **kwargs)
                 warmed += 1
             # noqa: FLX006 — not a retry loop: specs are independent, and a
             # bad one must be skipped (warmup can never take serving down)

@@ -120,9 +120,10 @@ class AggregationRequest:
 class ServeResult:
     """A served aggregation: the result/groups arrays plus per-request SLO
     attribution. ``result``/``groups`` may be shared with coalesced peers —
-    treat them as read-only."""
+    treat them as read-only. Multi-statistic requests (``func`` a tuple of
+    names) return ``result`` as a dict mapping func name -> array."""
 
-    result: np.ndarray
+    result: Any
     groups: np.ndarray
     request_id: str | None = None
     #: whether this request attached to another request's execution
@@ -195,6 +196,24 @@ _UNBATCHABLE = frozenset(
 )
 
 
+def _is_multi(func: Any) -> bool:
+    """A multi-statistic request: ``func`` is a tuple/list of names — one
+    ``groupby_aggregate_many`` dispatch serves the whole set."""
+    return isinstance(func, (tuple, list)) and all(
+        isinstance(f, str) for f in func
+    )
+
+
+def _func_label(func: Any) -> str:
+    if isinstance(func, str):
+        return func
+    if _is_multi(func):
+        from ..fusion import fused_program_label
+
+        return fused_program_label(func)
+    return "custom"
+
+
 def _digest_bytes(*parts: bytes) -> str:
     h = hashlib.blake2b(digest_size=16)
     for p in parts:
@@ -253,7 +272,9 @@ def _program_key(
 
     return (
         "reduce",
-        func if isinstance(func, str) else ("__agg__", id(func)),
+        func if isinstance(func, str)
+        else tuple(func) if _is_multi(func)
+        else ("__agg__", id(func)),
         arr.shape,
         str(arr.dtype),
         by_digest,
@@ -338,6 +359,10 @@ class Dispatcher:
     async def _submit_admitted(
         self, request: AggregationRequest, t0: float
     ) -> ServeResult:
+        if isinstance(request.func, list):
+            # JSON clients send statistic sets as lists; the program key
+            # and the fused planner both want the hashable tuple form
+            request.func = tuple(request.func)
         arr = np.asarray(request.array)
         by = np.asarray(request.by)
         # fold the submitter's AMBIENT scoped() overlay under the request's
@@ -435,7 +460,7 @@ class Dispatcher:
         telemetry.record_span(
             "serve.request", t0, t1,
             attrs={
-                "func": request.func if isinstance(request.func, str) else "custom",
+                "func": _func_label(request.func),
                 "coalesced": coalesced, "batch": leaf.batch_size,
             },
         )
@@ -452,7 +477,13 @@ class Dispatcher:
     # -- batching -----------------------------------------------------------
 
     def _batchable(self, request: AggregationRequest, arr: np.ndarray) -> bool:
-        if not isinstance(request.func, str) or request.func in _UNBATCHABLE:
+        if _is_multi(request.func):
+            # fused statistic sets contain only batchable reductions
+            # (FUSABLE_FUNCS excludes the axis-growing order statistics),
+            # and groupby_aggregate_many handles lead axes — multi-stat
+            # requests micro-batch exactly like single-stat ones
+            pass
+        elif not isinstance(request.func, str) or request.func in _UNBATCHABLE:
             return False
         if request.finalize_kwargs:
             return False
@@ -553,13 +584,33 @@ class Dispatcher:
         from ..core import groupby_reduce
 
         kwargs = {k: v for k, v in batch.agg_kwargs.items() if v is not None}
+        multi = _is_multi(batch.func)
         with options.scoped(**batch.overrides):
             with telemetry.span(
-                "serve.execute",
-                func=batch.func if isinstance(batch.func, str) else "custom",
-                batch=len(live),
+                "serve.execute", func=_func_label(batch.func), batch=len(live),
             ):
-                if len(live) == 1:
+                if multi:
+                    # one fused dispatch serves every statistic of every
+                    # leaf: the payload is staged once for the whole set
+                    from ..fusion import groupby_aggregate_many
+
+                    if len(live) == 1:
+                        result, groups = groupby_aggregate_many(
+                            live[0].array, batch.by, funcs=batch.func, **kwargs
+                        )
+                        rows = [{k: np.asarray(v) for k, v in result.items()}]
+                        dispatched = live[0].array
+                    else:
+                        dispatched = np.stack([leaf.array for leaf in live])
+                        result, groups = groupby_aggregate_many(
+                            dispatched, batch.by, funcs=batch.func, **kwargs
+                        )
+                        stats = {k: np.asarray(v) for k, v in result.items()}
+                        rows = [
+                            {k: v[i] for k, v in stats.items()}
+                            for i in range(len(live))
+                        ]
+                elif len(live) == 1:
                     result, groups = groupby_reduce(
                         live[0].array, batch.by, func=batch.func, **kwargs
                     )
@@ -580,11 +631,7 @@ class Dispatcher:
             # keeps the label bounded while separating shape/dtype/option
             # variants. Gated: the repr+hash must cost nothing when off.
             pdigest = _digest_bytes(repr(batch.pkey).encode())[:8]
-            prog = (
-                "serve["
-                + (batch.func if isinstance(batch.func, str) else "custom")
-                + f"#{pdigest}]"
-            )
+            prog = "serve[" + _func_label(batch.func) + f"#{pdigest}]"
             telemetry.sample_hbm(program=prog)
             # the program's cost-ledger row: one dispatch (however many
             # coalesced/batched waiters it served), its device wall, the

@@ -42,7 +42,11 @@ from . import cache, factorize as fct, utils
 from .aggregations import Aggregation, _initialize_aggregation
 from .multiarray import MultiArray
 
-__all__ = ["streaming_groupby_reduce", "streaming_groupby_scan"]
+__all__ = [
+    "streaming_groupby_reduce",
+    "streaming_groupby_scan",
+    "streaming_groupby_aggregate_many",
+]
 
 _BIG = np.iinfo(np.int32).max
 
@@ -159,6 +163,14 @@ def streaming_groupby_reduce(
     """
     from . import telemetry
 
+    if isinstance(func, (tuple, list)):
+        # the fused multi-statistic routing lives in the impl, but the
+        # single-statistic API boundary must fail loudly, not silently
+        # change its (array, groups) return contract to (dict, groups)
+        raise TypeError(
+            "streaming_groupby_reduce takes one func; pass statistic sets "
+            "to streaming_groupby_aggregate_many"
+        )
     with telemetry.span(
         "streaming_groupby_reduce",
         func=func if isinstance(func, str) else getattr(func, "name", "custom"),
@@ -262,11 +274,37 @@ def _streaming_groupby_reduce_impl(
             "datetime/timedelta streaming needs jax_enable_x64 (int64 NaT "
             "sentinels do not survive the int32 downcast)."
         )
-    agg = _initialize_aggregation(
-        func, dtype,
-        probe.dtype if datetime_dtype is None else np.dtype("int64"),
-        fill_value, 0 if min_count is None else min_count, finalize_kwargs,
-    )
+    fused_funcs = tuple(func) if isinstance(func, (tuple, list)) else None
+    if fused_funcs is not None:
+        # multi-statistic fusion: ONE streaming pass (one carry, one step
+        # program, one checkpoint identity) serves the whole statistic set
+        if datetime_dtype is not None:
+            raise NotImplementedError(
+                "fused multi-statistic streaming supports numeric data; "
+                "stream datetime reductions one func at a time"
+            )
+        from .aggregations import plan_fused
+
+        agg = plan_fused(
+            fused_funcs, dtype, probe.dtype, fill_value,
+            0 if min_count is None else min_count, finalize_kwargs,
+        )
+    else:
+        agg = _initialize_aggregation(
+            func, dtype,
+            probe.dtype if datetime_dtype is None else np.dtype("int64"),
+            fill_value, 0 if min_count is None else min_count, finalize_kwargs,
+        )
+        if agg.appended_count:
+            # the streaming runtime computes counts itself (count_skipna
+            # channel + _apply_final_fill threshold); the appended nanlen
+            # would otherwise leak into agg.finalize as a stray positional
+            # arg — var's ddof became a count array, poisoning every group
+            # (the same strip sharded_groupby_reduce applies)
+            agg.chunk = agg.chunk[:-1]
+            agg.combine = agg.combine[:-1]
+            agg.fill_value["intermediate"] = agg.fill_value["intermediate"][:-1]
+            agg.appended_count = False
     if datetime_dtype is not None:
         # same dtype round-trips as core.groupby_reduce (core.py:495-541),
         # applied PER SLAB so the conversion streams with the data
@@ -531,14 +569,19 @@ def _streaming_groupby_reduce_impl(
             done += 1
             ckpt.tick(lambda: state, slabs_done=done)
 
+    out_shape = tuple(lead_shape) + tuple(keep_by_shape) + grp_shape
     if mesh is not None:
         with telemetry.span("finalize", mesh=True):
             result = final(state)
             ckpt.done()
             from .core import _astype_final, _index_values
 
+            if fused_funcs is not None:
+                from .fusion import finalize_many
+
+                out = finalize_many(agg, result, out_shape)
+                return (out,) + tuple(_index_values(g) for g in found_groups)
             result = _astype_final(result, agg, datetime_dtype)
-            out_shape = tuple(lead_shape) + tuple(keep_by_shape) + grp_shape
             if result.shape != out_shape:
                 result = result.reshape(out_shape)
         return (result,) + tuple(_index_values(g) for g in found_groups)
@@ -551,13 +594,65 @@ def _streaming_groupby_reduce_impl(
         ckpt.done()
         from .core import _astype_final, _index_values
 
+        if fused_funcs is not None:
+            # one streaming pass -> the whole statistic set
+            from .fusion import finalize_many
+
+            out = finalize_many(agg, result, out_shape)
+            return (out,) + tuple(_index_values(g) for g in found_groups)
         result = _astype_final(result, agg, datetime_dtype)
         # (..., size) -> (..., *keep_by, *groups): kept by-dims ride the group
         # axis as disjoint code ranges (factorize_ offsetting) and unfold here
-        out_shape = tuple(lead_shape) + tuple(keep_by_shape) + grp_shape
         if result.shape != out_shape:
             result = result.reshape(out_shape)
     return (result,) + tuple(_index_values(g) for g in found_groups)
+
+
+def streaming_groupby_aggregate_many(
+    array: Any,
+    by: Any,
+    *,
+    funcs: "tuple | list" = ("sum", "count", "min", "max", "var"),
+    batch_len: int | None = None,
+    batch_bytes: int | None = None,
+    expected_groups: Any = None,
+    isbin: Any = False,
+    sort: bool = True,
+    axis: Any = None,
+    fill_value: Any = None,
+    dtype: Any = None,
+    min_count: int | None = None,
+    finalize_kwargs: dict | None = None,
+    mesh: Any = None,
+    axis_name: str | tuple[str, ...] = "data",
+) -> tuple:
+    """N grouped statistics in ONE streaming pass over the loader.
+
+    The multi-statistic form of :func:`streaming_groupby_reduce`: the
+    fusion planner (``aggregations.plan_fused``) merges the requested
+    statistic blueprints into one multi-output chunk plan, so every slab
+    is staged ONCE and folds into one fused carry — an ERA5-style
+    mean+std+extremes job is one pass over the data instead of four.
+    Checkpoint/resume (the fused carry snapshots under one stream
+    identity) and OOM slab-splitting work exactly as for a single
+    statistic; ``mesh=`` composes with the sharded runtime (one collective
+    combine for the whole set). Returns ``(results, groups)`` with
+    ``results`` a dict mapping func name -> array, each bit-identical to
+    the corresponding single-statistic streaming call.
+    """
+    from . import telemetry
+
+    with telemetry.span(
+        "streaming_groupby_aggregate_many", funcs=list(funcs),
+        mesh=mesh is not None,
+    ):
+        return _streaming_groupby_reduce_impl(
+            array, by, func=tuple(funcs), batch_len=batch_len,
+            batch_bytes=batch_bytes, expected_groups=expected_groups,
+            isbin=isbin, sort=sort, axis=axis, fill_value=fill_value,
+            dtype=dtype, min_count=min_count, finalize_kwargs=finalize_kwargs,
+            mesh=mesh, axis_name=axis_name,
+        )
 
 
 def _slab_stats(agg: Aggregation, slab, ccodes, offset, *, size: int,

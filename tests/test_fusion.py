@@ -1,0 +1,637 @@
+"""Multi-statistic fusion suite (ISSUE 10).
+
+Acceptance: ``groupby_aggregate_many`` is bit-identical to N sequential
+``groupby_reduce`` calls on every runtime (eager jax/numpy, mesh,
+streaming single-device and mesh, prefetch on/off), compiles exactly ONE
+program for N statistics, bills staged bytes exactly once in the cost
+ledger, and its streaming form survives kill-at-slab-k resume and OOM
+slab-splitting on the fused carry.
+"""
+
+import numpy as np
+import pytest
+
+import flox_tpu
+from flox_tpu import (
+    cache,
+    faults,
+    groupby_aggregate_many,
+    groupby_reduce,
+    streaming_groupby_aggregate_many,
+    streaming_groupby_reduce,
+    telemetry,
+)
+from flox_tpu.aggregations import FUSABLE_FUNCS, plan_fused
+
+CLIMATOLOGY = ("mean", "var", "min", "max")
+FUNC_SETS = [
+    CLIMATOLOGY,
+    ("sum", "count", "min", "max", "var"),
+    ("nanmean", "nanstd", "nanmin", "nanmax", "count"),
+    ("nansum", "nanvar", "mean"),
+    ("std", "prod", "any", "all"),
+    ("mean", "nanmean", "var", "nanvar"),  # mixed skipna: no cross-aliasing
+]
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    vals = rng.normal(size=(2, 2400))
+    vals[0, 5] = np.nan
+    vals[1, 100:200] = np.nan
+    vals[1, ::37] = np.nan
+    labels = rng.integers(0, 7, 2400)
+    return vals, labels
+
+
+def _assert_same(got, want, label):
+    got, want = np.asarray(got), np.asarray(want)
+    assert got.dtype == want.dtype, f"{label}: dtype {got.dtype} != {want.dtype}"
+    np.testing.assert_array_equal(got, want, err_msg=label)
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+
+class TestPlanner:
+    def test_var_triple_feeds_mean(self):
+        fused = plan_fused(("mean", "var", "std"), None, np.dtype("f8"), None, 0, None)
+        # ONE var_chunk leg serves all three statistics: mean reads the
+        # triple's (total, count) leaves — no sum/count legs at all
+        assert fused.chunk == (("var_chunk", {"skipna": False}),)
+        assert fused.slots[0]["sum"] == (0, 1)
+        assert fused.slots[0]["count"] == (0, 2)
+
+    def test_dedup_shared_legs(self):
+        fused = plan_fused(("sum", "mean", "count"), None, np.dtype("f8"), None, 0, None)
+        names = [c[0] if isinstance(c, tuple) else c for c in fused.chunk]
+        # sum shared by the sum stat and mean; one nanlen; one len presence
+        assert names.count("sum") == 1
+        assert names.count("nanlen") == 1
+
+    def test_rejects_unfusable(self):
+        with pytest.raises(NotImplementedError, match="cannot fuse"):
+            plan_fused(("mean", "argmax"), None, np.dtype("f8"), None, 0, None)
+        with pytest.raises(NotImplementedError, match="cannot fuse"):
+            plan_fused(("quantile",), None, np.dtype("f8"), None, 0, None)
+
+    def test_rejects_duplicates_and_empty(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            plan_fused(("mean", "mean"), None, np.dtype("f8"), None, 0, None)
+        with pytest.raises(ValueError, match="at least one"):
+            plan_fused((), None, np.dtype("f8"), None, 0, None)
+
+    def test_fusable_set_excludes_order_stats(self):
+        assert "quantile" not in FUSABLE_FUNCS
+        assert "argmin" not in FUSABLE_FUNCS
+        assert {"mean", "var", "min", "max", "count"} <= FUSABLE_FUNCS
+
+
+# ---------------------------------------------------------------------------
+# eager bit-identity
+# ---------------------------------------------------------------------------
+
+
+class TestEagerBitIdentity:
+    @pytest.mark.parametrize(
+        "funcs,engine",
+        # every set on the jax engine; the numpy engine shares the planner
+        # and finalize, so three sets cover its engine-specific kernels
+        [(f, "jax") for f in FUNC_SETS] + [(f, "numpy") for f in FUNC_SETS[:3]],
+        ids=lambda v: "+".join(v) if isinstance(v, tuple) else str(v),
+    )
+    def test_matches_sequential(self, data, funcs, engine):
+        vals, labels = data
+        out, groups = groupby_aggregate_many(vals, labels, funcs=funcs, engine=engine)
+        assert tuple(out) == funcs  # request order preserved
+        for f in funcs:
+            seq, seq_groups = groupby_reduce(vals, labels, func=f, engine=engine)
+            _assert_same(out[f], seq, f"{f} ({engine})")
+            np.testing.assert_array_equal(groups, seq_groups)
+
+    def test_float32(self, data):
+        vals, labels = data
+        v32 = vals.astype(np.float32)
+        out, _ = groupby_aggregate_many(v32, labels, funcs=CLIMATOLOGY)
+        for f in CLIMATOLOGY:
+            _assert_same(out[f], groupby_reduce(v32, labels, func=f)[0], f"{f} f32")
+
+    def test_int_input(self, data):
+        _, labels = data
+        ints = np.arange(labels.size, dtype=np.int64) % 101
+        funcs = ("sum", "count", "min", "max", "mean", "var")
+        out, _ = groupby_aggregate_many(ints, labels, funcs=funcs)
+        for f in funcs:
+            _assert_same(out[f], groupby_reduce(ints, labels, func=f)[0], f"{f} int")
+
+    def test_all_nan_group_per_statistic(self):
+        # skipna presence semantics diverge per statistic: nansum of an
+        # all-NaN group is 0, nanmean/nanmin are the fill (NaN)
+        vals = np.array([1.0, np.nan, np.nan, 4.0])
+        labels = np.array([0, 1, 1, 0])
+        funcs = ("nansum", "nanmean", "nanmin", "count")
+        out, _ = groupby_aggregate_many(vals, labels, funcs=funcs)
+        for f in funcs:
+            _assert_same(out[f], groupby_reduce(vals, labels, func=f)[0], f)
+        assert np.asarray(out["nansum"])[1] == 0.0
+        assert np.isnan(np.asarray(out["nanmean"])[1])
+
+    def test_empty_group_fill(self, data):
+        vals, labels = data
+        expected = np.arange(9)  # groups 7, 8 never occur
+        out, _ = groupby_aggregate_many(
+            vals, labels, funcs=CLIMATOLOGY, expected_groups=expected
+        )
+        for f in CLIMATOLOGY:
+            seq = groupby_reduce(vals, labels, func=f, expected_groups=expected)[0]
+            _assert_same(out[f], seq, f"{f} empty-group")
+
+    def test_per_func_fill_value_and_kwargs(self, data):
+        vals, labels = data
+        out, _ = groupby_aggregate_many(
+            vals, labels, funcs=("nanmin", "nanvar"),
+            expected_groups=np.arange(9),
+            fill_value={"nanmin": -1.0},
+            finalize_kwargs={"nanvar": {"ddof": 1}},
+        )
+        _assert_same(
+            out["nanmin"],
+            groupby_reduce(vals, labels, func="nanmin", fill_value=-1.0,
+                           expected_groups=np.arange(9))[0],
+            "nanmin fill",
+        )
+        _assert_same(
+            out["nanvar"],
+            groupby_reduce(vals, labels, func="nanvar",
+                           finalize_kwargs={"ddof": 1},
+                           expected_groups=np.arange(9))[0],
+            "nanvar ddof",
+        )
+
+    def test_min_count(self, data):
+        vals, labels = data
+        out, _ = groupby_aggregate_many(
+            vals, labels, funcs=("nansum", "nanmean"), min_count=200
+        )
+        for f in ("nansum", "nanmean"):
+            seq = groupby_reduce(vals, labels, func=f, min_count=200)[0]
+            _assert_same(out[f], seq, f"{f} min_count")
+
+    @pytest.mark.parametrize("engine", ["jax", "numpy"])
+    def test_min_count_var_family(self, engine):
+        # regression: _initialize_aggregation's appended nanlen used to
+        # mask var's ("var",) combine signature, misclassifying the Chan
+        # triple in the planner (review finding)
+        vals = np.array([[1.0, 2.0, np.nan, 3.0, 7.0, 2.0]])
+        labels = np.array([0, 0, 1, 1, 2, 2])
+        funcs = ("var", "mean", "std", "count")
+        out, _ = groupby_aggregate_many(
+            vals, labels, funcs=funcs, min_count=2, engine=engine
+        )
+        for f in funcs:
+            seq = groupby_reduce(vals, labels, func=f, min_count=2, engine=engine)[0]
+            _assert_same(out[f], seq, f"{f} min_count var-family ({engine})")
+
+    def test_nd_by_and_axis(self):
+        rng = np.random.default_rng(3)
+        vals = rng.normal(size=(2, 4, 50))
+        labels = rng.integers(0, 3, (4, 50))
+        out, _ = groupby_aggregate_many(vals, labels, funcs=("mean", "max"))
+        for f in ("mean", "max"):
+            _assert_same(out[f], groupby_reduce(vals, labels, func=f)[0], f)
+
+    def test_bool_input(self, data):
+        _, labels = data
+        b = (np.arange(labels.size) % 3).astype(bool)
+        funcs = ("sum", "count", "all", "any")
+        out, _ = groupby_aggregate_many(b, labels, funcs=funcs, engine="jax")
+        for f in funcs:
+            _assert_same(out[f], groupby_reduce(b, labels, func=f, engine="jax")[0],
+                         f"{f} bool")
+        with pytest.raises(NotImplementedError, match="bool data"):
+            groupby_aggregate_many(b, labels, funcs=("mean", "sum"))
+
+    def test_rejects_datetime_and_blockwise(self, data):
+        vals, labels = data
+        dt = np.arange(labels.size, dtype=np.int64).view("datetime64[ns]")
+        with pytest.raises(NotImplementedError, match="numeric"):
+            groupby_aggregate_many(dt, labels, funcs=("min", "max"))
+        with pytest.raises(NotImplementedError, match="method"):
+            groupby_aggregate_many(vals, labels, funcs=("min",), method="blockwise")
+
+
+# ---------------------------------------------------------------------------
+# one compiled program + cost ledger bytes staged once
+# ---------------------------------------------------------------------------
+
+
+class TestOneProgram:
+    def test_one_compile_for_n_statistics(self, data):
+        import jax
+
+        vals, labels = data
+        with flox_tpu.set_options(telemetry=True):
+            cache.clear_all()
+            jax.clear_caches()
+            c0 = telemetry.METRICS.get("jax.compiles")
+            groupby_aggregate_many(vals, labels, funcs=CLIMATOLOGY, engine="jax")
+            fused_compiles = telemetry.METRICS.get("jax.compiles") - c0
+            # same-shape re-dispatch reuses the program: zero new compiles
+            c1 = telemetry.METRICS.get("jax.compiles")
+            groupby_aggregate_many(vals, labels, funcs=CLIMATOLOGY, engine="jax")
+            assert telemetry.METRICS.get("jax.compiles") - c1 == 0
+
+            cache.clear_all()
+            jax.clear_caches()
+            c0 = telemetry.METRICS.get("jax.compiles")
+            for f in CLIMATOLOGY:
+                groupby_reduce(vals, labels, func=f, engine="jax")
+            seq_compiles = telemetry.METRICS.get("jax.compiles") - c0
+        assert fused_compiles == 1
+        assert seq_compiles == len(CLIMATOLOGY)
+
+    def test_ledger_bills_bytes_once(self, data):
+        vals, labels = data
+        with flox_tpu.set_options(telemetry=True):
+            cache.clear_all()
+            groupby_aggregate_many(vals, labels, funcs=CLIMATOLOGY, engine="jax")
+            row = telemetry.cost_by_program()["fused[mean+var+min+max]"]
+            expected = vals.nbytes + labels.size * np.asarray(labels).itemsize
+            assert row["dispatches"] == 1
+            # bytes staged ONCE for the whole statistic set — no
+            # per-statistic double counting at any observe_cost site
+            assert row["bytes"] == expected
+
+            # the sequential baseline pays ~N x the staged bytes
+            cache.clear_all()
+            for f in CLIMATOLOGY:
+                groupby_reduce(vals, labels, func=f, engine="jax")
+            seq_bytes = sum(
+                r["bytes"]
+                for k, r in telemetry.cost_by_program().items()
+                if k.startswith("bundle[")
+            )
+            assert seq_bytes == len(CLIMATOLOGY) * expected
+
+    def test_fused_program_cache_registered(self):
+        # FLX008 discipline: the fused-program LRU is reachable from
+        # cache.clear_all and visible in cache.stats
+        from flox_tpu.fusion import _FUSED_PROGRAM_CACHE
+
+        rng = np.random.default_rng(0)
+        groupby_aggregate_many(
+            rng.normal(size=64), rng.integers(0, 4, 64), funcs=("mean", "max"),
+            engine="jax",
+        )
+        assert len(_FUSED_PROGRAM_CACHE) >= 1
+        assert cache.stats()["fused_programs"] == len(_FUSED_PROGRAM_CACHE)
+        cache.clear_all()
+        assert len(_FUSED_PROGRAM_CACHE) == 0
+
+
+# ---------------------------------------------------------------------------
+# mesh
+# ---------------------------------------------------------------------------
+
+
+class TestMesh:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        from flox_tpu.parallel.mesh import make_mesh
+
+        return make_mesh()
+
+    @pytest.mark.parametrize("funcs", FUNC_SETS[:3], ids=["+".join(f) for f in FUNC_SETS[:3]])
+    def test_matches_sequential(self, data, mesh, funcs):
+        vals, labels = data
+        out, _ = groupby_aggregate_many(
+            vals, labels, funcs=funcs, method="map-reduce", mesh=mesh
+        )
+        for f in funcs:
+            seq = groupby_reduce(vals, labels, func=f, method="map-reduce", mesh=mesh)[0]
+            _assert_same(out[f], seq, f"{f} mesh")
+
+    def test_one_program_one_cache_key(self, data, mesh):
+        from flox_tpu.parallel.mapreduce import _PROGRAM_CACHE
+
+        vals, labels = data
+        cache.clear_all()
+        groupby_aggregate_many(
+            vals, labels, funcs=CLIMATOLOGY, method="map-reduce", mesh=mesh
+        )
+        # the whole statistic set lowered as ONE program under ONE key
+        assert len(_PROGRAM_CACHE) == 1
+        misses0 = telemetry.METRICS.get("cache.program_misses")
+        groupby_aggregate_many(
+            vals, labels, funcs=CLIMATOLOGY, method="map-reduce", mesh=mesh
+        )
+        assert len(_PROGRAM_CACHE) == 1
+        assert telemetry.METRICS.get("cache.program_misses") == misses0
+
+    def test_distinct_fills_get_distinct_programs(self, data, mesh):
+        # per-statistic identity rides the program key: same legs,
+        # different final fill -> different compiled program
+        from flox_tpu.parallel.mapreduce import _agg_cache_key
+
+        k1 = _agg_cache_key(
+            plan_fused(("min", "max"), None, np.dtype("f8"), None, 0, None)
+        )
+        k2 = _agg_cache_key(
+            plan_fused(("min", "max"), None, np.dtype("f8"), {"min": -1.0}, 0, None)
+        )
+        assert k1 != k2
+
+
+# ---------------------------------------------------------------------------
+# streaming: one pass, fused carry, resilience
+# ---------------------------------------------------------------------------
+
+
+class TestStreaming:
+    @pytest.mark.parametrize(
+        "funcs,depth",
+        [(FUNC_SETS[0], 0), (FUNC_SETS[0], 2), (FUNC_SETS[1], 0), (FUNC_SETS[2], 2)],
+        ids=lambda v: "+".join(v) if isinstance(v, tuple) and v and isinstance(v[0], str) else str(v),
+    )
+    def test_matches_sequential(self, data, funcs, depth):
+        vals, labels = data
+        with flox_tpu.set_options(stream_prefetch=depth):
+            out, _ = streaming_groupby_aggregate_many(
+                vals, labels, funcs=funcs, batch_len=600
+            )
+            for f in funcs:
+                seq = streaming_groupby_reduce(vals, labels, func=f, batch_len=600)[0]
+                _assert_same(out[f], seq, f"{f} stream depth={depth}")
+
+    def test_close_to_eager(self, data):
+        # slab-by-slab folds reorder float accumulation vs the eager
+        # one-pass program — allclose, not bit-equal (the same contract
+        # the sequential streaming runtime has with the eager path)
+        vals, labels = data
+        out, _ = streaming_groupby_aggregate_many(
+            vals, labels, funcs=CLIMATOLOGY, batch_len=700
+        )
+        eager, _ = groupby_aggregate_many(vals, labels, funcs=CLIMATOLOGY)
+        for f in CLIMATOLOGY:
+            np.testing.assert_allclose(
+                np.asarray(out[f]), np.asarray(eager[f]), rtol=1e-12,
+                equal_nan=True, err_msg=f,
+            )
+
+    def test_loader_single_pass(self, data):
+        # the whole statistic set streams the loader ONCE (the sequential
+        # baseline would read it len(funcs) times)
+        vals, labels = data
+        reads = []
+
+        def loader(s, e):
+            reads.append((s, e))
+            return vals[:, s:e]
+
+        streaming_groupby_aggregate_many(loader, labels, funcs=CLIMATOLOGY, batch_len=800)
+        spans = [(s, e) for s, e in reads if e - s > 1]  # drop the dtype probe
+        total = sum(e - s for s, e in spans)
+        assert total == labels.size  # every byte staged exactly once
+
+    def test_mesh_matches_sequential(self, data):
+        from flox_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        vals, labels = data
+        out, _ = streaming_groupby_aggregate_many(
+            vals, labels, funcs=CLIMATOLOGY, batch_len=800, mesh=mesh
+        )
+        for f in CLIMATOLOGY:
+            seq = streaming_groupby_reduce(
+                vals, labels, func=f, batch_len=800, mesh=mesh
+            )[0]
+            _assert_same(out[f], seq, f"{f} stream-mesh")
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_kill_resume_bit_identical(self, data, depth, tmp_path):
+        from flox_tpu.resilience import _SNAPSHOTS
+
+        vals, labels = data
+        funcs = ("mean", "var", "min", "max", "count")
+
+        def run():
+            out, _ = streaming_groupby_aggregate_many(
+                vals, labels, funcs=funcs, batch_len=500
+            )
+            return {f: np.asarray(v).tobytes() for f, v in out.items()}
+
+        with flox_tpu.set_options(stream_prefetch=depth):
+            base = run()
+            with flox_tpu.set_options(stream_checkpoint_every=2):
+                with faults.inject(kill_at=[1000]):
+                    with pytest.raises(faults.StreamKilled):
+                        run()
+                assert len(_SNAPSHOTS) == 1  # the fused carry snapshotted
+                resumed = run()
+        assert resumed == base
+        assert _SNAPSHOTS == {}
+
+    def test_oom_split_on_fused_carry(self, data):
+        # the OOM ladder re-folds sub-slabs through the fused carry: the
+        # split run is bit-identical to each SEQUENTIAL statistic under
+        # the same injection (the established split contract), and
+        # allclose to the unsplit fused run
+        from flox_tpu import profiling
+
+        vals, labels = data
+        funcs = ("mean", "var", "min", "max")
+        base, _ = streaming_groupby_aggregate_many(
+            vals, labels, funcs=funcs, batch_len=500
+        )
+        with faults.inject(oom_at=[1500]):
+            with profiling.stream_monitor() as reports:
+                out, _ = streaming_groupby_aggregate_many(
+                    vals, labels, funcs=funcs, batch_len=500
+                )
+        assert reports[0].oom_splits == 1
+        for f in funcs:
+            with faults.inject(oom_at=[1500]):
+                seq = streaming_groupby_reduce(vals, labels, func=f, batch_len=500)[0]
+            _assert_same(out[f], seq, f"{f} oom-split")
+            np.testing.assert_allclose(
+                np.asarray(out[f]), np.asarray(base[f]), rtol=1e-12, equal_nan=True
+            )
+
+    def test_rejects_datetime(self, data):
+        _, labels = data
+        dt = np.arange(labels.size, dtype=np.int64).view("datetime64[ns]")
+        with pytest.raises(NotImplementedError, match="numeric"):
+            streaming_groupby_aggregate_many(dt, labels, funcs=("min", "max"))
+
+    def test_single_stat_api_rejects_func_lists(self, data):
+        # the single-statistic boundary must fail loudly rather than
+        # silently switch its return contract to (dict, groups)
+        vals, labels = data
+        with pytest.raises(TypeError, match="aggregate_many"):
+            streaming_groupby_reduce(vals, labels, func=["sum"])
+
+
+# ---------------------------------------------------------------------------
+# kernels: the absorbed fused primitive + megakernel
+# ---------------------------------------------------------------------------
+
+
+class TestFusedKernelPrimitive:
+    def test_megakernel_matches_per_leg(self):
+        # force the pallas policy (interpret mode on CPU) and check the
+        # one-pass multi-output primitive against the per-leg kernels
+        import jax.numpy as jnp
+
+        from flox_tpu.kernels import fused_segment_stats, generic_kernel
+
+        rng = np.random.default_rng(5)
+        vals = rng.normal(size=(2, 320)).astype(np.float32)
+        vals[0, 3] = np.nan
+        vals[1, 7] = np.inf
+        labels = rng.integers(0, 4, 320).astype(np.int32)
+        with flox_tpu.set_options(segment_sum_impl="pallas"):
+            got = fused_segment_stats(
+                labels, jnp.asarray(vals), size=4,
+                want=("sum", "nansum", "min", "max", "nanmin", "len", "nanlen"),
+            )
+        assert got is not None
+        with flox_tpu.set_options(segment_sum_impl="pallas"):
+            for name in ("sum", "nansum", "min", "max", "nanmin"):
+                ref = generic_kernel(
+                    name, labels, jnp.asarray(vals), size=4,
+                    fill_value=None if name in ("sum", "nansum") else
+                    (np.inf if "min" in name else -np.inf),
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(got[name]), np.asarray(ref), err_msg=name
+                )
+
+    def test_scatter_policy_returns_none(self):
+        import jax.numpy as jnp
+
+        from flox_tpu.kernels import fused_segment_stats
+
+        vals = jnp.ones((32,), jnp.float32)
+        labels = np.zeros(32, np.int32)
+        with flox_tpu.set_options(segment_sum_impl="scatter"):
+            assert fused_segment_stats(labels, vals, size=2, want=("sum", "nanlen")) is None
+
+    def test_counts_alone_never_fuse(self):
+        import jax.numpy as jnp
+
+        from flox_tpu.kernels import fused_segment_stats
+
+        vals = jnp.ones((32,), jnp.float32)
+        with flox_tpu.set_options(segment_sum_impl="pallas"):
+            assert (
+                fused_segment_stats(
+                    np.zeros(32, np.int32), vals, size=2, want=("len", "nanlen")
+                )
+                is None
+            )
+
+    def test_mean_var_ride_the_shared_primitive(self):
+        # satellite: _fused_sum_counts is now a `want` set of the general
+        # primitive — mean/var single-statistic calls share it
+        import jax.numpy as jnp
+
+        from flox_tpu.kernels import _fused_sum_counts
+
+        rng = np.random.default_rng(2)
+        vals = jnp.asarray(rng.normal(size=(2, 160)).astype(np.float32))
+        labels = jnp.asarray(rng.integers(0, 3, 160).astype(np.int32))
+        with flox_tpu.set_options(segment_sum_impl="pallas"):
+            got = _fused_sum_counts(
+                jnp.moveaxis(vals, -1, 0), jnp.asarray(labels), 3
+            )
+        assert got is not None
+        total, cnt = got
+        np.testing.assert_allclose(
+            np.asarray(total).sum(), np.asarray(vals).sum(), rtol=1e-5
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cnt).sum(axis=0), np.full(2, 160.0)
+        )
+
+
+# ---------------------------------------------------------------------------
+# autotune dispatch + serve integration
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchIntegration:
+    def test_autotune_sequential_winner_falls_back(self, data, tmp_path):
+        from flox_tpu import autotune
+
+        vals, labels = data
+        with flox_tpu.set_options(
+            autotune=True, autotune_cache_path=str(tmp_path / "at.json")
+        ):
+            cache.clear_all()
+            nelems = vals.size
+            autotune.record("fused", "sequential", 100.0, dtype=str(vals.dtype),
+                            ngroups=7, nelems=nelems)
+            autotune.record("fused", "fused", 1.0, dtype=str(vals.dtype),
+                            ngroups=7, nelems=nelems)
+            out, _ = groupby_aggregate_many(vals, labels, funcs=("mean", "max"))
+            # the sequential branch is still correct — and bit-identical
+            for f in ("mean", "max"):
+                _assert_same(out[f], groupby_reduce(vals, labels, func=f)[0], f)
+        cache.clear_all()
+
+    def test_serve_multi_stat_coalesce_and_batch(self, data):
+        import asyncio
+
+        from flox_tpu.serve.dispatcher import AggregationRequest, Dispatcher
+        from flox_tpu.telemetry import METRICS
+
+        vals, labels = data
+        arr = np.ascontiguousarray(vals[0])
+
+        async def main():
+            d = Dispatcher()
+            d0 = METRICS.get("serve.dispatches")
+            r1, r2 = await asyncio.gather(
+                d.submit(AggregationRequest(func=["mean", "max"], array=arr, by=labels)),
+                d.submit(AggregationRequest(func=["mean", "max"], array=arr, by=labels)),
+            )
+            assert METRICS.get("serve.dispatches") - d0 == 1  # coalesced
+            assert r1.coalesced or r2.coalesced
+            d1 = METRICS.get("serve.dispatches")
+            r3, r4 = await asyncio.gather(
+                d.submit(AggregationRequest(func=("mean", "max"), array=arr, by=labels)),
+                d.submit(AggregationRequest(func=("mean", "max"), array=arr * 2, by=labels)),
+            )
+            assert METRICS.get("serve.dispatches") - d1 == 1  # micro-batched
+            assert r3.batch_size == 2 and r4.batch_size == 2
+            await d.close()
+            return r1, r4
+
+        r1, r4 = asyncio.run(main())
+        _assert_same(
+            r1.result["mean"], groupby_reduce(arr, labels, func="mean")[0],
+            "serve mean",
+        )
+        _assert_same(
+            r4.result["max"], groupby_reduce(arr * 2, labels, func="max")[0],
+            "serve batched max row",
+        )
+
+    def test_bench_seed_feeds_fused_family(self):
+        from flox_tpu import autotune
+
+        n = autotune._seed_from_bench_record(
+            {
+                "platform": "cpu",
+                "workload": {"nlat": 2, "nlon": 2, "ntime": 100, "ngroups": 4},
+                "fused": {"fused_sweep_gbps": {"fused": 5.0, "sequential": 1.5}},
+            }
+        )
+        assert n == 2
+        rec = autotune.lookup("fused", dtype="float32", ngroups=4, nelems=400,
+                              platform="cpu")
+        assert rec is not None and set(rec["candidates"]) == {"fused", "sequential"}
+        cache.clear_all()

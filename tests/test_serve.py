@@ -501,10 +501,15 @@ class TestLRUProgramCaches:
         from flox_tpu.parallel.mapreduce import _PROGRAM_CACHE
         from flox_tpu.streaming import _STEP_CACHE
 
+        from flox_tpu.fusion import _FUSED_PROGRAM_CACHE
+
         assert isinstance(_PROGRAM_CACHE, LRUCache)
         assert isinstance(_STEP_CACHE, LRUCache)
+        assert isinstance(_FUSED_PROGRAM_CACHE, LRUCache)
         stats = cache.stats()
-        assert stats["evictions"] == {"mesh_programs": 0, "stream_steps": 0}
+        assert stats["evictions"] == {
+            "mesh_programs": 0, "stream_steps": 0, "fused_programs": 0
+        }
         # sustained mixed traffic past capacity: hot key survives because
         # every get() renews it — the old clear() dropped it 4 times here
         _STEP_CACHE["hot"] = "hot-program"

@@ -87,6 +87,22 @@ def test_min_count(data):
     assert np.isnan(np.asarray(got)).all()  # nothing reaches min_count
 
 
+def test_min_count_var_matches_eager(data):
+    # regression: the min_count-appended nanlen leg used to leak into
+    # _var_finalize as a stray positional (ddof became a count array),
+    # poisoning every group to NaN on the streaming path; the runtime
+    # computes its own counts, so the appended leg is stripped like
+    # sharded_groupby_reduce strips it
+    vals, labels = data
+    got, _ = streaming_groupby_reduce(vals, labels, func="nanvar", batch_len=997,
+                                      min_count=2)
+    ref, _ = groupby_reduce(vals, labels, func="nanvar", min_count=2)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-12, equal_nan=True
+    )
+    assert not np.isnan(np.asarray(got)).all()
+
+
 def test_mode_rejected_median_streams(data):
     # median/quantile stream now (TestStreamingOrderStats); mode's
     # run-length structure still cannot
