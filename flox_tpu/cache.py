@@ -116,6 +116,7 @@ def stats() -> dict:
     from .parallel.scan import _SCAN_CACHE
     from .profiling import capture_active
     from .serve.aot import _MANIFEST_MEMO
+    from .serve.breaker import breaker_stats
     from .serve.dispatcher import _BATCH_REGISTRY, _COALESCE_CACHE, _PENDING_REGISTRY
     from .streaming import _STEP_CACHE
     from .telemetry import (
@@ -162,6 +163,10 @@ def stats() -> dict:
         "serve_coalesce": len(_COALESCE_CACHE),
         "serve_batches": len(_BATCH_REGISTRY),
         "serve_aot_manifest": len(_MANIFEST_MEMO),
+        # per-program circuit breakers: entry counts per state plus the
+        # open/half-open detail (which program labels are being fast-failed
+        # and how long their cooldowns have left)
+        "serve_breakers": breaker_stats(),
         "bundle_lru": {
             "size": info.currsize, "hits": info.hits, "misses": info.misses
         },
@@ -198,6 +203,7 @@ def clear_all() -> None:
     from .profiling import _CAPTURE_STATE
     from .resilience import _SNAPSHOTS
     from .serve.aot import _MANIFEST_MEMO
+    from .serve.breaker import _BREAKER_REGISTRY
     from .serve.dispatcher import _BATCH_REGISTRY, _COALESCE_CACHE, _PENDING_REGISTRY
     from .streaming import _STEP_CACHE
     from .telemetry import (
@@ -225,6 +231,9 @@ def clear_all() -> None:
     _COALESCE_CACHE.clear()
     _BATCH_REGISTRY.clear()
     _MANIFEST_MEMO.clear()
+    # circuit-breaker state resets with the program caches it shadows: a
+    # cleared process has no failure history, so no breaker stays open
+    _BREAKER_REGISTRY.clear()
     # pallas one-time probe memos (floxlint FLX008: every runtime-accreted
     # module-level cache must be reachable from here) — the next reduction
     # after a clear re-validates the backend, which is exactly the fresh

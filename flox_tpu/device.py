@@ -22,7 +22,52 @@ import numpy as np
 from . import factorize as fct
 from .aggregations import _initialize_aggregation
 
-__all__ = ["groupby_reduce_device", "codes_device", "memory_stats"]
+__all__ = ["groupby_reduce_device", "codes_device", "memory_stats", "reinitialize"]
+
+
+def reinitialize() -> bool:
+    """Tear down and re-create the JAX backend client — the recovery step
+    after a device-loss classification (``resilience.DEVICE_LOST``).
+
+    Clears jax's live backend clients so the next dispatch re-initializes
+    the runtime (PJRT re-enumerates devices), and drops this package's
+    compiled-program caches — executables compiled against the dead client
+    hold dangling device references and must never be served again. The
+    metrics registry, cost ledger, and autotune store are deliberately
+    untouched: recovery is not a stats reset. Returns whether a backend
+    teardown API was found (``False`` degrades to cache-drop-only, which is
+    still the correct half of the story on backends that self-heal).
+    Never raises: recovery must be drivable from an error path.
+    """
+    import jax
+
+    torn_down = False
+    # the teardown API moved across jax releases; try each spelling
+    holders = (
+        getattr(getattr(jax, "extend", None), "backend", None),
+        getattr(jax, "_src", None) and getattr(jax._src, "api", None),
+        jax,
+    )
+    for holder in holders:
+        fn = getattr(holder, "clear_backends", None) if holder is not None else None
+        if callable(fn) and _teardown_quietly(fn):
+            torn_down = True
+            break
+    try:
+        from .core import _jitted_bundle
+        from .fusion import _FUSED_PROGRAM_CACHE
+        from .parallel.mapreduce import _PROGRAM_CACHE
+        from .parallel.scan import _SCAN_CACHE
+        from .streaming import _STEP_CACHE
+
+        _jitted_bundle.cache_clear()
+        _PROGRAM_CACHE.clear()
+        _SCAN_CACHE.clear()
+        _STEP_CACHE.clear()
+        _FUSED_PROGRAM_CACHE.clear()
+    except Exception:  # noqa: BLE001 — partial recovery beats masking the loss
+        pass
+    return torn_down
 
 
 def memory_stats(devices: Sequence | None = None) -> dict[str, int] | None:
@@ -54,6 +99,20 @@ def memory_stats(devices: Sequence | None = None) -> dict[str, int] | None:
     if not reporting:
         return None
     return {"bytes_in_use": in_use, "peak_bytes_in_use": peak, "devices": reporting}
+
+
+def _teardown_quietly(fn: Any) -> bool:
+    """Run one backend-teardown candidate; ``False`` means try the next
+    spelling (recovery proceeds to the cache drop either way)."""
+    import warnings
+
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            fn()
+        return True
+    except Exception:  # noqa: BLE001 — an unavailable spelling, not a fault
+        return False
 
 
 def _device_stats(dev: Any) -> dict | None:

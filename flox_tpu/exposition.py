@@ -51,6 +51,7 @@ __all__ = [
     "MetricsServer",
     "prometheus_text",
     "ready",
+    "ready_reason",
     "set_ready",
     "start_metrics_server",
     "stop_metrics_server",
@@ -60,20 +61,30 @@ _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
 #: process-wide endpoint state: the live server (one per process — the
 #: registry it exposes is process-wide too) and the readiness flag
-_SERVER_STATE: dict[str, Any] = {"server": None, "ready": False}
+_SERVER_STATE: dict[str, Any] = {"server": None, "ready": False, "reason": "warming"}
 _STATE_LOCK = threading.Lock()
 
 
-def set_ready(flag: bool = True) -> None:
+def set_ready(flag: bool = True, reason: str | None = None) -> None:
     """Flip the ``/readyz`` verdict. The serve loop calls this once its AOT
     warmup manifest has been replayed (immediately when there is nothing to
-    replay); tests and drains may flip it back."""
+    replay); the drain path and device-loss recovery flip it back with a
+    ``reason`` (``"draining"`` / ``"device-lost"``) that becomes the 503
+    body, so a fleet router's probe log says WHY the replica left rotation."""
     _SERVER_STATE["ready"] = bool(flag)
+    _SERVER_STATE["reason"] = "warming" if flag or reason is None else str(reason)
 
 
 def ready() -> bool:
     """Whether ``/readyz`` currently answers 200."""
     return bool(_SERVER_STATE["ready"])
+
+
+def ready_reason() -> str:
+    """The current 503 body for an unready replica (``"warming"`` at boot,
+    ``"draining"`` during graceful shutdown, ``"device-lost"`` while the
+    backend recovers)."""
+    return str(_SERVER_STATE.get("reason") or "warming")
 
 
 def _metric_name(name: str, suffix: str = "") -> str:
@@ -196,7 +207,9 @@ class _Handler(BaseHTTPRequestHandler):
             if ready():
                 body, status = b"ready\n", 200
             else:
-                body, status = b"warming\n", 503
+                # the reason IS the payload: "warming" at boot, "draining"
+                # during graceful shutdown, "device-lost" mid-recovery
+                body, status = ready_reason().encode() + b"\n", 503
             ctype = "text/plain; charset=utf-8"
         elif path == "/debug/costs":
             body, status = self._costs()
@@ -324,5 +337,6 @@ def stop_metrics_server() -> None:
         server = _SERVER_STATE.pop("server", None)
         _SERVER_STATE["server"] = None
         _SERVER_STATE["ready"] = False
+        _SERVER_STATE["reason"] = "warming"
     if server is not None:
         server.close()

@@ -16,6 +16,14 @@ where the resilience layer must handle them:
   the halve-and-re-stage ladder, recursively when ``times > 1``), and
   :class:`StreamKilled` at a chosen slab start or after a chosen number of
   dispatches (simulated host preemption — exercises checkpoint/resume).
+* :func:`serve_inject` installs the SERVE-level fault plan consulted by
+  ``serve.dispatcher.Dispatcher._execute`` immediately before each device
+  dispatch (:func:`serve_poke`): poison one micro-batch member by payload
+  digest (drives the request-quarantine bisection — the fault re-fires for
+  every sub-batch still containing the poisoned leaf), fail compiles for a
+  chosen program label (drives the per-program circuit breaker),
+  :class:`SimulatedDeviceLoss` at dispatch N (drives backend recovery),
+  and hang a chosen dispatch (drives the dispatch watchdog).
 
 Everything is index-deterministic: the same plan against the same stream
 fires at the same slabs in the same order, prefetch on or off. The plan
@@ -34,10 +42,15 @@ import numpy as np
 __all__ = [
     "SimulatedOOM",
     "StreamKilled",
+    "SimulatedDeviceLoss",
+    "SimulatedCompileError",
     "FlakyLoader",
     "inject",
     "poke",
     "active",
+    "serve_inject",
+    "serve_poke",
+    "serve_active",
     "misshaping_loader",
 ]
 
@@ -58,6 +71,24 @@ class StreamKilled(RuntimeError):
 
     def __init__(self, where: str = "") -> None:
         super().__init__(f"stream killed (simulated preemption) {where}".rstrip())
+
+
+class SimulatedDeviceLoss(RuntimeError):
+    """Stands in for a PJRT device-loss ``XlaRuntimeError``: the message
+    carries the ``DEVICE_LOST`` status token, so ``resilience.classify_error``
+    routes it down the same backend-recovery path as the real thing."""
+
+    def __init__(self, where: str = "") -> None:
+        super().__init__(f"DEVICE_LOST (simulated): device lost {where}".rstrip())
+
+
+class SimulatedCompileError(RuntimeError):
+    """A deterministically-failing compile/dispatch: classified FATAL (no
+    status token), the substrate for the request-quarantine and
+    circuit-breaker chaos tests — never retried, never split."""
+
+    def __init__(self, where: str = "") -> None:
+        super().__init__(f"INVALID_PROGRAM (simulated): compile failed {where}".rstrip())
 
 
 @dataclass
@@ -190,6 +221,124 @@ class FlakyLoader:
         """How many times the underlying slab at ``start`` was actually
         requested (fault firings included)."""
         return sum(1 for (s, _e) in self.calls if s == start)
+
+
+# ---------------------------------------------------------------------------
+# serve-level injection: the chaos substrate for the serve fault domain
+
+
+@dataclass
+class _ServePlan:
+    """One installed serve-level fault plan, with an injection log for
+    asserting determinism. Consulted by ``Dispatcher._execute`` via
+    :func:`serve_poke` immediately before each device dispatch."""
+
+    #: payload digest -> fault: a dispatch whose leaf set CONTAINS the
+    #: digest raises — so the quarantine bisection keeps hitting it until
+    #: the poisoned member dispatches alone
+    poison: dict[str, _Fault] = field(default_factory=dict)
+    #: program func label -> fault (fail-compile-for-program-key)
+    fail_compile: dict[str, _Fault] = field(default_factory=dict)
+    #: 1-based dispatch numbers that raise SimulatedDeviceLoss
+    device_loss_at: frozenset = frozenset()
+    #: 1-based dispatch numbers that hang for ``hang_seconds``
+    hang_at: frozenset = frozenset()
+    hang_seconds: float = 1.0
+    dispatches: int = 0
+    #: (kind | None, label, dispatch_no) per dispatch, in dispatch order
+    log: list = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+
+_SERVE_PLAN: _ServePlan | None = None
+
+
+def serve_active() -> bool:
+    return _SERVE_PLAN is not None
+
+
+def serve_poke(label: str, digests: tuple = ()) -> None:
+    """Serve-dispatch injection hook: ``Dispatcher._execute`` calls this at
+    the top of every device dispatch with the program's func label and the
+    payload digests of the leaves being dispatched. No-op unless a plan is
+    installed via :func:`serve_inject`. Hangs run OUTSIDE the plan lock so
+    a concurrent healthy dispatch is never blocked by an injected hang."""
+    plan = _SERVE_PLAN
+    if plan is None:
+        return
+    hang = 0.0
+    with plan._lock:
+        plan.dispatches += 1
+        n = plan.dispatches
+        for digest in digests:
+            fault = plan.poison.get(digest)
+            if fault is not None and fault.times != 0:
+                if fault.times > 0:
+                    fault.times -= 1
+                plan.log.append(("poison", label, n))
+                raise fault.exc(f"poisoned member {digest[:8]} in dispatch #{n}")
+        fault = plan.fail_compile.get(label)
+        if fault is not None and fault.times != 0:
+            if fault.times > 0:
+                fault.times -= 1
+            plan.log.append(("fail-compile", label, n))
+            raise fault.exc(f"for program {label!r} at dispatch #{n}")
+        if n in plan.device_loss_at:
+            plan.log.append(("device-loss", label, n))
+            raise SimulatedDeviceLoss(f"at dispatch #{n}")
+        if n in plan.hang_at:
+            plan.log.append(("hang", label, n))
+            hang = plan.hang_seconds
+        else:
+            plan.log.append((None, label, n))
+    if hang > 0:
+        import time
+
+        time.sleep(hang)
+
+
+@contextlib.contextmanager
+def serve_inject(
+    *,
+    poison_digests: tuple[str, ...] | list[str] = (),
+    poison_times: int = -1,
+    fail_compile_for: tuple[str, ...] | list[str] = (),
+    fail_times: int = -1,
+    device_loss_at: tuple[int, ...] | list[int] = (),
+    hang_at: tuple[int, ...] | list[int] = (),
+    hang_seconds: float = 1.0,
+) -> Iterator[_ServePlan]:
+    """Install a deterministic serve-level fault plan for the scope.
+
+    ``poison_digests``: payload digests (``serve.dispatcher.payload_digest``
+    of the request's array) whose every containing dispatch raises
+    :class:`SimulatedCompileError` — the default ``times=-1`` keeps firing
+    through the quarantine bisection until the poisoned member dispatches
+    alone (and would fail a retry too, as a genuinely poisoned payload
+    does). ``fail_compile_for``: program func labels whose dispatches raise
+    :class:`SimulatedCompileError` ``fail_times`` times (-1 = always) — the
+    circuit-breaker substrate. ``device_loss_at``: 1-based dispatch numbers
+    that raise :class:`SimulatedDeviceLoss` once. ``hang_at``: 1-based
+    dispatch numbers that sleep ``hang_seconds`` before executing — the
+    watchdog substrate. Yields the plan; its ``log`` records every dispatch
+    for determinism assertions.
+    """
+    global _SERVE_PLAN
+    plan = _ServePlan(
+        device_loss_at=frozenset(int(n) for n in device_loss_at),
+        hang_at=frozenset(int(n) for n in hang_at),
+        hang_seconds=float(hang_seconds),
+    )
+    for d in poison_digests:
+        plan.poison[str(d)] = _Fault(SimulatedCompileError, poison_times)
+    for label in fail_compile_for:
+        plan.fail_compile[str(label)] = _Fault(SimulatedCompileError, fail_times)
+    prev = _SERVE_PLAN
+    _SERVE_PLAN = plan
+    try:
+        yield plan
+    finally:
+        _SERVE_PLAN = prev
 
 
 def misshaping_loader(

@@ -267,6 +267,25 @@ OPTIONS: dict[str, Any] = {
     "serve_microbatch_max_elems": _env_int(
         "FLOX_TPU_SERVE_MICROBATCH_MAX_ELEMS", 1 << 20, 0
     ),
+    # Serve fault domain (flox_tpu/serve/): seconds a draining replica
+    # (SIGTERM or {"op":"shutdown"}) waits for in-flight requests to finish
+    # before exiting — admission stops and /readyz flips 503 the moment the
+    # drain begins; requests still unfinished past the budget are failed
+    # (never silently dropped). 0 = exit as soon as admission has stopped.
+    "serve_drain_timeout": _env_float("FLOX_TPU_SERVE_DRAIN_TIMEOUT", 30.0),
+    # seconds a single device dispatch may run before the watchdog fails its
+    # waiters (typed WatchdogTimeoutError), flight-dumps, and leaves a
+    # capture hint — a wedged dispatch must not hang the whole queue.
+    # 0 (the default) disables the watchdog.
+    "serve_watchdog_timeout": _env_float("FLOX_TPU_SERVE_WATCHDOG_TIMEOUT", 0.0),
+    # consecutive fatal failures on ONE program key that open its circuit
+    # breaker: further identical-program requests fast-fail with a typed
+    # CircuitOpenError (no device dispatch burned) until the cooldown
+    # elapses and a half-open probe request closes it. 0 disables breakers.
+    "serve_breaker_threshold": _env_int("FLOX_TPU_SERVE_BREAKER_THRESHOLD", 5, 0, 10_000),
+    # seconds an open breaker fast-fails before admitting one half-open
+    # probe request (success closes the breaker, failure re-opens it)
+    "serve_breaker_cooldown": _env_float("FLOX_TPU_SERVE_BREAKER_COOLDOWN", 30.0),
     # AOT persistence root (flox_tpu/serve/aot.py): the JAX persistent
     # compilation cache directory + the warmup manifest next to it. A
     # fresh replica pointed at a warm dir serves its first request with
@@ -363,6 +382,13 @@ _VALIDATORS = {
     "serve_microbatch_max": lambda x: _is_int(x) and 1 <= x <= 1024,
     "serve_batch_window": lambda x: _is_finite_num(x) and 0 <= x <= 60,
     "serve_microbatch_max_elems": lambda x: _is_int(x) and x >= 0,
+    # serve fault-domain knobs: same at-set-time discipline — a negative
+    # drain budget or a non-finite cooldown raises here, not mid-drain or
+    # inside the breaker check
+    "serve_drain_timeout": lambda x: _is_finite_num(x) and x >= 0,
+    "serve_watchdog_timeout": lambda x: _is_finite_num(x) and x >= 0,
+    "serve_breaker_threshold": lambda x: _is_int(x) and 0 <= x <= 10_000,
+    "serve_breaker_cooldown": lambda x: _is_finite_num(x) and x >= 0,
     "serve_aot_dir": lambda x: x is None or (
         isinstance(x, (str, os.PathLike)) and bool(str(x))
     ),

@@ -49,6 +49,7 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -60,8 +61,10 @@ __all__ = [
     "TRANSIENT",
     "OOM",
     "FATAL",
+    "DEVICE_LOST",
     "classify_error",
     "register_transient",
+    "seed_backoff",
     "RetryPolicy",
     "call_with_retry",
     "StreamCounters",
@@ -74,6 +77,11 @@ __all__ = [
 TRANSIENT = "transient"
 OOM = "oom"
 FATAL = "fatal"
+#: the device (or its backend runtime) is gone — retrying the call cannot
+#: help and splitting it cannot help; the serve plane reacts by failing
+#: in-flight waiters, reinitializing the backend, and replaying its AOT
+#: warmup manifest (serve/dispatcher.py device-loss recovery)
+DEVICE_LOST = "device_lost"
 
 # exception types retried as transient: IO and RPC hiccups. OSError subsumes
 # IOError / TimeoutError / ConnectionError / BrokenPipeError — the loader-IO
@@ -99,6 +107,13 @@ _NON_RECOVERABLE_OS: tuple[type, ...] = (
 _RUNTIME_ERROR_NAMES = ("XlaRuntimeError", "JaxRuntimeError")
 _OOM_TOKENS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
 _TRANSIENT_TOKENS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED")
+# a dead chip surfaces as an XlaRuntimeError carrying one of these (PJRT
+# wording varies by backend/version; faults.SimulatedDeviceLoss carries the
+# first token so the chaos harness rides the same path as the real thing)
+_DEVICE_LOSS_TOKENS = (
+    "DEVICE_LOST", "device lost", "Device lost", "backend is dead",
+    "device is in an invalid state",
+)
 
 
 def register_transient(exc_type: type) -> None:
@@ -111,23 +126,63 @@ def register_transient(exc_type: type) -> None:
 
 
 def classify_error(exc: BaseException) -> str:
-    """``transient`` | ``oom`` | ``fatal`` for one exception.
+    """``transient`` | ``oom`` | ``device_lost`` | ``fatal`` for one exception.
 
     The ONE gate every streaming retry/degradation path consults, so the
     transient-vs-fatal line cannot drift between them: transient errors are
-    retried with backoff, oom errors trigger the slab split, everything
-    else (programming errors above all) raises immediately.
+    retried with backoff, oom errors trigger the slab split, device-loss
+    errors trigger the serve plane's backend recovery, everything else
+    (programming errors above all) raises immediately.
+
+    A ``fatal`` verdict on the outermost exception is re-checked down the
+    ``__cause__``/``__context__`` chain: a transient ``IOError`` that a
+    wrapper (``asyncio.to_thread`` plumbing, a loader SDK's
+    ``raise RuntimeError(...) from exc``) re-raised as a generic
+    ``RuntimeError`` is still transient — misclassifying it fatal would
+    turn an IO hiccup into a dead stream. Only fatal softens this way: an
+    explicitly transient/oom outer classification is already the most
+    actionable verdict and never consults the chain.
     """
+    cls = _classify_one(exc)
+    if cls != FATAL:
+        return cls
+    seen: set[int] = {id(exc)}
+    queue: list[BaseException] = [exc]
+    for _ in range(8):  # bounded: exception chains are short, cycles exist
+        if not queue:
+            break
+        current = queue.pop(0)
+        for link in (current.__cause__, current.__context__):
+            if link is None or id(link) in seen:
+                continue
+            seen.add(id(link))
+            inner = _classify_one(link)
+            if inner != FATAL:
+                return inner
+            queue.append(link)
+    return FATAL
+
+
+def _classify_one(exc: BaseException) -> str:
+    """Classification of one exception, ignoring its chain."""
     msg = str(exc)
     if isinstance(exc, MemoryError):
         # host-side slab allocation failure: splitting halves that too
         return OOM
     if type(exc).__name__ in _RUNTIME_ERROR_NAMES:
+        if any(tok in msg for tok in _DEVICE_LOSS_TOKENS):
+            return DEVICE_LOST
         if any(tok in msg for tok in _OOM_TOKENS):
             return OOM
         if any(tok in msg for tok in _TRANSIENT_TOKENS):
             return TRANSIENT
         return FATAL
+    if isinstance(exc, RuntimeError) and any(
+        tok in msg for tok in _DEVICE_LOSS_TOKENS
+    ):
+        # covers faults.SimulatedDeviceLoss and runtime wrappers that kept
+        # the status token in the message
+        return DEVICE_LOST
     if isinstance(exc, RuntimeError) and any(tok in msg for tok in _OOM_TOKENS):
         # covers faults.SimulatedOOM and any runtime wrapper that kept the
         # status token in the message
@@ -141,14 +196,34 @@ def classify_error(exc: BaseException) -> str:
     return FATAL
 
 
+#: jitter source for the retry backoff — module-level so the fault harness
+#: can pin it (:func:`seed_backoff`) and replay a chaos run's exact sleep
+#: schedule; never used for anything load-bearing beyond scheduling
+_BACKOFF_RNG = random.Random()
+
+
+def seed_backoff(seed: Any = None) -> None:
+    """Seed the backoff jitter source. The fault-injection tests pin it so
+    a chaos run's retry schedule is reproducible; production leaves it
+    unseeded (OS entropy) so prefetch workers de-synchronize."""
+    _BACKOFF_RNG.seed(seed)
+
+
 @dataclass(frozen=True)
 class RetryPolicy:
     """Retry knobs for one stream, frozen at stream start.
 
     ``retries`` extra attempts per slab (so ``retries + 1`` total),
-    ``backoff`` base sleep in seconds (doubled per attempt:
-    ``backoff * 2**attempt``), ``timeout`` the per-slab deadline in seconds
-    across ALL attempts+backoffs of that slab (0 = no deadline)."""
+    ``backoff`` base sleep in seconds, ``timeout`` the per-slab deadline in
+    seconds across ALL attempts+backoffs of that slab (0 = no deadline).
+
+    Sleeps use **full jitter**: attempt ``k`` sleeps
+    ``uniform(0, backoff * 2**k)``. Without it, every prefetch worker that
+    hit the same transient fault (one flaky object store, N concurrent
+    loads) retries at the same instant and they re-collide on every rung of
+    the exponential ladder; the jitter spreads the retry herd across the
+    whole window. Deterministic under the fault harness via
+    :func:`seed_backoff`."""
 
     retries: int = 2
     backoff: float = 0.05
@@ -165,7 +240,14 @@ class RetryPolicy:
         )
 
     def delay(self, attempt: int) -> float:
-        return self.backoff * (2.0**attempt)
+        cap = self.backoff * (2.0**attempt)
+        if cap <= 0:
+            return 0.0
+        # full jitter over the open interval: never exactly 0 (a zero sleep
+        # would defeat the de-synchronization the jitter exists for) and
+        # never the synchronized full cap
+        u = _BACKOFF_RNG.random()
+        return cap * (u if u > 0.0 else 0.5)
 
 
 def _flight_on_fatal(exc: BaseException, what: str = "") -> None:
@@ -203,11 +285,11 @@ def call_with_retry(
         except Exception as exc:
             cls = classify_error(exc)
             if cls != TRANSIENT:
-                if cls == FATAL:
-                    # a programming error is about to surface: leave the
-                    # flight record NOW, while the ring still holds the
-                    # spans/events leading up to it (no-op unless
-                    # FLOX_TPU_FLIGHT_RECORDER_PATH is configured)
+                if cls in (FATAL, DEVICE_LOST):
+                    # a programming error (or a dead device) is about to
+                    # surface: leave the flight record NOW, while the ring
+                    # still holds the spans/events leading up to it (no-op
+                    # unless FLOX_TPU_FLIGHT_RECORDER_PATH is configured)
                     _flight_on_fatal(exc, what=what)
                 raise
             if attempt >= policy.retries:
@@ -316,7 +398,7 @@ def dispatch_slab(
     except Exception as exc:
         cls = classify_error(exc)
         if cls != OOM or stager is None:
-            if cls == FATAL:
+            if cls in (FATAL, DEVICE_LOST):
                 _flight_on_fatal(exc, what=f"[{sl.start}:{sl.stop})")
             raise
         return _split_dispatch(

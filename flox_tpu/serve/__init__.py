@@ -13,8 +13,21 @@ requests instead:
   rooted at ``OPTIONS["serve_aot_dir"]`` plus a warmup manifest, so a
   restarted replica serves its first request with zero new backend
   compiles (asserted on the ``jax.compiles`` telemetry counter).
+* :mod:`.breaker` — per-program circuit breakers: a program key whose
+  dispatches keep failing fatally fast-fails at submit with a typed
+  :class:`CircuitOpenError` until a half-open probe closes it.
 * ``python -m flox_tpu.serve`` — a JSON-lines request loop over the
   dispatcher, for testing and smoke deployment (see :mod:`.__main__`).
+
+The serve plane carries its own fault domain (the serving-era analogue of
+the streaming resilience layer): request quarantine (a poisoned micro-batch
+member fails alone — healthy peers still get results), device-loss
+recovery (typed :class:`DeviceLostError` to in-flight waiters, backend
+reinit + AOT warmup replay, readiness flipped around the cycle), a
+dispatch watchdog (:class:`WatchdogTimeoutError` instead of a wedged
+queue), and graceful drain (SIGTERM / ``{"op": "shutdown"}`` answer
+in-flight requests and exit 0). Deterministic chaos coverage lives in
+``faults.serve_inject`` + ``tests/test_serve_chaos.py``.
 
 Per-request SLO metrics (``serve.queue_ms`` / ``serve.device_ms`` /
 ``serve.request_ms`` histograms, ``serve.*`` counters) flow through the
@@ -24,22 +37,33 @@ and reset by ``cache.clear_all()``.
 
 from __future__ import annotations
 
-from . import aot
+from . import aot, breaker
 from .dispatcher import (
     AggregationRequest,
+    CircuitOpenError,
     DeadlineExceededError,
+    DeviceLostError,
     Dispatcher,
+    DrainingError,
     LoadShedError,
     ServeError,
     ServeResult,
+    WatchdogTimeoutError,
+    payload_digest,
 )
 
 __all__ = [
     "AggregationRequest",
+    "CircuitOpenError",
     "DeadlineExceededError",
+    "DeviceLostError",
     "Dispatcher",
+    "DrainingError",
     "LoadShedError",
     "ServeError",
     "ServeResult",
+    "WatchdogTimeoutError",
     "aot",
+    "breaker",
+    "payload_digest",
 ]

@@ -13,7 +13,14 @@ Responses are emitted as each completes (match them by ``id``)::
 
     {"id": "r1", "ok": true, "result": [...], "groups": [...],
      "coalesced": false, "batch": 1, "queue_ms": 0.4, "device_ms": 2.1}
-    {"id": "r2", "ok": false, "error": "LoadShedError", "message": "..."}
+    {"id": "r2", "ok": false, "error": "LoadShedError", "code": "load_shed",
+     "retry_after_ms": 2.0, "message": "..."}
+
+Serving-layer failures carry a machine-readable ``code``
+(``load_shed`` / ``deadline_exceeded`` / ``circuit_open`` /
+``device_lost`` / ``watchdog_timeout`` / ``draining``) plus an optional
+``retry_after_ms`` hint, so clients branch on the code instead of
+string-matching Python class names.
 
 Control lines use ``op`` instead of ``func``:
 
@@ -22,12 +29,19 @@ Control lines use ``op`` instead of ``func``:
 * ``{"op": "stats"}`` — cache.stats() + the telemetry counter snapshot
   (``jax.compiles`` included: the two-process AOT smoke asserts on it;
   the per-program/per-tenant cost ledger rides ``cache.cost_by_program`` /
-  ``cache.cost_by_tenant``).
+  ``cache.cost_by_tenant``; breaker state rides ``cache.serve_breakers``).
 * ``{"op": "profile", "seconds": N}`` — start an on-demand on-chip capture
   into ``OPTIONS["profile_dir"]`` (409-equivalent ``"busy"`` while one
   runs, ``"unavailable"`` on profiler-less backends).
 * ``{"op": "drain"}`` — wait for every in-flight request before reading on
   (scripted runs use it to sequence assertions).
+* ``{"op": "shutdown"}`` — graceful drain: admission stops, ``/readyz``
+  flips 503 immediately, in-flight requests finish within
+  ``serve_drain_timeout``, the flight recorder dumps, and the process
+  exits 0. SIGTERM triggers the exact same path (the supervisor's
+  rolling-restart signal must never kill a request mid-flight — the old
+  behavior of dump-and-die-143 is still what the standalone metrics
+  endpoint does, where there are no requests to finish).
 
 Request lines may carry a ``"tenant"`` tag: it feeds the per-tenant cost
 ledger and a ``serve.request_ms{tenant=...}`` histogram on /metrics
@@ -44,6 +58,8 @@ import argparse
 import asyncio
 import json
 import sys
+import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -59,6 +75,10 @@ _REQUEST_FIELDS = frozenset(
     }
 )
 
+#: soft bound on lines buffered ahead of the serve loop — a scripted
+#: multi-GB request file must not load wholesale into the line queue
+_READER_HIGH_WATER = 512
+
 
 def _emit(obj: dict) -> None:
     # all emits run on the event-loop thread, so lines never interleave
@@ -73,7 +93,28 @@ def _counters() -> dict:
     return {"cache": cache.stats(), "counters": METRICS.snapshot()}
 
 
+def _error_response(rid: str, exc: Exception) -> dict:
+    """The typed error envelope: the exception class (back-compat), the
+    machine-readable ``code``, and the ``retry_after_ms`` hint when the
+    failure kind has one (load shed, open breaker)."""
+    out: dict[str, Any] = {
+        "id": rid, "ok": False,
+        "error": type(exc).__name__, "message": str(exc),
+    }
+    if isinstance(exc, ServeError):
+        out["code"] = exc.code
+        if exc.retry_after_ms is not None:
+            out["retry_after_ms"] = round(float(exc.retry_after_ms), 3)
+        if exc.program is not None:
+            out["program"] = exc.program
+    else:
+        out["code"] = "execution"
+    return out
+
+
 async def _serve_request(dispatcher: Dispatcher, line_no: int, msg: dict) -> None:
+    from .. import telemetry
+
     rid = msg.get("id", f"line-{line_no}")
     try:
         unknown = set(msg) - _REQUEST_FIELDS - {"id"}
@@ -83,20 +124,21 @@ async def _serve_request(dispatcher: Dispatcher, line_no: int, msg: dict) -> Non
             request_id=rid, **{k: v for k, v in msg.items() if k != "id"}
         )
     except Exception as exc:  # noqa: BLE001 — malformed envelope, client's bug
-        _emit({"id": rid, "ok": False, "error": "protocol", "message": str(exc)})
+        telemetry.record_serve_error(exc, what=f"protocol line {line_no}")
+        _emit({"id": rid, "ok": False, "error": "protocol", "code": "protocol",
+               "message": str(exc)})
         return
     try:
         result = await dispatcher.submit(request)
     except ServeError as exc:
-        _emit(
-            {"id": rid, "ok": False, "error": type(exc).__name__, "message": str(exc)}
-        )
+        _emit(_error_response(rid, exc))
     except Exception as exc:  # noqa: BLE001 — execution failed, NOT a protocol
         # error: report the real class so clients can tell a bad func/dtype
-        # apart from a malformed line (and never kill the loop over it)
-        _emit(
-            {"id": rid, "ok": False, "error": type(exc).__name__, "message": str(exc)}
-        )
+        # apart from a malformed line (and never kill the loop over it).
+        # The flight ring keeps the record (FLX012): the dispatcher already
+        # classified the failure, this preserves WHICH request wore it.
+        telemetry.record_serve_error(exc, what=f"request {rid}")
+        _emit(_error_response(rid, exc))
     else:
         # multi-statistic requests (func = a list of names) answer with a
         # {func: values} object; single statistics stay a flat list
@@ -118,7 +160,76 @@ async def _serve_request(dispatcher: Dispatcher, line_no: int, msg: dict) -> Non
         )
 
 
+def _start_reader(stream: Any, loop: asyncio.AbstractEventLoop) -> asyncio.Queue:
+    """Feed input lines into an asyncio queue from a daemon thread.
+
+    A daemon reader (instead of ``asyncio.to_thread(stream.readline)``)
+    is what makes the graceful drain exit-able: a drain that begins while
+    the process is blocked reading stdin must not wait for one more line —
+    the loop simply stops consuming the queue, and the parked thread dies
+    with the process instead of wedging executor shutdown."""
+    queue: asyncio.Queue = asyncio.Queue()
+
+    def _read() -> None:
+        line_no = 0
+        try:
+            for line in stream:
+                line_no += 1
+                while queue.qsize() > _READER_HIGH_WATER:
+                    time.sleep(0.005)  # soft back-pressure on scripted files
+                loop.call_soon_threadsafe(queue.put_nowait, (line_no, line))
+        except (RuntimeError, ValueError, OSError):
+            pass  # loop closed / stream torn down mid-read: exit quietly
+        try:
+            loop.call_soon_threadsafe(queue.put_nowait, None)  # EOF sentinel
+        except RuntimeError:
+            pass
+
+    threading.Thread(target=_read, name="flox-tpu-serve-reader", daemon=True).start()
+    return queue
+
+
+async def _drain_and_exit(
+    dispatcher: Dispatcher, pending: set[asyncio.Task], source: str
+) -> None:
+    """Finish in-flight work within ``serve_drain_timeout``, then dump.
+
+    Requests still unfinished past the budget are cancelled (their waiters
+    see the cancellation, never a silent drop) and counted on
+    ``serve.drain_abandoned``."""
+    from .. import telemetry
+    from ..options import OPTIONS
+    from ..telemetry import METRICS
+
+    budget = float(OPTIONS["serve_drain_timeout"] or 0)
+    deadline = time.monotonic() + budget
+    abandoned = 0
+    if pending:
+        done, not_done = await asyncio.wait(
+            set(pending), timeout=budget if budget > 0 else 0
+        )
+        for task in not_done:
+            task.cancel()
+            abandoned += 1
+    remaining = max(0.0, deadline - time.monotonic())
+    try:
+        await asyncio.wait_for(dispatcher.close(), remaining or 0.001)
+    except (asyncio.TimeoutError, TimeoutError):
+        abandoned += 1
+    if abandoned:
+        METRICS.inc("serve.drain_abandoned", abandoned)
+    telemetry.flight_dump(reason=f"drain:{source}")
+    _emit(
+        {
+            "op": "shutdown", "ok": True, "source": source,
+            "abandoned": abandoned,
+        }
+    )
+
+
 async def _amain(args: argparse.Namespace) -> int:
+    import signal
+
     from .. import exposition
     from ..options import OPTIONS, set_options
 
@@ -145,17 +256,48 @@ async def _amain(args: argparse.Namespace) -> int:
         microbatch_max=args.microbatch_max,
         batch_window=args.batch_window,
     )
-    stream = sys.stdin if args.input == "-" else open(args.input)
-    pending: set[asyncio.Task] = set()
-    line_no = 0
+    drain_event = asyncio.Event()
+    drain_state: dict[str, str] = {}
+
+    def _begin_drain(source: str) -> None:
+        # idempotent: a second SIGTERM during a drain changes nothing.
+        # Ordering is the ROADMAP-item-2 contract: readiness flips 503
+        # FIRST (the fleet router stops routing), THEN admission closes,
+        # THEN in-flight work finishes.
+        if drain_state:
+            return
+        drain_state["source"] = source
+        exposition.set_ready(False, reason="draining")
+        dispatcher.begin_drain()
+        drain_event.set()
+
+    loop = asyncio.get_running_loop()
     try:
-        while True:
-            # one reader thread-hop per line; requests run concurrently
-            # because we never await the per-request task here
-            line = await asyncio.to_thread(stream.readline)
-            if not line:
-                break
-            line_no += 1
+        loop.add_signal_handler(
+            signal.SIGTERM, _begin_drain, "SIGTERM"
+        )
+    except (NotImplementedError, RuntimeError, ValueError):
+        pass  # platform without unix signals: the shutdown op still drains
+    stream = sys.stdin if args.input == "-" else open(args.input)
+    queue = _start_reader(stream, loop)
+    pending: set[asyncio.Task] = set()
+    # ONE long-lived drain sentinel raced against each line read — per-line
+    # task churn would put two allocations and a cancellation on the hot
+    # path of every request line for a pure signal
+    drainer = asyncio.ensure_future(drain_event.wait())
+    try:
+        while not drain_event.is_set():
+            getter = asyncio.ensure_future(queue.get())
+            done, _ = await asyncio.wait(
+                {getter, drainer}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if getter not in done:
+                getter.cancel()
+                break  # drain began while blocked on input
+            item = getter.result()
+            if item is None:
+                break  # EOF
+            line_no, line = item
             line = line.strip()
             if not line:
                 continue
@@ -164,10 +306,14 @@ async def _amain(args: argparse.Namespace) -> int:
                 assert isinstance(msg, dict)
             # noqa: FLX006 — not a retry loop: lines are independent client
             # requests, and one malformed line must never kill the replica
-            except Exception:  # noqa: FLX006
+            except Exception as exc:  # noqa: FLX006
+                from .. import telemetry
+
+                telemetry.record_serve_error(exc, what=f"malformed line {line_no}")
                 _emit(
                     {
                         "id": f"line-{line_no}", "ok": False, "error": "protocol",
+                        "code": "protocol",
                         "message": f"malformed JSON on line {line_no}",
                     }
                 )
@@ -208,11 +354,15 @@ async def _amain(args: argparse.Namespace) -> int:
                     await asyncio.gather(*pending, return_exceptions=True)
                 await dispatcher.close()
                 _emit({"op": "drain", "ok": True})
+            elif op == "shutdown":
+                _begin_drain("shutdown-op")
+                break
             elif op is not None:
                 _emit(
                     {
                         "id": msg.get("id", f"line-{line_no}"), "ok": False,
-                        "error": "protocol", "message": f"unknown op {op!r}",
+                        "error": "protocol", "code": "protocol",
+                        "message": f"unknown op {op!r}",
                     }
                 )
             else:
@@ -220,9 +370,15 @@ async def _amain(args: argparse.Namespace) -> int:
                 pending.add(task)
                 task.add_done_callback(pending.discard)
     finally:
-        if pending:
-            await asyncio.gather(*pending, return_exceptions=True)
-        await dispatcher.close()
+        drainer.cancel()
+        if drain_state:
+            await _drain_and_exit(dispatcher, pending, drain_state["source"])
+        else:
+            # EOF: the scripted-run path — finish everything, unbounded,
+            # exactly as before the drain machinery existed
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+            await dispatcher.close()
         if stream is not sys.stdin:
             stream.close()
     return 0
@@ -259,11 +415,14 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     from .. import profiling, telemetry
 
-    # SIGTERM/SIGUSR2 leave a flight-recorder dump (no-op unless telemetry
-    # + FLOX_TPU_FLIGHT_RECORDER_PATH are configured); SIGUSR1 starts an
+    # SIGUSR2 leaves a flight-recorder dump (no-op unless telemetry +
+    # FLOX_TPU_FLIGHT_RECORDER_PATH are configured); SIGUSR1 starts an
     # on-demand on-chip capture into OPTIONS["profile_dir"]. Both must be
-    # installed on the main thread, before the loop starts
-    telemetry.install_signal_dumps()
+    # installed on the main thread, before the loop starts. SIGTERM is
+    # deliberately NOT taken here (sigterm=False): the serve loop registers
+    # its own handler for the graceful drain — finish in-flight requests,
+    # flight-dump, exit 0 — instead of the dump-and-die-143 default.
+    telemetry.install_signal_dumps(sigterm=False)
     profiling.install_capture_signal()
     try:
         return asyncio.run(_amain(args))

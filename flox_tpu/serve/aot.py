@@ -100,7 +100,8 @@ def configure(path: Any = None) -> Path | None:
             # everything; the dir is bounded by what the replica serves.
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        except Exception:  # noqa: BLE001 — version drift must not break serving
+        except Exception as exc:  # noqa: BLE001 — version drift must not break serving
+            telemetry.record_serve_error(exc, what="aot.configure")
             logger.warning(
                 "persistent compilation cache unavailable (jax too old?); "
                 "AOT warmup will re-trace but restarts pay full compiles"
@@ -124,8 +125,8 @@ def deconfigure() -> None:
             import jax
 
             jax.config.update("jax_compilation_cache_dir", None)
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:  # noqa: BLE001
+            telemetry.record_serve_error(exc, what="aot.deconfigure")
         _STATE["configured"] = None
 
 
@@ -225,6 +226,7 @@ def _load_into_memo(path: Any = None) -> None:
         entries = payload["programs"]
         assert isinstance(entries, dict)
     except Exception as exc:  # noqa: BLE001 — fall back to what we have
+        telemetry.record_serve_error(exc, what="aot.load-manifest")
         logger.warning("ignoring unreadable AOT manifest %s: %s", mpath, exc)
         return
     with _LOCK:
@@ -320,6 +322,7 @@ def warmup(path: Any = None) -> int:
             # noqa: FLX006 — not a retry loop: specs are independent, and a
             # bad one must be skipped (warmup can never take serving down)
             except Exception as exc:  # noqa: FLX006
+                telemetry.record_serve_error(exc, what="aot.warmup-spec")
                 logger.warning("AOT warmup skipped %s: %s", spec.get("func"), exc)
         telemetry.count("serve.aot_warmed", warmed)
         # warmup just materialized every program the replica will serve:

@@ -49,6 +49,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import itertools
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -59,31 +60,100 @@ import numpy as np
 # never from-bound: test_resilience importlib.reload()s flox_tpu.options,
 # and a from-import here would keep reading the pre-reload dict while
 # set_options writes to the post-reload one
-from .. import options, telemetry
+from .. import options, resilience, telemetry
 from ..telemetry import METRICS
+from . import breaker
 
 __all__ = [
     "AggregationRequest",
+    "CircuitOpenError",
     "DeadlineExceededError",
+    "DeviceLostError",
     "Dispatcher",
+    "DrainingError",
     "LoadShedError",
     "ServeError",
     "ServeResult",
+    "WatchdogTimeoutError",
+    "payload_digest",
 ]
 
 
 class ServeError(RuntimeError):
-    """Base class for serving-layer request failures."""
+    """Base class for serving-layer request failures.
+
+    Every subclass carries a machine-readable :attr:`code` (stable across
+    renames — JSON clients branch on it instead of string-matching the
+    Python class name) and an optional :attr:`retry_after_ms` hint for
+    load-control failures where retrying is the right move. Both ride the
+    JSON-lines protocol on error responses."""
+
+    #: stable machine-readable identity of the failure kind
+    code = "serve_error"
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        retry_after_ms: float | None = None,
+        program: str | None = None,
+    ) -> None:
+        super().__init__(message)
+        #: when retrying makes sense, the soonest it plausibly helps (ms)
+        self.retry_after_ms = retry_after_ms
+        #: the program label the failure is scoped to, where one applies
+        self.program = program
 
 
 class LoadShedError(ServeError):
     """The dispatcher is saturated (``serve_queue_depth`` reached); the
     request was rejected WITHOUT queueing — retry with backoff."""
 
+    code = "load_shed"
+
 
 class DeadlineExceededError(ServeError):
     """The request's deadline passed before its result was ready; if it was
     still queued, it will never be dispatched."""
+
+    code = "deadline_exceeded"
+
+
+class CircuitOpenError(ServeError):
+    """This request's program key has an OPEN circuit breaker — recent
+    requests for the same compiled program failed fatally
+    ``serve_breaker_threshold`` times in a row, so the dispatcher fails
+    fast instead of burning another dispatch. Carries the program label and
+    the cooldown remaining (``retry_after_ms``); after the cooldown one
+    probe request is admitted, and its success closes the breaker."""
+
+    code = "circuit_open"
+
+
+class DeviceLostError(ServeError):
+    """The accelerator (or its backend runtime) died under this request's
+    dispatch. In-flight waiters get this typed error while the replica
+    recovers: readiness flips 503, the backend reinitializes, the AOT
+    warmup manifest replays, and readiness returns — retry against the
+    fleet (or this replica once ``/readyz`` answers 200 again)."""
+
+    code = "device_lost"
+
+
+class WatchdogTimeoutError(ServeError):
+    """The device dispatch ran past ``serve_watchdog_timeout``: its waiters
+    are failed (the queue must not hang behind a wedged program) and a
+    flight dump + on-chip-capture hint are left for the operator."""
+
+    code = "watchdog_timeout"
+
+
+class DrainingError(ServeError):
+    """The replica is draining (SIGTERM / ``{"op": "shutdown"}``):
+    admission is closed, in-flight requests are finishing. Retry against
+    another replica — this process is about to exit."""
+
+    code = "draining"
 
 
 @dataclass
@@ -227,6 +297,13 @@ def _array_digest(arr: np.ndarray) -> str:
     return _digest_bytes(str(arr.dtype).encode(), repr(arr.shape).encode(), arr.tobytes())
 
 
+def payload_digest(array: Any) -> str:
+    """The payload half of a request's coalescing identity — public so the
+    chaos harness (``faults.serve_inject(poison_digests=...)``) can target
+    one micro-batch member by the exact digest the dispatcher will see."""
+    return _array_digest(np.asarray(array))
+
+
 #: payloads up to this many bytes hash inline on the event-loop thread (a
 #: thread hop costs more than the hash there); bigger ones go off-loop
 _INLINE_DIGEST_BYTES = 1 << 16
@@ -309,6 +386,20 @@ class Dispatcher:
         self.microbatch_max_elems = microbatch_max_elems
         self.batch_window = batch_window
         self._tasks: set[asyncio.Task] = set()
+        self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        """Whether admission is closed (:meth:`begin_drain` was called)."""
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Close admission: every later :meth:`submit` fails fast with
+        :class:`DrainingError`. In-flight requests are unaffected — the
+        serve loop awaits them (bounded by ``serve_drain_timeout``) via
+        :meth:`close` before exiting."""
+        self._draining = True
+        METRICS.inc("serve.drains")
 
     def _knob(self, explicit: Any, name: str) -> Any:
         return explicit if explicit is not None else options.OPTIONS[name]
@@ -327,12 +418,22 @@ class Dispatcher:
             request = AggregationRequest(**kwargs)
         t0 = time.perf_counter()
         METRICS.inc("serve.requests")
+        if self._draining:
+            METRICS.inc("serve.drain_rejected")
+            raise DrainingError(
+                "replica draining: admission closed, in-flight requests "
+                "finishing; retry against another replica"
+            )
         depth = self._knob(self.queue_depth, "serve_queue_depth")
         if depth and len(_PENDING_REGISTRY) >= depth:
             METRICS.inc("serve.shed")
+            window = float(self._knob(self.batch_window, "serve_batch_window"))
             raise LoadShedError(
                 f"dispatcher saturated: {len(_PENDING_REGISTRY)} requests pending "
-                f"(serve_queue_depth={depth}); retry with backoff"
+                f"(serve_queue_depth={depth}); retry with backoff",
+                # the soonest a queue slot plausibly frees: one batch window
+                # (the granularity at which pending batches dispatch)
+                retry_after_ms=max(1.0, window * 1e3),
             )
         rid = next(_IDS)
         _PENDING_REGISTRY[rid] = request
@@ -391,6 +492,10 @@ class Dispatcher:
         # bad option name/value fails HERE, not inside a worker thread)
         with options.scoped(**overrides):
             pkey = _program_key(request.func, arr, by_digest, agg_kwargs, overrides)
+        # circuit-breaker gate: a program key whose recent dispatches all
+        # failed fatally fast-fails HERE (typed CircuitOpenError with the
+        # cooldown remaining) — no queue slot, no batch, no device time
+        breaker.check(pkey, _func_label(request.func))
         payload_key = (pkey, arr_digest)
         deadline = request.deadline
         if deadline is None:
@@ -541,25 +646,211 @@ class Dispatcher:
                 _COALESCE_CACHE.pop(leaf.payload_key, None)
         if not live:
             METRICS.inc("serve.batches_abandoned")
+            # an abandoned batch resolves nothing: if it carried the
+            # breaker's half-open probe, re-arm the probe slot
+            breaker.release_probe(batch.pkey)
             return
         try:
-            results = await asyncio.to_thread(self._execute, batch, live)
-        except BaseException as exc:  # noqa: BLE001 — fan the failure out
-            METRICS.inc("serve.errors")
+            results = await self._dispatch(batch, live)
+        except asyncio.CancelledError:
+            # a cancelled batch task (drain budget expiry) must propagate,
+            # never be classified: cancel still-waiting futures so no
+            # waiter hangs, re-dispatch nothing, pollute no breaker
             for leaf in live:
+                _COALESCE_CACHE.pop(leaf.payload_key, None)
                 if not leaf.future.done():
-                    leaf.future.set_exception(exc)
-                    # mark retrieved: if every waiter timed out meanwhile,
-                    # an unretrieved exception would warn at GC
-                    leaf.future.exception()
+                    leaf.future.cancel()
+            breaker.release_probe(batch.pkey)
+            raise
+        except BaseException as exc:  # noqa: BLE001 — classified + fanned out
+            # the serve-plane fault domain: classify first (the same gate
+            # the streaming path consults), then contain the blast radius —
+            # device loss triggers backend recovery, a fatal/oom failure of
+            # a multi-leaf batch bisects so healthy peers still get
+            # results, a single poisoned leaf fails alone (and feeds its
+            # program's circuit breaker)
+            for leaf in live:
+                _COALESCE_CACHE.pop(leaf.payload_key, None)
+            await self._contain_failure(
+                batch, live, exc, resilience.classify_error(exc)
+            )
             return
         finally:
             for leaf in live:
                 _COALESCE_CACHE.pop(leaf.payload_key, None)
+        breaker.record_success(batch.pkey)
         rows, groups = results
         for leaf, row in zip(live, rows):
             if not leaf.future.done():
                 leaf.future.set_result((row, groups))
+
+    # -- fault domain -------------------------------------------------------
+
+    async def _dispatch(self, batch: _Batch, live: list[_Leaf]) -> tuple:
+        """One watchdog-guarded device dispatch for ``live``'s leaves.
+
+        ``serve_watchdog_timeout`` bounds the worker-thread execution: a
+        dispatch stuck past it fails its waiters with a typed
+        :class:`WatchdogTimeoutError` (flight dump + capture hint recorded)
+        instead of wedging the queue behind one hung program. The stuck
+        thread itself cannot be killed — its eventual result is discarded
+        — but every queue decision stops waiting on it."""
+        watchdog = float(options.OPTIONS["serve_watchdog_timeout"] or 0)
+        if not watchdog:
+            return await asyncio.to_thread(self._execute, batch, live)
+        try:
+            return await asyncio.wait_for(
+                asyncio.to_thread(self._execute, batch, live), watchdog
+            )
+        except (asyncio.TimeoutError, TimeoutError):
+            label = _func_label(batch.func)
+            METRICS.inc("serve.watchdog_fired")
+            telemetry.event(
+                "watchdog",
+                program=label,
+                timeout_s=watchdog,
+                hint="dispatch wedged: grab an on-chip capture "
+                "(/debug/profile?seconds=N or SIGUSR1) while it hangs",
+            )
+            telemetry.flight_dump(reason=f"watchdog:{label}")
+            raise WatchdogTimeoutError(
+                f"dispatch for program {label!r} exceeded "
+                f"serve_watchdog_timeout={watchdog:g}s; its waiters were "
+                "failed so the queue keeps moving",
+                program=label,
+            ) from None
+
+    async def _contain_failure(
+        self, batch: _Batch, live: list[_Leaf], exc: BaseException, cls: str
+    ) -> None:
+        """Route one classified dispatch failure down its containment path."""
+        if isinstance(exc, WatchdogTimeoutError):
+            # a hang is not bisectable — re-dispatching sub-batches would
+            # hang serially through N more watchdog windows. Fail the whole
+            # batch and count it against the program's breaker.
+            breaker.record_failure(batch.pkey, _func_label(batch.func))
+            self._fail_leaves(live, exc)
+            return
+        if cls == resilience.DEVICE_LOST:
+            await self._handle_device_loss(batch, live, exc)
+            return
+        if len(live) > 1 and cls in (resilience.FATAL, resilience.OOM):
+            # request quarantine: one poisoned member must not take its
+            # coalesced/micro-batched peers down with it
+            await self._quarantine(batch, live, exc)
+            return
+        if cls == resilience.FATAL:
+            breaker.record_failure(batch.pkey, _func_label(batch.func))
+        else:
+            # transient/oom outcomes carry no breaker verdict — a pending
+            # half-open probe must be re-armed, not leaked
+            breaker.release_probe(batch.pkey)
+        self._fail_leaves(live, exc)
+
+    async def _quarantine(
+        self, batch: _Batch, live: list[_Leaf], cause: BaseException
+    ) -> None:
+        """Bisect a failed multi-leaf dispatch so only the poisoned member
+        fails.
+
+        The split rides the same power-of-two ladder as
+        ``resilience.dispatch_slab`` (half the span, rounded up to a power
+        of two), so the re-dispatched sub-batch shapes form a small
+        reusable set — each rung's stacked program compiles once. Healthy
+        sub-batches produce rows bit-identical to solo runs (the PR 7
+        micro-batching invariant); a failing sub-batch recurses until the
+        poisoned leaf dispatches alone and gets the typed error, which also
+        feeds its program's circuit breaker."""
+        METRICS.inc("serve.quarantine_splits")
+        telemetry.event(
+            "quarantine-split",
+            program=_func_label(batch.func),
+            leaves=len(live),
+            error=type(cause).__name__,
+        )
+        half = resilience._ladder_half(len(live), 1)
+        for lo in range(0, len(live), half):
+            sub = live[lo : lo + half]
+            try:
+                results = await self._dispatch(batch, sub)
+            except asyncio.CancelledError:
+                for leaf in sub:
+                    if not leaf.future.done():
+                        leaf.future.cancel()
+                breaker.release_probe(batch.pkey)
+                raise
+            except BaseException as sub_exc:  # noqa: BLE001 — classified below
+                if isinstance(sub_exc, WatchdogTimeoutError):
+                    breaker.record_failure(batch.pkey, _func_label(batch.func))
+                    self._fail_leaves(sub, sub_exc)
+                    continue
+                cls = resilience.classify_error(sub_exc)
+                if cls == resilience.DEVICE_LOST:
+                    await self._handle_device_loss(batch, sub, sub_exc)
+                    continue
+                if len(sub) > 1 and cls in (resilience.FATAL, resilience.OOM):
+                    await self._quarantine(batch, sub, sub_exc)
+                    continue
+                # a single leaf failing alone IS the poisoned member
+                METRICS.inc("serve.quarantined")
+                telemetry.event(
+                    "quarantined",
+                    program=_func_label(batch.func),
+                    error=type(sub_exc).__name__,
+                )
+                if cls == resilience.FATAL:
+                    breaker.record_failure(batch.pkey, _func_label(batch.func))
+                else:
+                    breaker.release_probe(batch.pkey)
+                self._fail_leaves(sub, sub_exc)
+                continue
+            breaker.record_success(batch.pkey)
+            rows, groups = results
+            for leaf, row in zip(sub, rows):
+                if not leaf.future.done():
+                    leaf.future.set_result((row, groups))
+
+    async def _handle_device_loss(
+        self, batch: _Batch, live: list[_Leaf], exc: BaseException
+    ) -> None:
+        """The dispatch died WITH the device: quarantine its waiters behind
+        a typed error, flip readiness, and recover the backend.
+
+        Recovery (reinitialize the backend, replay the AOT warmup manifest,
+        flip readiness back) runs in a worker thread under a process-wide
+        guard — concurrent batches discovering the same dead device fail
+        their own waiters but only one recovery cycle runs."""
+        from .. import exposition
+
+        METRICS.inc("serve.device_lost")
+        # device loss is not a program-key verdict: never counted toward
+        # the breaker, but a pending half-open probe must be re-armed
+        breaker.release_probe(batch.pkey)
+        telemetry.event(
+            "device-lost", program=_func_label(batch.func), error=str(exc)[:200]
+        )
+        telemetry.flight_dump(reason="device-lost")
+        exposition.set_ready(False, reason="device-lost")
+        self._fail_leaves(
+            live,
+            DeviceLostError(
+                f"device lost under dispatch for program "
+                f"{_func_label(batch.func)!r}; replica recovering "
+                f"(/readyz 503 until the backend is back): {exc}",
+                program=_func_label(batch.func),
+            ),
+        )
+        await asyncio.to_thread(_recover_device)
+
+    def _fail_leaves(self, leaves: list[_Leaf], exc: BaseException) -> None:
+        """Fan one failure out to every waiter of ``leaves``."""
+        METRICS.inc("serve.errors")
+        for leaf in leaves:
+            if not leaf.future.done():
+                leaf.future.set_exception(exc)
+                # mark retrieved: if every waiter timed out meanwhile,
+                # an unretrieved exception would warn at GC
+                leaf.future.exception()
 
     def _execute(self, batch: _Batch, live: list[_Leaf]) -> tuple[list, np.ndarray]:
         """One device dispatch for every live leaf of ``batch`` (worker
@@ -572,6 +863,15 @@ class Dispatcher:
         # (or retrieved) — idempotent no-op when serve_aot_dir is unset
         aot.configure()
         METRICS.inc("serve.dispatches")
+        from .. import faults
+
+        # chaos hook: the serve fault plan (faults.serve_inject) fires here,
+        # exactly where a real compile/dispatch failure would — one is None
+        # check when no plan is installed
+        faults.serve_poke(
+            _func_label(batch.func),
+            tuple(leaf.payload_key[1] for leaf in live),
+        )
         # captured ONCE: a set_options(telemetry=True) landing mid-dispatch
         # must not make the post-dispatch block read baselines that were
         # never taken (same discipline as core.chunk_reduce)
@@ -665,3 +965,45 @@ class Dispatcher:
         delivered to their waiters as usual)."""
         while self._tasks:
             await asyncio.gather(*list(self._tasks), return_exceptions=True)
+
+
+#: one recovery cycle at a time: concurrent batches discovering the same
+#: dead device each fail their own waiters, but reinit + warmup + ready
+#: must not run twice in parallel (the second cycle would re-tear-down the
+#: backend the first just rebuilt)
+_RECOVERY_GUARD = threading.Lock()
+
+
+def _recover_device() -> None:
+    """The device-loss recovery cycle (worker thread): reinitialize the
+    backend, replay the AOT warmup manifest so the rebuilt backend holds
+    live programs again (zero NEW compiles against a warm AOT dir), then
+    flip ``/readyz`` back to 200. Failures leave readiness at 503 — a
+    replica that could not recover must not take traffic."""
+    from .. import device, exposition
+
+    if not _RECOVERY_GUARD.acquire(blocking=False):
+        return  # a recovery is already running; it owns the ready flip
+    try:
+        telemetry.event("device-recovery-start")
+        torn_down = device.reinitialize()
+        from . import aot
+
+        warmed = aot.warmup()
+        # flip ready back ONLY if the 503 is still ours: a graceful drain
+        # that began mid-recovery set reason "draining", and that 503 must
+        # hold until the process exits — a recovered-but-draining replica
+        # answering 200 would pull router traffic straight into
+        # DrainingError
+        if exposition.ready_reason() == "device-lost":
+            exposition.set_ready(True)
+        METRICS.inc("serve.recoveries")
+        telemetry.event(
+            "device-recovery-done", reinitialized=torn_down, warmed=warmed
+        )
+    except Exception as exc:  # noqa: BLE001 — an unrecoverable replica stays
+        # unready (503) rather than crashing the loop; the record is the
+        # operator's signal to replace it
+        telemetry.record_serve_error(exc, what="device-recovery")
+    finally:
+        _RECOVERY_GUARD.release()

@@ -109,6 +109,7 @@ __all__ = [
     "install_signal_dumps",
     "observe_cost",
     "profile_call",
+    "record_serve_error",
     "record_span",
     "reset",
     "sample_hbm",
@@ -549,6 +550,24 @@ def event(name: str, **attrs: Any) -> None:
     )
 
 
+def record_serve_error(exc: BaseException, what: str = "") -> None:
+    """Record one serve-plane exception into the flight ring + counters.
+
+    The sanctioned tail of a broad ``except`` in ``flox_tpu/serve/`` that
+    answers the error instead of re-raising it (floxlint FLX012): the
+    handler must either consult ``resilience.classify_error`` or leave a
+    flight-recorder trace through this — a swallowed serve error must never
+    be invisible to the crash forensics. No-op when telemetry is off; never
+    raises (the handler's own answer must not be masked)."""
+    if not enabled():
+        return
+    try:
+        METRICS.inc("serve.swallowed_errors")
+        event("serve-error", what=what, error=type(exc).__name__, detail=str(exc)[:200])
+    except Exception:  # noqa: BLE001 — forensics never break the answer path
+        pass
+
+
 def current_set(**attrs: Any) -> None:
     """Attach attributes to the innermost live span, if any."""
     sp = _CURRENT.get() if enabled() else None
@@ -755,13 +774,15 @@ def flight_dump(path: Any = None, reason: str = "") -> str | None:
         return None
 
 
-def install_signal_dumps() -> None:
+def install_signal_dumps(sigterm: bool = True) -> None:
     """Dump the flight recorder on SIGTERM (then die with the default
     disposition, so exit codes stay honest) and on SIGUSR2 (dump and keep
     running — the operator's "what are you doing right now" poke). Only
-    callable from the main thread; the serve loop and the standalone
-    metrics endpoint install this at startup. No-op on platforms missing
-    the signals."""
+    callable from the main thread; the standalone metrics endpoint installs
+    this at startup. The serve loop passes ``sigterm=False`` and owns
+    SIGTERM itself: there it triggers the graceful drain (finish in-flight
+    requests, flight-dump, exit 0) instead of dying 143 mid-request. No-op
+    on platforms missing the signals."""
     import signal
 
     def _dump(signum: int, frame: Any) -> None:
@@ -775,7 +796,8 @@ def install_signal_dumps() -> None:
             signal.signal(signal.SIGTERM, signal.SIG_DFL)
             os.kill(os.getpid(), signal.SIGTERM)
 
-    for signame in ("SIGTERM", "SIGUSR2"):
+    names = ("SIGTERM", "SIGUSR2") if sigterm else ("SIGUSR2",)
+    for signame in names:
         signum = getattr(signal, signame, None)
         if signum is None:
             continue
@@ -969,6 +991,7 @@ def hbm_by_program() -> dict[str, float]:
 SATURATION_GAUGES: tuple[str, ...] = (
     "serve.queue_depth",
     "serve.inflight_batches",
+    "serve.breakers_open",
     "stream.prefetch_occupancy",
 )
 
@@ -1005,6 +1028,12 @@ def sample_saturation() -> None:
         METRICS.set_gauge("serve.queue_depth", len(_PENDING_REGISTRY))
         METRICS.set_gauge("serve.inflight_batches", len(_BATCH_REGISTRY))
     except Exception:  # noqa: BLE001 — sampling must never take serving down
+        pass
+    try:
+        from .serve.breaker import open_breakers
+
+        METRICS.set_gauge("serve.breakers_open", len(open_breakers()))
+    except Exception:  # noqa: BLE001
         pass
     try:
         from .pipeline import prefetch_occupancy

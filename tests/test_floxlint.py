@@ -605,6 +605,51 @@ def test_options_drift_reintroduction_fails(tmp_path):
     assert any("_VALIDATORS" in m for m in messages)
 
 
+def test_flx012_serve_fixture():
+    # FLX012 scopes to files under a `serve` path component: the fixture
+    # package mirrors flox_tpu/serve and pins both the violations and the
+    # sanctioned shapes (re-raise / classify / record / specific types)
+    fixture = FIXTURES / "flx012_pkg" / "serve" / "handlers.py"
+    expected = expected_findings(fixture)
+    assert expected  # the fixture seeds at least one violation
+    assert actual_findings([fixture]) == expected
+
+
+def test_flx012_unforensic_serve_except_fails(tmp_path):
+    # ISSUE 12 satellite: a serve-plane handler that answers the error but
+    # neither classifies it nor leaves a flight trace must fail the lint —
+    # a replica quietly eating device-loss errors looks healthy until the
+    # fleet is not. Outside a serve/ directory the same shape is FLX012-free
+    # (FLX006 still polices retry loops everywhere).
+    serve_dir = tmp_path / "serve"
+    serve_dir.mkdir()
+    bad = serve_dir / "regress_swallow.py"
+    src = (
+        "def answer_request(emit, work):\n"
+        "    try:\n"
+        "        return work()\n"
+        "    except Exception as exc:\n"
+        "        emit({'ok': False, 'error': type(exc).__name__})\n"
+    )
+    bad.write_text(src)
+    assert any(f.rule == "FLX012" for f in lint_file(bad))
+    outside = tmp_path / "regress_swallow_outside.py"
+    outside.write_text(src)
+    assert not [f for f in lint_file(outside) if f.rule == "FLX012"]
+    # the sanctioned shape: record to the flight ring, then answer
+    good = serve_dir / "clean_records.py"
+    good.write_text(
+        "from flox_tpu import telemetry\n\n"
+        "def answer_request(emit, work):\n"
+        "    try:\n"
+        "        return work()\n"
+        "    except Exception as exc:\n"
+        "        telemetry.record_serve_error(exc, what='request')\n"
+        "        emit({'ok': False, 'error': type(exc).__name__})\n"
+    )
+    assert not [f for f in lint_file(good) if f.rule == "FLX012"]
+
+
 def test_helper_host_sync_reintroduction_fails(tmp_path):
     bad = tmp_path / "regress_helper_sync.py"
     bad.write_text(

@@ -123,6 +123,135 @@ class TestClassifier:
         with pytest.raises(TypeError):
             register_transient("not a type")
 
+    def test_device_loss_family(self):
+        from flox_tpu.resilience import DEVICE_LOST
+
+        assert classify_error(faults.SimulatedDeviceLoss("chip 0")) == DEVICE_LOST
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        assert classify_error(
+            XlaRuntimeError("INTERNAL: device lost (it crashed)")
+        ) == DEVICE_LOST
+        assert classify_error(XlaRuntimeError("DEVICE_LOST: gone")) == DEVICE_LOST
+
+
+class TestClassifierWrappedChains:
+    """ISSUE 12 satellite: a transient/oom/device-loss error wrapped in a
+    generic RuntimeError (asyncio.to_thread plumbing, loader-SDK
+    ``raise ... from exc``) must not be misclassified fatal — the chain is
+    walked when the outer verdict is fatal, and ONLY then (an explicitly
+    transient outer error never consults its context)."""
+
+    def test_cause_chain_unwraps_transient(self):
+        outer = RuntimeError("loader wrapper")
+        outer.__cause__ = IOError("read failed")
+        assert classify_error(outer) == TRANSIENT
+
+    def test_context_chain_unwraps_transient(self):
+        try:
+            try:
+                raise IOError("flaky read")
+            except IOError:
+                raise ValueError("raised while handling")  # noqa: B904
+        except ValueError as exc:
+            assert exc.__context__ is not None
+            assert classify_error(exc) == TRANSIENT
+
+    def test_cause_chain_unwraps_oom_and_device_loss(self):
+        from flox_tpu.resilience import DEVICE_LOST
+
+        outer = RuntimeError("wrapper")
+        outer.__cause__ = MemoryError()
+        assert classify_error(outer) == OOM
+        outer = KeyError("wrapper")
+        outer.__cause__ = faults.SimulatedDeviceLoss("chip")
+        assert classify_error(outer) == DEVICE_LOST
+
+    def test_nested_two_level_chain(self):
+        inner = OSError("socket reset")
+        mid = RuntimeError("mid wrapper")
+        mid.__cause__ = inner
+        outer = RuntimeError("outer wrapper")
+        outer.__cause__ = mid
+        assert classify_error(outer) == TRANSIENT
+
+    def test_plain_fatal_stays_fatal(self):
+        outer = RuntimeError("genuine bug")
+        outer.__cause__ = TypeError("still a bug")
+        assert classify_error(outer) == FATAL
+
+    def test_self_referential_chain_terminates(self):
+        exc = RuntimeError("cyclic")
+        exc.__context__ = exc
+        assert classify_error(exc) == FATAL
+
+    def test_transient_outer_never_consults_chain(self):
+        # an explicitly transient classification is already the verdict;
+        # a fatal link underneath must not harden it
+        outer = IOError("transient outer")
+        outer.__cause__ = TypeError("fatal inner")
+        assert classify_error(outer) == TRANSIENT
+
+    def test_to_thread_propagated_exception_keeps_class(self):
+        import asyncio
+
+        async def main():
+            def boom():
+                raise IOError("raised inside to_thread")
+
+            try:
+                await asyncio.to_thread(boom)
+            except Exception as exc:  # noqa: BLE001 — classifying is the test
+                return classify_error(exc)
+
+        assert asyncio.run(main()) == TRANSIENT
+
+
+class TestBackoffJitter:
+    """ISSUE 12 satellite: full jitter on the exponential backoff, so
+    prefetch workers hitting the same transient fault do not retry in
+    lockstep — seedable for deterministic chaos runs."""
+
+    def test_full_jitter_spreads_within_cap(self):
+        from flox_tpu.resilience import RetryPolicy, seed_backoff
+
+        seed_backoff(7)
+        policy = RetryPolicy(backoff=0.1)
+        delays = [policy.delay(2) for _ in range(64)]
+        cap = 0.1 * 4
+        assert all(0 < d <= cap for d in delays)
+        # genuinely jittered: not all equal, and spread across the window
+        assert len({round(d, 9) for d in delays}) > 8
+        assert min(delays) < cap / 4 and max(delays) > cap / 2
+
+    def test_seeded_schedule_is_reproducible(self):
+        from flox_tpu.resilience import RetryPolicy, seed_backoff
+
+        policy = RetryPolicy(backoff=0.05)
+        seed_backoff(123)
+        first = [policy.delay(a) for a in range(6)]
+        seed_backoff(123)
+        assert [policy.delay(a) for a in range(6)] == first
+
+    def test_zero_backoff_stays_zero(self):
+        from flox_tpu.resilience import RetryPolicy
+
+        assert RetryPolicy(backoff=0.0).delay(3) == 0.0
+
+    def test_jittered_retries_stay_bit_identical(self, data):
+        # the jitter changes WHEN retries fire, never WHAT they compute
+        from flox_tpu.resilience import seed_backoff
+
+        vals, labels = data
+        base, _ = streaming_groupby_reduce(vals, labels, func="nanmean", batch_len=700)
+        seed_backoff(99)
+        flaky = faults.FlakyLoader(lambda s, e: vals[:, s:e], {700: IOError}, times=2)
+        with flox_tpu.set_options(stream_backoff=0.001):
+            got, _ = streaming_groupby_reduce(
+                flaky, labels, func="nanmean", batch_len=700
+            )
+        assert _bits(got) == _bits(base)
+        assert flaky.loads_of(700) == 3
+
 
 # ---------------------------------------------------------------------------
 # retry with backoff + per-slab deadline

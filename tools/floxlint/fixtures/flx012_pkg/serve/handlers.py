@@ -1,0 +1,68 @@
+"""Seeded FLX012 violations: unforensic broad excepts in a serve-plane
+module (this file lives under a ``serve`` path component, which is the
+rule's scope). Violating lines carry the corpus's trailing expect-marker;
+the clean shapes below pin the negative space (re-raise, classify, record,
+specific types)."""
+
+from flox_tpu import telemetry
+from flox_tpu.resilience import classify_error
+
+
+def swallows_silently(answer, work):
+    try:
+        return work()
+    except Exception:  # expect: FLX012
+        answer({"ok": False})
+
+
+def bare_except_swallows(answer, work):
+    try:
+        return work()
+    except:  # noqa: E722  # expect: FLX012
+        return None
+
+
+def tuple_catch_swallows(answer, work, log):
+    try:
+        return work()
+    except (ValueError, BaseException):  # expect: FLX012
+        log("oops")
+
+
+def clean_reraises(work):
+    try:
+        return work()
+    except Exception:
+        raise
+
+
+def clean_classifies(work):
+    try:
+        return work()
+    except Exception as exc:
+        if classify_error(exc) != "transient":
+            raise
+        return None
+
+
+def clean_records_to_flight(answer, work):
+    try:
+        return work()
+    except Exception as exc:
+        telemetry.record_serve_error(exc, what="fixture")
+        answer({"ok": False, "error": type(exc).__name__})
+
+
+def clean_dumps_flight(work):
+    try:
+        return work()
+    except Exception:
+        telemetry.flight_dump(reason="fixture")
+        return None
+
+
+def clean_specific_types(answer, work):
+    try:
+        return work()
+    except (ValueError, KeyError) as exc:  # naming types IS classifying
+        answer({"ok": False, "error": type(exc).__name__})

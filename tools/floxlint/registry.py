@@ -13,6 +13,7 @@ from .rules.flx008_cache_registry import CacheRegistryRule
 from .rules.flx009_donation import DonationAfterUseRule
 from .rules.flx010_options_drift import OptionsEnvDriftRule
 from .rules.flx011_helper_sync import HelperHostSyncRule
+from .rules.flx012_serve_except import ServeBroadExceptRule
 
 #: id -> rule instance, in id order
 RULES = {
@@ -29,6 +30,7 @@ RULES = {
         DonationAfterUseRule(),
         OptionsEnvDriftRule(),
         HelperHostSyncRule(),
+        ServeBroadExceptRule(),
     )
 }
 

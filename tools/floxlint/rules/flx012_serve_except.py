@@ -1,0 +1,99 @@
+"""FLX012 — unforensic broad except in the serve plane.
+
+The serve tier answers errors instead of crashing on them — a malformed
+line, a failed dispatch, or an unreadable manifest each gets a JSON
+response or a log line, and the loop keeps serving. That discipline has a
+failure mode of its own: a broad ``except Exception`` that swallows the
+error WITHOUT consulting the resilience classifier and WITHOUT leaving a
+flight-recorder trace makes the fault invisible — the serve chaos
+postmortem (``telemetry.flight_dump``) shows a healthy replica that was
+quietly eating device-loss errors for an hour. Every broad handler under
+``flox_tpu/serve/`` must therefore either
+
+* re-raise (``raise`` anywhere in the handler),
+* classify (``resilience.classify_error`` — the FLX006 gate), or
+* record (``telemetry.record_serve_error`` / ``telemetry.flight_dump`` —
+  the answer path's forensic tail).
+
+Handlers for specific exception types are always fine — naming the types
+IS a classification. Scope: files with a ``serve`` path component, i.e.
+the ``flox_tpu/serve/`` package (and the fixture corpus's ``serve`` dir).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import PurePath
+from typing import Iterator
+
+from ..core import FileContext, Finding
+
+#: calling any of these inside the handler satisfies the rule
+_SANCTIONED_CALLS = (
+    "classify_error",
+    "record_serve_error",
+    "flight_dump",
+)
+
+
+class ServeBroadExceptRule:
+    id = "FLX012"
+    name = "serve-unforensic-except"
+    description = (
+        "bare `except:`/`except Exception:` in flox_tpu/serve/ that neither "
+        "re-raises, consults resilience.classify_error, nor records to the "
+        "flight recorder (telemetry.record_serve_error / flight_dump)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "serve" not in PurePath(ctx.display_path).parts:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not _catches_everything(handler.type):
+                    continue
+                if _reraises_classifies_or_records(handler):
+                    continue
+                yield Finding(
+                    path=ctx.display_path,
+                    line=handler.lineno,
+                    col=handler.col_offset,
+                    rule="FLX012",
+                    message=(
+                        "broad except in the serve plane swallows the error "
+                        "invisibly; re-raise, consult "
+                        "resilience.classify_error, or leave a flight trace "
+                        "via telemetry.record_serve_error / flight_dump"
+                    ),
+                )
+
+
+def _catches_everything(expr: ast.expr | None) -> bool:
+    if expr is None:  # bare `except:`
+        return True
+    elts = expr.elts if isinstance(expr, ast.Tuple) else [expr]
+    for el in elts:
+        name = None
+        if isinstance(el, ast.Name):
+            name = el.id
+        elif isinstance(el, ast.Attribute):
+            name = el.attr
+        if name in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _reraises_classifies_or_records(handler: ast.ExceptHandler) -> bool:
+    for sub in ast.walk(handler):
+        if isinstance(sub, ast.Raise):
+            return True
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None
+            )
+            if name in _SANCTIONED_CALLS:
+                return True
+    return False
