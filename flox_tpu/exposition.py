@@ -59,6 +59,19 @@ __all__ = [
 
 _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
+
+def _top_rows(table: dict, top: int) -> dict:
+    """The ``top`` most expensive ledger rows, ranked by device time then
+    dispatch count — the same order the costs CLI prints."""
+    ranked = sorted(
+        table.items(),
+        key=lambda kv: (
+            -float(kv[1].get("device_ms", 0.0)),
+            -int(kv[1].get("dispatches", 0)),
+        ),
+    )
+    return dict(ranked[:top])
+
 #: process-wide endpoint state: the live server (one per process — the
 #: registry it exposes is process-wide too) and the readiness flag
 _SERVER_STATE: dict[str, Any] = {"server": None, "ready": False, "reason": "warming"}
@@ -138,8 +151,24 @@ def prometheus_text(exemplars: bool = True) -> str:
     abort on the first one — so the HTTP handler serves them only when the
     scraper asks (``/metrics?exemplars=1``), keeping the default scrape
     spec-clean.
+
+    With ``OPTIONS["replica_id"]`` set, EVERY series additionally carries
+    ``replica="<id>",host="<short hostname>"`` labels (merged ahead of any
+    per-series ``|key=value`` labels) — the fleet-identity contract the
+    ``python -m flox_tpu.fleet`` federator keys its merge on. Unset (the
+    single-replica default), the output is byte-identical to before.
     """
-    from .telemetry import HIST_EDGES_MS, METRICS
+    from .telemetry import HIST_EDGES_MS, METRICS, host_name, replica_id
+
+    rid = replica_id()
+    identity = (
+        f'replica="{_escape_label(rid)}",host="{_escape_label(host_name())}"'
+        if rid is not None
+        else ""
+    )
+
+    def _merge(labels: str) -> str:
+        return ",".join(part for part in (identity, labels) if part)
 
     lines: list[str] = []
     typed: set[str] = set()
@@ -151,18 +180,21 @@ def prometheus_text(exemplars: bool = True) -> str:
 
     for name, value in sorted(METRICS.counters().items()):
         base, labels = _split_labels(name)
+        labels = _merge(labels)
         metric = _metric_name(base, "_total")
         _type_line(metric, "counter")
         label_str = f"{{{labels}}}" if labels else ""
         lines.append(f"{metric}{label_str} {_fmt(value)}")
     for name, value in sorted(METRICS.gauges().items()):
         base, labels = _split_labels(name)
+        labels = _merge(labels)
         metric = _metric_name(base)
         _type_line(metric, "gauge")
         label_str = f"{{{labels}}}" if labels else ""
         lines.append(f"{metric}{label_str} {_fmt(value)}")
     for name, hist in sorted(METRICS.histograms().items()):
         base, labels = _split_labels(name)
+        labels = _merge(labels)
         metric = _metric_name(base)
         _type_line(metric, "histogram")
         prefix = f"{labels}," if labels else ""
@@ -212,7 +244,7 @@ class _Handler(BaseHTTPRequestHandler):
                 body, status = ready_reason().encode() + b"\n", 503
             ctype = "text/plain; charset=utf-8"
         elif path == "/debug/costs":
-            body, status = self._costs()
+            body, status = self._costs(query)
             ctype = "application/json; charset=utf-8"
         elif path == "/debug/profile":
             body, status = self._profile(query)
@@ -226,16 +258,53 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     @staticmethod
-    def _costs() -> tuple[bytes, int]:
+    def _costs(query: str = "") -> tuple[bytes, int]:
         """The cost ledger as JSON — the machine-readable face of
         ``cache.stats()["cost_by_program"]`` (``python -m flox_tpu.telemetry
-        costs <scrape>`` tabulates exactly this payload)."""
+        costs <scrape>`` tabulates exactly this payload).
+
+        ``?tenant=<label>`` narrows the tenant axis to that (sanitized)
+        label; ``?top=K`` keeps only the K most expensive rows per axis,
+        ranked exactly as the costs CLI ranks them (device time, then
+        dispatches) — so a fleet scrape of 40 replicas does not have to
+        ship every cold row just to build a top-10 table. A malformed
+        ``top`` is a 400, never a silent full dump."""
         from . import telemetry
 
+        params = urllib.parse.parse_qs(query)
+        top_raw = params.get("top", [None])[0]
+        top: int | None = None
+        if top_raw is not None:
+            try:
+                top = int(top_raw)
+            except ValueError:
+                top = -1
+            if top < 1:
+                return (
+                    json.dumps(
+                        {"ok": False, "error": f"top must be a positive integer, got {top_raw!r}"}
+                    )
+                    + "\n"
+                ).encode(), 400
+        tenant = params.get("tenant", [None])[0]
+        programs = telemetry.cost_by_program()
+        tenants = telemetry.cost_by_tenant()
+        if tenant is not None:
+            # sanitize-only (register=False): a GET filter for a tenant
+            # nobody ever billed must not burn a cardinality slot
+            wanted = telemetry.tenant_label(tenant, register=False)
+            tenants = {k: v for k, v in tenants.items() if k == wanted}
+        if top is not None:
+            programs = _top_rows(programs, top)
+            tenants = _top_rows(tenants, top)
         payload = {
-            "cost_by_program": telemetry.cost_by_program(),
-            "cost_by_tenant": telemetry.cost_by_tenant(),
-            "hbm_by_program": telemetry.hbm_by_program(),
+            "cost_by_program": programs,
+            "cost_by_tenant": tenants,
+            "hbm_by_program": {
+                k: v for k, v in telemetry.hbm_by_program().items() if k in programs
+            },
+            "replica": telemetry.replica_instance(),
+            "host": telemetry.host_name(),
         }
         return (json.dumps(payload, default=str) + "\n").encode(), 200
 

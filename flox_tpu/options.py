@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import contextvars
 import os
+import re
 from typing import Any
 
 
@@ -57,6 +58,22 @@ def _env_choice(name: str, default: str, valid: tuple[str, ...]) -> str:
     same cannot-seed-what-set_options-refuses contract."""
     value = os.environ.get(name, default)
     return value if value in valid else default
+
+
+#: characters a replica id may carry: it becomes a Prometheus label value,
+#: a request-id prefix, and a trace-join track name, so label/quote/newline
+#: syntax must be unrepresentable rather than escaped at N call sites
+_REPLICA_ID_OK = re.compile(r"^[A-Za-z0-9_.:\-]{1,64}$")
+
+
+def _env_replica(name: str) -> str | None:
+    """Env-seeded replica id: a value the :data:`_VALIDATORS` entry would
+    reject (label-unsafe characters, overlong) falls back to ``None`` —
+    the cannot-seed-what-``set_options``-refuses contract again."""
+    value = os.environ.get(name) or None
+    if value is not None and _REPLICA_ID_OK.match(value) is None:
+        return None
+    return value
 
 
 #: the active option overlay: ``(values, pinned_names)`` installed by
@@ -327,6 +344,28 @@ OPTIONS: dict[str, Any] = {
     "metrics_sample_interval": _env_float(
         "FLOX_TPU_METRICS_SAMPLE_INTERVAL", 0.0, 0.0, 3600.0
     ),
+    # Fleet identity (flox_tpu/telemetry.py + fleet.py): this replica's
+    # stable name in a multi-replica deployment. When set, every /metrics
+    # series and /debug/costs payload carries replica="<id>" (plus the
+    # host), generated request ids are prefixed "<id>:" so they never
+    # collide across the fleet, and jsonl/flight exports are stamped with
+    # it for tools/trace_join.py. None (the default) keeps the
+    # single-replica surfaces byte-identical to PR 8/9.
+    "replica_id": _env_replica("FLOX_TPU_REPLICA_ID"),
+    # Fleet federation (flox_tpu/fleet.py): seconds between scrape rounds
+    # of the `python -m flox_tpu.fleet federate` aggregator (each round
+    # pulls every replica's /metrics + /debug/costs + /readyz)
+    "fleet_scrape_interval": _env_float(
+        "FLOX_TPU_FLEET_SCRAPE_INTERVAL", 2.0, 0.05, 3600.0, lo_open=False
+    ),
+    # TCP port the federator serves the merged view on (0 = ephemeral,
+    # printed at startup); `fleet federate --port` overrides
+    "fleet_port": _env_int("FLOX_TPU_FLEET_PORT", 0, 0, 65535),
+    # default replica set for the fleet CLIs: comma-separated base URLs
+    # ("http://127.0.0.1:8971,http://127.0.0.1:8972" — name=url pairs
+    # allowed: "a=http://...") consumed when `fleet federate` / `fleet
+    # top` get no --replicas flag. None requires the flag.
+    "fleet_replicas": os.environ.get("FLOX_TPU_FLEET_REPLICAS") or None,
 }
 
 # single source of truth for the accumulation disciplines — referenced by
@@ -407,6 +446,15 @@ _VALIDATORS = {
     ),
     "profile_keep": lambda x: _is_int(x) and 1 <= x <= 1024,
     "metrics_sample_interval": lambda x: _is_finite_num(x) and 0 <= x <= 3600,
+    # fleet knobs: same at-set-time discipline — an empty or label-unsafe
+    # replica id (it becomes a Prometheus label value and a request-id
+    # prefix) or a runaway scrape interval raises here, not at scrape time
+    "replica_id": lambda x: x is None or (
+        isinstance(x, str) and bool(x) and _REPLICA_ID_OK.match(x) is not None
+    ),
+    "fleet_scrape_interval": lambda x: _is_finite_num(x) and 0.05 <= x <= 3600,
+    "fleet_port": lambda x: _is_int(x) and 0 <= x <= 65535,
+    "fleet_replicas": lambda x: x is None or (isinstance(x, str) and bool(x)),
 }
 
 # rebind the literal through the overlay-aware view: same object contents,

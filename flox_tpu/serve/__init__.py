@@ -33,6 +33,14 @@ Per-request SLO metrics (``serve.queue_ms`` / ``serve.device_ms`` /
 ``serve.request_ms`` histograms, ``serve.*`` counters) flow through the
 process telemetry registry; serving state is visible in ``cache.stats()``
 and reset by ``cache.clear_all()``.
+
+The plane is fleet-ready (docs/serving.md "Running a fleet"): a request
+carrying a W3C ``traceparent`` runs under the propagated trace id with
+the remote parent span linked and echoes the same trace id back, replicas
+started with ``replica_id`` label every metric series and prefix their
+generated request ids fleet-uniquely, and ``python -m flox_tpu.fleet``
+federates N replicas' ``/metrics`` + ``/debug/costs`` + ``/readyz`` into
+one merged view (plus a live ops console).
 """
 
 from __future__ import annotations

@@ -71,7 +71,7 @@ _REQUEST_FIELDS = frozenset(
     {
         "func", "array", "by", "expected_groups", "fill_value", "dtype",
         "min_count", "engine", "finalize_kwargs", "options", "deadline",
-        "tenant",
+        "tenant", "traceparent",
     }
 )
 
@@ -146,18 +146,22 @@ async def _serve_request(dispatcher: Dispatcher, line_no: int, msg: dict) -> Non
             payload = {k: np.asarray(v).tolist() for k, v in result.result.items()}
         else:
             payload = np.asarray(result.result).tolist()
-        _emit(
-            {
-                "id": rid,
-                "ok": True,
-                "result": payload,
-                "groups": np.asarray(result.groups).tolist(),
-                "coalesced": result.coalesced,
-                "batch": result.batch_size,
-                "queue_ms": round(result.queue_ms, 3),
-                "device_ms": round(result.device_ms, 3),
-            }
-        )
+        out = {
+            "id": rid,
+            "ok": True,
+            "result": payload,
+            "groups": np.asarray(result.groups).tolist(),
+            "coalesced": result.coalesced,
+            "batch": result.batch_size,
+            "queue_ms": round(result.queue_ms, 3),
+            "device_ms": round(result.device_ms, 3),
+        }
+        if result.traceparent is not None:
+            # trace-context echo: same trace id the request carried, this
+            # replica's handling as the new parent span — the hop chains
+            out["traceparent"] = result.traceparent
+            out["trace_id"] = result.trace_id
+        _emit(out)
 
 
 def _start_reader(stream: Any, loop: asyncio.AbstractEventLoop) -> asyncio.Queue:
@@ -235,12 +239,22 @@ async def _amain(args: argparse.Namespace) -> int:
 
     if args.aot_dir:
         set_options(serve_aot_dir=args.aot_dir)
+    if args.replica_id:
+        # validated like any set_options value (label-safe, bounded): a
+        # bad --replica-id dies at startup, not at first scrape
+        set_options(replica_id=args.replica_id)
     metrics_port = (
         args.metrics_port if args.metrics_port is not None else OPTIONS["metrics_port"]
     )
+    from .. import telemetry
+
     if metrics_port:
         bound = exposition.start_metrics_server(port=metrics_port, host=args.metrics_host)
-        _emit({"op": "metrics", "port": bound})
+        _emit({"op": "metrics", "port": bound,
+               "replica": telemetry.replica_instance()})
+    # a clock anchor near startup: trace_join aligns this replica's jsonl
+    # export onto the shared fleet timeline from it (no-op, telemetry off)
+    telemetry.anchor_event()
     if args.warmup:
         warmed = await asyncio.to_thread(aot.warmup)
         from ..telemetry import METRICS
@@ -406,6 +420,13 @@ def main(argv: list[str] | None = None) -> int:
         "--metrics-port", type=int, default=None,
         help="serve /metrics + /healthz + /readyz on this port "
         "(overrides FLOX_TPU_METRICS_PORT; 0 keeps the endpoint off)",
+    )
+    parser.add_argument(
+        "--replica-id", default=None,
+        help="this replica's stable fleet identity (overrides "
+        "FLOX_TPU_REPLICA_ID): labels every /metrics series and "
+        "/debug/costs payload, prefixes generated request ids, and stamps "
+        "telemetry exports for tools/trace_join.py",
     )
     parser.add_argument(
         "--metrics-host", default="127.0.0.1",

@@ -178,6 +178,13 @@ class AggregationRequest:
     #: to ``OPTIONS["serve_deadline"]`` (0 there = no deadline)
     deadline: float | None = None
     request_id: str | None = None
+    #: optional W3C trace-context header (``00-<trace>-<parent>-<flags>``):
+    #: a request that arrived carrying one (router hop, traced client) runs
+    #: under THAT trace id with the parent span linked, and the response
+    #: echoes a ``traceparent`` with the same trace id — so the whole
+    #: router→replica path joins into ONE trace. Malformed values are
+    #: ignored (counted on ``serve.bad_traceparent``), never errors.
+    traceparent: str | None = None
     #: optional cost-attribution tag: requests carrying one feed the
     #: per-tenant cost ledger (``cache.stats()["cost_by_tenant"]``) and a
     #: tenant-labeled ``serve.request_ms{tenant=...}`` latency histogram on
@@ -202,6 +209,13 @@ class ServeResult:
     batch_size: int = 1
     queue_ms: float = 0.0
     device_ms: float = 0.0
+    #: the trace id this request ran under: the W3C trace id when the
+    #: request carried a valid ``traceparent``, else its request id
+    trace_id: str | None = None
+    #: the ``traceparent`` to hand the next hop (same trace id, this
+    #: replica's handling as the new parent span) — set only for requests
+    #: that propagated one in, so untraced traffic sees no new fields
+    traceparent: str | None = None
 
 
 class _Leaf:
@@ -448,17 +462,40 @@ class Dispatcher:
         # trace ENTRY, so the request's own mid-trace observation cannot
         # dilute its own verdict
         if request.request_id is None:
-            request.request_id = f"req-{rid}"
+            # replica-prefixed: two replicas behind one router each count
+            # their own req-N — without the prefix (the configured
+            # replica_id, or a per-process fallback) the fleet's ids
+            # collide and traces/exemplars/ledger links cross-attribute
+            request.request_id = f"{telemetry.replica_instance()}:req-{rid}"
+        # trace propagation: a request that arrived with a (valid) W3C
+        # traceparent runs under ITS trace id with the remote parent span
+        # linked — the whole router→replica hop becomes one joined trace.
+        # Without one, the request id roots a fresh local trace as before.
+        parsed = (
+            telemetry.parse_traceparent(request.traceparent)
+            if request.traceparent is not None
+            else None
+        )
+        if request.traceparent is not None and parsed is None:
+            METRICS.inc("serve.bad_traceparent")
+        trace_ctx, parent_span = parsed if parsed else (request.request_id, None)
         try:
             with telemetry.trace(
-                request.request_id, hist="serve.request_ms", observe=False
+                trace_ctx, hist="serve.request_ms", observe=False,
+                parent=parent_span,
             ):
-                return await self._submit_admitted(request, t0)
+                return await self._submit_admitted(
+                    request, t0, trace_ctx, propagated=parsed is not None
+                )
         finally:
             _PENDING_REGISTRY.pop(rid, None)
 
     async def _submit_admitted(
-        self, request: AggregationRequest, t0: float
+        self,
+        request: AggregationRequest,
+        t0: float,
+        trace_ctx: str | None = None,
+        propagated: bool = False,
     ) -> ServeResult:
         if isinstance(request.func, list):
             # JSON clients send statistic sets as lists; the program key
@@ -577,6 +614,15 @@ class Dispatcher:
             batch_size=leaf.batch_size,
             queue_ms=queue_ms,
             device_ms=leaf.device_ms,
+            trace_id=trace_ctx if trace_ctx is not None else request.request_id,
+            # echo the SAME trace id with this replica's handling as the
+            # new parent span — the next hop (or the client's trace UI)
+            # chains onto it. Only for requests that propagated one in.
+            traceparent=(
+                telemetry.format_traceparent(trace_ctx)
+                if propagated and trace_ctx is not None
+                else None
+            ),
         )
 
     # -- batching -----------------------------------------------------------

@@ -93,11 +93,13 @@ __all__ = [
     "METRICS",
     "SATURATION_GAUGES",
     "MetricsRegistry",
+    "anchor_event",
     "annotated",
     "cost_by_program",
     "cost_by_tenant",
     "count",
     "current_trace",
+    "current_trace_parent",
     "detailed",
     "drain",
     "enabled",
@@ -106,11 +108,17 @@ __all__ = [
     "export_jsonl",
     "flight_dump",
     "flush",
+    "format_traceparent",
+    "host_name",
     "install_signal_dumps",
+    "new_span_hex",
     "observe_cost",
+    "parse_traceparent",
     "profile_call",
     "record_serve_error",
     "record_span",
+    "replica_id",
+    "replica_instance",
     "reset",
     "sample_hbm",
     "sample_saturation",
@@ -129,6 +137,50 @@ _EPOCH = time.perf_counter()
 _WALL0 = time.time()
 
 _PID = os.getpid()
+
+# short host name (label-sanitized): the `host` half of the fleet identity
+# every /metrics series, /debug/costs payload, and export stamp carries
+try:
+    import socket
+
+    _HOST = re.sub(r"[^A-Za-z0-9_.:\-]", "_", socket.gethostname().split(".")[0]) or "?"
+except Exception:  # noqa: BLE001 — identity must never break import
+    _HOST = "?"
+
+
+def host_name() -> str:
+    """This process's short, label-safe host name."""
+    return _HOST
+
+
+def replica_id() -> str | None:
+    """The configured replica identity (``OPTIONS["replica_id"]`` /
+    ``FLOX_TPU_REPLICA_ID``), or ``None`` on an unconfigured single-replica
+    process — the fleet surfaces (metric labels, export stamps) activate
+    only when one is set, so solo deployments stay byte-identical."""
+    from .options import OPTIONS
+
+    return OPTIONS["replica_id"]
+
+
+def replica_instance() -> str:
+    """A process-unique replica name: the configured ``replica_id`` when
+    set, else a stable per-process fallback (``p<pid>``). Request-id
+    generation and the trace-join export stamps use THIS — two replicas an
+    operator forgot to name must still never collide."""
+    return replica_id() or f"p{_PID}"
+
+
+def _process_index() -> int:
+    """This process's index in a ``jax.distributed`` mesh (0 outside one);
+    stamped into jsonl export tails so ``tools/trace_join.py`` can order
+    mesh tracks deterministically."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — identity must never break exports
+        return 0
 
 #: buffer cap — a runaway instrumented loop must degrade (drop + count),
 #: never hold the process's memory hostage
@@ -355,7 +407,78 @@ _CURRENT: contextvars.ContextVar["_Span | None"] = contextvars.ContextVar(
 _TRACE: contextvars.ContextVar[str | None] = contextvars.ContextVar(
     "flox_tpu_trace", default=None
 )
+#: the REMOTE parent span of the active trace (the ``parent-id`` half of a
+#: client-supplied W3C ``traceparent``): root-level records emitted inside
+#: the trace carry it as ``trace_parent``, which is what lets
+#: ``tools/trace_join.py`` hang a replica's spans under the hop that sent
+#: the request (router→replica, client→replica) in ONE joined trace
+_TRACE_PARENT: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "flox_tpu_trace_parent", default=None
+)
 _IDS = itertools.count(1)
+
+# ---------------------------------------------------------------------------
+# W3C trace-context (traceparent) propagation
+# ---------------------------------------------------------------------------
+
+#: ``version-traceid-parentid-flags`` per the W3C trace-context spec; the
+#: serve protocol accepts exactly this shape (lowercase hex, version != ff,
+#: ids nonzero) and ignores anything else rather than guessing
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def parse_traceparent(header: Any) -> tuple[str, str] | None:
+    """``(trace_id, parent_span_id)`` from a W3C ``traceparent`` string,
+    or ``None`` for anything malformed (wrong shape, uppercase hex, the
+    forbidden ``ff`` version, all-zero ids) — a bad header degrades to a
+    locally rooted trace, never to an error or a half-parsed id."""
+    if not isinstance(header, str):
+        return None
+    m = _TRACEPARENT_RE.match(header.strip())
+    if m is None:
+        return None
+    version, trace_id, parent_id, _flags = m.groups()
+    if version == "ff" or set(trace_id) == {"0"} or set(parent_id) == {"0"}:
+        return None
+    return trace_id, parent_id
+
+
+def _hex_trace_id(trace_id: str) -> str:
+    """``trace_id`` as 32 lowercase hex chars: pass-through when it already
+    is one (a propagated W3C id), else a stable blake2b digest of it — so a
+    plain request id still formats into a valid ``traceparent``."""
+    if (
+        len(trace_id) == 32
+        and set(trace_id) != {"0"}
+        and _TRACEPARENT_RE.match(f"00-{trace_id}-{'1' * 16}-01")
+    ):
+        return trace_id
+    import hashlib
+
+    digest = hashlib.blake2b(trace_id.encode(), digest_size=16).hexdigest()
+    # an (astronomically unlikely) all-zero digest would format into the
+    # spec's forbidden all-zero trace id — nudge it valid
+    return digest if set(digest) != {"0"} else "1" + digest[1:]
+
+
+def new_span_hex() -> str:
+    """A fresh 16-hex span id, unique per process AND across replicas (the
+    pid + replica instance are folded in): the replica's own hop identity
+    in the ``traceparent`` it echoes downstream."""
+    import hashlib
+
+    seed = f"{replica_instance()}|{_PID}|{next(_IDS)}|{time.perf_counter_ns()}"
+    return hashlib.blake2b(seed.encode(), digest_size=8).hexdigest()
+
+
+def format_traceparent(trace_id: str, span_id: str | None = None) -> str:
+    """A W3C ``traceparent`` for ``trace_id`` (hex-normalized via
+    :func:`_hex_trace_id`) with ``span_id`` (or a fresh one) as the
+    parent-id field — what a replica echoes so the NEXT hop keeps the same
+    trace and parents onto this replica's handling."""
+    return f"00-{_hex_trace_id(str(trace_id))}-{span_id or new_span_hex()}-01"
 
 #: per-trace parked detail records (tail-based sampling at level="basic"):
 #: trace id -> records kept only if the trace blows its running p99.
@@ -587,7 +710,19 @@ def current_trace() -> str | None:
     return _TRACE.get()
 
 
-def trace(trace_id: Any, hist: str = "trace_ms", observe: bool = True):
+def current_trace_parent() -> str | None:
+    """The active trace's REMOTE parent span id (the ``parent-id`` of the
+    ``traceparent`` the request arrived with), or ``None`` for a locally
+    rooted trace."""
+    return _TRACE_PARENT.get()
+
+
+def trace(
+    trace_id: Any,
+    hist: str = "trace_ms",
+    observe: bool = True,
+    parent: str | None = None,
+):
     """Bind a trace context: ``with telemetry.trace(request_id): ...``.
 
     Every record emitted inside (phase spans, streaming passes, mesh
@@ -598,21 +733,34 @@ def trace(trace_id: Any, hist: str = "trace_ms", observe: bool = True):
     itself): a trace that blew the p99, or errored, promotes its parked
     ``detailed``-level records into the buffer; a fast one drops them. The
     no-op singleton is returned when telemetry is disabled — no allocation.
+
+    ``parent`` is the REMOTE parent span id for a trace that began on
+    another process (the ``parent-id`` half of a W3C ``traceparent`` — the
+    serve layer passes the parsed header through): root-level records then
+    carry it as ``trace_parent``, which ``tools/trace_join.py`` uses to
+    hang this process's spans under the sending hop in one joined trace.
     """
     if not enabled():
         return _NOOP
     _bootstrap()
-    return _Trace(str(trace_id), hist, observe)
+    return _Trace(str(trace_id), hist, observe, parent)
 
 
 class _Trace:
-    __slots__ = ("trace_id", "_hist", "_observe", "_token", "_t0", "_owns_tail", "_p99")
+    __slots__ = (
+        "trace_id", "_hist", "_observe", "_token", "_ptoken", "_parent",
+        "_t0", "_owns_tail", "_p99",
+    )
 
-    def __init__(self, trace_id: str, hist: str, observe: bool) -> None:
+    def __init__(
+        self, trace_id: str, hist: str, observe: bool, parent: str | None = None
+    ) -> None:
         self.trace_id = trace_id
         self._hist = hist
         self._observe = observe
+        self._parent = parent
         self._token: contextvars.Token | None = None
+        self._ptoken: contextvars.Token | None = None
         self._t0 = 0.0
         self._owns_tail = False
         self._p99: float | None = None
@@ -621,6 +769,8 @@ class _Trace:
         from .options import OPTIONS
 
         self._token = _TRACE.set(self.trace_id)
+        if self._parent is not None:
+            self._ptoken = _TRACE_PARENT.set(str(self._parent))
         if OPTIONS["telemetry_level"] != "detailed":
             # open the tail-parking buffer for this trace; detail records
             # emitted inside land here instead of the main buffer. Only the
@@ -643,6 +793,9 @@ class _Trace:
 
     def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
         dur_ms = (time.perf_counter() - self._t0) * 1e3
+        if self._ptoken is not None:
+            _TRACE_PARENT.reset(self._ptoken)
+            self._ptoken = None
         if self._token is not None:
             _TRACE.reset(self._token)
             self._token = None
@@ -724,6 +877,19 @@ class _FlightRecorder:
 FLIGHT_RECORDER = _FlightRecorder()
 
 
+def _breaker_snapshot() -> dict:
+    """``cache.stats()["serve_breakers"]`` for the flight-dump header —
+    imported lazily and guarded, since a dump must succeed even on a
+    process that never touched the serve plane (or mid-interpreter-
+    shutdown when the import machinery is already torn down)."""
+    try:
+        from .serve.breaker import breaker_stats
+
+        return breaker_stats()
+    except Exception:  # noqa: BLE001 — forensics are best-effort by contract
+        return {}
+
+
 def flight_dump(path: Any = None, reason: str = "") -> str | None:
     """Dump the flight-recorder ring atomically as JSON-lines.
 
@@ -755,6 +921,16 @@ def flight_dump(path: Any = None, reason: str = "") -> str | None:
                 "records": len(records),
                 "pid": _PID,
                 "wall": time.time(),
+                "replica": replica_instance(),
+                "host": _HOST,
+                # breaker + saturation state AT CRASH TIME: the ring holds
+                # spans, but a post-mortem's first questions — was a
+                # breaker open, was the queue building — need the live
+                # state, not an inference from record archaeology
+                "breakers": _breaker_snapshot(),
+                "saturation": {
+                    name: METRICS.get(name) for name in SATURATION_GAUGES
+                },
             },
         }
         path = str(path)
@@ -921,17 +1097,21 @@ _TENANT_MAX = 64
 _TENANT_UNSAFE = re.compile(r"[^A-Za-z0-9_.:\-]")
 
 
-def tenant_label(tenant: Any) -> str:
+def tenant_label(tenant: Any, register: bool = True) -> str:
     """The sanitized, cardinality-bounded label for a client tenant tag.
 
     The serve layer passes every request's raw ``tenant`` through here
     before using it as a ledger key or a metric label: unsafe characters
     fold to ``_``, length is capped, and once :data:`_TENANT_MAX` distinct
     labels exist, new ones collapse into ``"_other"`` (their cost is still
-    counted — just not per-tenant)."""
+    counted — just not per-tenant). ``register=False`` sanitizes without
+    admitting a new label — read-side callers (the ``/debug/costs``
+    ``?tenant=`` filter) must not burn cardinality slots on lookups."""
     label = _TENANT_UNSAFE.sub("_", str(tenant))[:64] or "_"
     with _RECORDS_LOCK:
         if label in _TENANT_LABELS:
+            return label
+        if not register:
             return label
         if len(_TENANT_LABELS) >= _TENANT_MAX:
             return "_other"
@@ -1101,6 +1281,17 @@ def _emit(record: dict, detail: bool = False) -> None:
     tid = _TRACE.get()
     if tid is not None:
         record["trace"] = tid
+        # a remote parent attaches to ROOT-level records only: the local
+        # span hierarchy already links everything below them, so one
+        # trace_parent per root is exactly what the join tool needs
+        parent_span = _TRACE_PARENT.get()
+        if parent_span is not None and record.get("parent") is None:
+            record["trace_parent"] = parent_span
+    rid = OPTIONS["replica_id"]
+    if rid is not None:
+        # fleet identity on every record: jsonl/flight files from N
+        # replicas stay attributable after they are merged or joined
+        record["replica"] = rid
     # the flight ring sees EVERY record (bounded: oldest falls out), so a
     # crash dump always holds the freshest activity regardless of export
     # configuration or tail-sampling verdicts
@@ -1258,7 +1449,37 @@ def _counters_record() -> dict:
         "histograms": METRICS.histograms(),
         "hist_edges_ms": list(HIST_EDGES_MS),
         "wall0": _WALL0,
+        # fleet/mesh identity + a fresh two-clock anchor: trace_join reads
+        # these to give each process its own Perfetto track and to shift
+        # its monotonic timestamps onto the shared wall clock
+        "replica": replica_instance(),
+        "host": _HOST,
+        "pid": _PID,
+        "process_index": _process_index(),
+        "anchor": {
+            "wall": time.time(),
+            "ts_us": round((time.perf_counter() - _EPOCH) * 1e6, 1),
+        },
     }
+
+
+def anchor_event() -> None:
+    """Emit a ``clock-anchor`` instant event pairing the wall clock with
+    the process's monotonic span clock (plus its replica/mesh identity).
+
+    ``tools/trace_join.py`` prefers the freshest anchor it finds when
+    aligning per-process files onto one timeline — emit one near the work
+    being joined (the serve loop emits one at startup; the mesh smoke
+    emits one per process) so monotonic-vs-wall drift since import cannot
+    skew the merged trace. No-op while telemetry is off."""
+    event(
+        "clock-anchor",
+        wall=time.time(),
+        replica=replica_instance(),
+        host=_HOST,
+        pid=_PID,
+        process_index=_process_index(),
+    )
 
 
 def export_jsonl(path: str, records: Iterable[dict] | None = None) -> None:
@@ -1562,23 +1783,33 @@ def _report_lines(path: str, histograms: bool = False) -> list[str]:
     return lines
 
 
-def _load_costs(path: str | None) -> tuple[dict, dict]:
-    """(cost_by_program, cost_by_tenant) — from a file (a ``/debug/costs``
-    scrape, a serve ``stats`` line, or a bare ``{label: row}`` mapping) or,
-    with no file, from the live in-process ledger."""
+def _load_costs(path: str | None) -> tuple[dict, dict, str | None]:
+    """(cost_by_program, cost_by_tenant, replica) — from a file (a
+    ``/debug/costs`` scrape — possibly ``?tenant=``/``?top=``-filtered —
+    a serve ``stats`` line, or a bare ``{label: row}`` mapping) or, with
+    no file, from the live in-process ledger. ``replica`` is the scrape's
+    fleet identity stamp when it carries one."""
     if path is None:
-        return cost_by_program(), cost_by_tenant()
+        return cost_by_program(), cost_by_tenant(), None
     with open(path) as f:
         payload = json.load(f)
     if not isinstance(payload, dict):
         raise ValueError(f"{path}: expected a JSON object, got {type(payload).__name__}")
     if "cost_by_program" in payload:
-        return payload.get("cost_by_program") or {}, payload.get("cost_by_tenant") or {}
+        return (
+            payload.get("cost_by_program") or {},
+            payload.get("cost_by_tenant") or {},
+            payload.get("replica"),
+        )
     # a serve `stats` response line carries the ledger under cache stats
     stats = payload.get("cache") or {}
     if "cost_by_program" in stats:
-        return stats.get("cost_by_program") or {}, stats.get("cost_by_tenant") or {}
-    return payload, {}
+        return (
+            stats.get("cost_by_program") or {},
+            stats.get("cost_by_tenant") or {},
+            None,
+        )
+    return payload, {}, None
 
 
 def _cost_lines(
@@ -1680,11 +1911,11 @@ def main(argv: list[str] | None = None) -> int:
         if args.top is not None and args.top < 1:
             parser.error("--top must be >= 1")
         try:
-            programs, tenants = _load_costs(args.file)
-            lines = _cost_lines(
-                programs, tenants, top=args.top,
-                source=args.file or "live process",
-            )
+            programs, tenants, replica = _load_costs(args.file)
+            source = args.file or "live process"
+            if replica:
+                source = f"{source} (replica {replica})"
+            lines = _cost_lines(programs, tenants, top=args.top, source=source)
         except OSError as exc:
             parser.error(f"cannot read {args.file}: {exc}")
         except (ValueError, KeyError, TypeError, AttributeError) as exc:

@@ -496,6 +496,11 @@ class TestNewOptions:
             {"profile_keep": True},
             {"metrics_sample_interval": -1.0},
             {"metrics_sample_interval": float("inf")},
+            {"replica_id": ""},
+            {"replica_id": 'bad"label'},
+            {"fleet_scrape_interval": 0.0},
+            {"fleet_port": -1},
+            {"fleet_replicas": ""},
         ],
     )
     def test_validated_at_set_time(self, bad):
@@ -513,6 +518,8 @@ class TestNewOptions:
         for name in (
             "metrics_port", "flight_recorder_path", "flight_recorder_size",
             "profile_dir", "profile_keep", "metrics_sample_interval",
+            "replica_id", "fleet_scrape_interval", "fleet_port",
+            "fleet_replicas",
         ):
             assert f"FLOX_TPU_{name.upper()}" in src
 

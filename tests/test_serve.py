@@ -721,3 +721,45 @@ class TestProtocol:
         # failures were rejected before admission
         assert records["stats"]["counters"]["serve.requests"] == 2
         assert records["stats"]["cache"]["serve_pending"] == 0
+
+    def test_traceparent_propagates_and_echoes_over_protocol(self, tmp_path):
+        """Fleet trace propagation (ISSUE 13): a request carrying a W3C
+        traceparent is answered with the SAME trace id and a fresh parent
+        span for this hop; requests without one gain no new fields."""
+        trace32, span16 = "ab" * 16, "cd" * 8
+        script = tmp_path / "requests.jsonl"
+        script.write_text(
+            "\n".join(
+                [
+                    json.dumps(
+                        {"id": "traced", "func": "sum",
+                         "array": [1.0, 2.0, 4.0], "by": [0, 1, 1],
+                         "traceparent": f"00-{trace32}-{span16}-01"}
+                    ),
+                    json.dumps(
+                        {"id": "plain", "func": "sum",
+                         "array": [1.0, 2.0, 8.0], "by": [0, 1, 1]}
+                    ),
+                    json.dumps({"op": "drain"}),
+                ]
+            )
+            + "\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", FLOX_TPU_TELEMETRY="1")
+        env.pop("FLOX_TPU_TELEMETRY_EXPORT_PATH", None)
+        proc = subprocess.run(
+            [sys.executable, "-m", "flox_tpu.serve", "--input", str(script),
+             "--replica-id", "rep-a"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=240,
+        )
+        assert proc.returncode == 0, proc.stderr
+        records = {
+            rec.get("id", rec.get("op")): rec
+            for rec in (json.loads(l) for l in proc.stdout.splitlines() if l.strip())
+        }
+        traced = records["traced"]
+        assert traced["ok"] and traced["trace_id"] == trace32
+        echoed = traced["traceparent"].split("-")
+        assert echoed[0] == "00" and echoed[1] == trace32
+        assert echoed[2] != span16  # this replica's hop, not the caller's
+        assert "traceparent" not in records["plain"]

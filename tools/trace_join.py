@@ -1,0 +1,280 @@
+"""Join per-process telemetry jsonl exports into ONE Perfetto trace.
+
+A fleet (router + N replicas) or a multi-host ``jax.distributed`` mesh
+produces one jsonl export per process — each with its own monotonic span
+clock, its own pids/tids, and (with trace propagation, PR 13) shared trace
+ids linking the hops of one request. This tool merges them::
+
+    python -m tools.trace_join fleet.json replica-a.jsonl replica-b.jsonl
+
+into a single Chrome trace-event file (ui.perfetto.dev-loadable) where:
+
+* every input file becomes its OWN process track, named from the file's
+  replica stamp (``process_name`` metadata events; ``process_sort_index``
+  follows the recorded ``jax.distributed`` process index, so mesh tracks
+  order deterministically);
+* per-process monotonic timestamps are aligned onto one shared timeline
+  from each file's clock anchor — the freshest ``clock-anchor`` event
+  (``telemetry.anchor_event()``) when present, else the export tail's
+  ``anchor`` pair, else the import-time ``wall0`` — normalized so the
+  earliest process starts at 0;
+* records sharing a trace id across processes get Perfetto flow arrows
+  (``ph: s/f``) from the root span of the process that saw the trace
+  first (the router/client hop) to each other process's root span for it
+  — with ``trace_parent`` stamps (a propagated W3C ``traceparent``)
+  naming the exact remote parent span.
+
+Counters lines ride along under ``floxTpuFleet`` (one entry per input
+file: replica, host, pid, process index, counter snapshot), so the merged
+file still answers "how many compiles did replica b pay".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+__all__ = ["join_traces", "load_jsonl", "main"]
+
+
+def load_jsonl(path: str) -> tuple[list[dict], dict]:
+    """(records, tail) for one per-process export: every span/event record
+    plus the final ``counters`` record (the identity/anchor stamp). A
+    malformed line is an error naming ``file:line`` — a torn export must
+    fail the join, not silently drop a process's spans."""
+    records: list[dict] = []
+    tail: dict = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as exc:
+                raise ValueError(f"{path}:{lineno}: malformed record ({exc})") from exc
+            if not isinstance(rec, dict):
+                raise ValueError(
+                    f"{path}:{lineno}: expected an object, got {type(rec).__name__}"
+                )
+            if rec.get("type") == "counters":
+                tail = rec  # later snapshots supersede (append-mode files)
+            else:
+                records.append(rec)
+    return records, tail
+
+
+def _wall_offset_us(records: list[dict], tail: dict) -> float:
+    """Microseconds to ADD to this file's ``ts_us`` values to land them on
+    the wall clock: from the freshest ``clock-anchor`` event (both clocks
+    read at one instant), else the export tail's ``anchor`` pair, else the
+    import-time ``wall0`` (where ``ts_us`` 0 == ``wall0`` by
+    construction)."""
+    anchor: tuple[float, float] | None = None  # (wall_s, ts_us)
+    for rec in records:
+        if rec.get("name") == "clock-anchor":
+            wall = (rec.get("attrs") or {}).get("wall")
+            if wall is not None:
+                anchor = (float(wall), float(rec.get("ts_us", 0.0)))
+    if anchor is None and isinstance(tail.get("anchor"), dict):
+        pair = tail["anchor"]
+        if "wall" in pair and "ts_us" in pair:
+            anchor = (float(pair["wall"]), float(pair["ts_us"]))
+    if anchor is None and "wall0" in tail:
+        anchor = (float(tail["wall0"]), 0.0)
+    if anchor is None:
+        return 0.0
+    wall_s, ts_us = anchor
+    return wall_s * 1e6 - ts_us
+
+
+def join_traces(inputs: list[tuple[str, list[dict], dict]]) -> dict:
+    """Merge per-process (label, records, tail) triples into one Chrome
+    trace-event object with a distinct, named process track per input and
+    cross-process flow arrows for shared trace ids."""
+    if not inputs:
+        raise ValueError("no input files to join")
+    labels = [label for label, _, _ in inputs]
+    if len(set(labels)) != len(labels):
+        raise ValueError(
+            f"duplicate input labels {sorted(labels)} — labels key the "
+            "per-file clock offsets, so they must be distinct"
+        )
+    offsets = {
+        label: _wall_offset_us(records, tail)
+        for label, records, tail in inputs
+    }
+    # normalize: the earliest process's first record lands at ts 0 (Perfetto
+    # renders absolute microseconds; epoch-scale values are unwieldy)
+    starts = []
+    for label, records, tail in inputs:
+        for rec in records:
+            if "ts_us" in rec:
+                starts.append(rec["ts_us"] + offsets[label])
+                break
+    base = min(starts) if starts else 0.0
+
+    events: list[dict] = []
+    fleet_meta: list[dict] = []
+    #: (trace id, pid) -> {"ts": earliest aligned ts, "tid": its thread,
+    #: "parent": any trace_parent stamp seen} — the per-process sighting
+    #: the flow arrows connect. Earliest by TIMESTAMP, not file order:
+    #: spans emit at exit, so inner spans precede their parents in the
+    #: file, and the parent stamp rides only root-level records.
+    sightings: dict[tuple[str, int], dict] = {}
+    for pid, (label, records, tail) in enumerate(inputs, start=1):
+        replica = tail.get("replica") or label
+        sort_index = int(tail.get("process_index", pid - 1))
+        events.append(
+            {
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"{replica} ({label})"},
+            }
+        )
+        events.append(
+            {
+                "name": "process_sort_index", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"sort_index": sort_index},
+            }
+        )
+        offset = offsets[label] - base
+        for rec in records:
+            ts = float(rec.get("ts_us", 0.0)) + offset
+            args = dict(rec.get("attrs") or {})
+            if rec.get("trace") is not None:
+                args["trace_id"] = rec["trace"]
+            if rec.get("trace_parent") is not None:
+                args["trace_parent"] = rec["trace_parent"]
+            if rec.get("replica") is not None:
+                args["replica"] = rec["replica"]
+            tid = rec.get("tid", 0)
+            if rec.get("type") == "span":
+                events.append(
+                    {
+                        "name": rec.get("name", "?"), "ph": "X", "ts": ts,
+                        "dur": rec.get("dur_us", 0.0), "pid": pid, "tid": tid,
+                        "args": args,
+                    }
+                )
+            elif rec.get("type") == "event":
+                events.append(
+                    {
+                        "name": rec.get("name", "?"), "ph": "i", "s": "t",
+                        "ts": ts, "pid": pid, "tid": tid, "args": args,
+                    }
+                )
+            else:
+                continue
+            trace_id = rec.get("trace")
+            if trace_id is not None:
+                slot = sightings.setdefault(
+                    (trace_id, pid), {"ts": ts, "tid": tid, "parent": None}
+                )
+                if ts < slot["ts"]:
+                    slot["ts"], slot["tid"] = ts, tid
+                if rec.get("trace_parent") is not None:
+                    slot["parent"] = rec["trace_parent"]
+        fleet_meta.append(
+            {
+                "file": label,
+                "pid": pid,
+                "replica": replica,
+                "host": tail.get("host"),
+                "source_pid": tail.get("pid"),
+                "process_index": tail.get("process_index"),
+                "clock_offset_us": round(offset, 1),
+                "counters": tail.get("counters", {}),
+            }
+        )
+    # flow arrows: a trace id seen in >1 process flows from its earliest
+    # sighting (the hop that opened the trace) to every later process's
+    # first record for it — Perfetto draws the router→replica arrow
+    by_trace: dict[str, list[tuple[float, int, Any, Any]]] = {}
+    for (trace_id, pid), slot in sightings.items():
+        by_trace.setdefault(trace_id, []).append(
+            (slot["ts"], pid, slot["tid"], slot["parent"])
+        )
+    flow_id = 0
+    for trace_id, rows in sorted(by_trace.items()):
+        if len(rows) < 2:
+            continue
+        rows.sort()
+        t0, pid0, tid0, _ = rows[0]
+        flow_id += 1
+        events.append(
+            {
+                "name": f"trace:{trace_id}", "ph": "s", "id": flow_id,
+                "ts": t0, "pid": pid0, "tid": tid0, "cat": "trace",
+            }
+        )
+        for ts, pid, tid, parent in rows[1:]:
+            events.append(
+                {
+                    "name": f"trace:{trace_id}", "ph": "f", "bp": "e",
+                    "id": flow_id, "ts": ts, "pid": pid, "tid": tid,
+                    "cat": "trace",
+                    "args": {"trace_parent": parent} if parent else {},
+                }
+            )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "floxTpuFleet": fleet_meta,
+    }
+
+
+def _unique_labels(paths: list[str]) -> list[str]:
+    """Short display labels for the input files, guaranteed distinct.
+
+    Labels key the per-file clock offsets inside :func:`join_traces`, so
+    two files that share a basename (``replica-a/export.jsonl`` and
+    ``replica-b/export.jsonl``) must NOT collapse to one label — that
+    would silently apply one file's clock offset to the other's track.
+    Basenames when unique, full paths where they collide."""
+    bases = [os.path.basename(p) for p in paths]
+    return [
+        path if bases.count(base) > 1 else base
+        for base, path in zip(bases, paths)
+    ]
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.trace_join",
+        description="Merge per-process flox_tpu telemetry jsonl exports "
+        "into one Perfetto-loadable trace with a track per process and "
+        "flow arrows joining propagated trace ids.",
+    )
+    parser.add_argument("output", help="merged Chrome-trace .json to write")
+    parser.add_argument(
+        "inputs", nargs="+",
+        help="per-process .jsonl telemetry exports (one track each)",
+    )
+    args = parser.parse_args(argv)
+    try:
+        labels = _unique_labels(args.inputs)
+        loaded = [
+            (label, *load_jsonl(path))
+            for label, path in zip(labels, args.inputs)
+        ]
+        payload = join_traces(loaded)
+    except (OSError, ValueError) as exc:
+        parser.error(str(exc))
+    tmp = args.output + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, args.output)
+    tracks = len(loaded)
+    flows = sum(1 for ev in payload["traceEvents"] if ev.get("ph") == "s")
+    print(
+        f"{args.output}: {len(payload['traceEvents'])} events across "
+        f"{tracks} process track(s), {flows} cross-process trace flow(s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
