@@ -473,6 +473,41 @@ def main() -> None:
               file=sys.stderr, flush=True)
         telemetry_profile = None
 
+    # --- analytical cost model (ISSUE 14) ---------------------------------
+    # re-run the headline call with the cards plane on: the round's JSON
+    # carries each program's analytical flops/bytes + roofline predicted_ms
+    # next to the measured GB/s, and the drift sentinel verdict — the
+    # "silently got slower after a JAX upgrade" regression detector riding
+    # every committed bench artifact
+    try:
+        from flox_tpu import costmodel as _costmodel
+
+        with flox_tpu.set_options(telemetry=True, costmodel=True):
+            np.asarray(flox_tpu.groupby_reduce(dev_data, month, func="nanmean")[0])
+            drift = _costmodel.drift_report()
+            costmodel_record = {
+                # keyed by digest — the registry's identity: one label can
+                # hold several cards (one per input signature), and a
+                # committed artifact must not let them overwrite each other
+                "cards": {
+                    digest: {
+                        "label": card["label"],
+                        "flops": card["flops"],
+                        "bytes_accessed": card["bytes_accessed"],
+                        "predicted_ms": card["predicted_ms"],
+                        "analysis": card["analysis"],
+                    }
+                    for digest, card in _costmodel.cards().items()
+                },
+                "platform": _costmodel.platform_name(),
+                "drift_flagged": drift["flagged"],
+                "drift_threshold": drift["threshold"],
+            }
+    except Exception as exc:  # noqa: BLE001 — diagnostics must not kill the bench
+        print(f"flox-tpu bench: costmodel failed: {exc}",
+              file=sys.stderr, flush=True)
+        costmodel_record = None
+
     # --- autotune store feed + regression sentinel (ISSUE 6) --------------
     # the round's sweep results ARE the measurements the autotuner's `auto`
     # dispatch wants: record them under the workload's bands (source=bench
@@ -542,6 +577,7 @@ def main() -> None:
         "streaming": streaming,
         "fused": fused_info,
         "telemetry": telemetry_profile,
+        "costmodel": costmodel_record,
         "autotune": autotune_record,
         "regressions": regressions,
     }

@@ -386,6 +386,46 @@ def bench_telemetry(scale: str):
     return [{"bench": "telemetry[era5-nanmean]", "value": profile, "unit": "profile"}]
 
 
+def bench_costmodel(scale: str):
+    """Analytical-cards sweep (ISSUE 14): run the ERA5 nanmean with the
+    cost-model plane on and emit each program's card next to the drift
+    verdict — every benchmarks.py round carries the predicted-vs-observed
+    join, so a program that silently got slower shows up in the committed
+    artifact, not just in a live scrape."""
+    import flox_tpu
+    from flox_tpu import cache, costmodel, groupby_reduce
+
+    nt, day = _era5_labels(scale)
+    nspace = 72 * 144 if scale == "full" else 24 * 48
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(nspace, nt)).astype(np.float32)
+    cache.clear_all()
+    try:
+        with flox_tpu.set_options(telemetry=True, costmodel=True):
+            _block(groupby_reduce(vals, day, func="nanmean", engine="jax")[0])
+            drift = costmodel.drift_report()
+            # keyed by digest (the registry identity) — one label can hold
+            # several cards, one per input signature
+            record = {
+                "cards": {
+                    digest: {
+                        "label": card["label"],
+                        "flops": card["flops"],
+                        "bytes_accessed": card["bytes_accessed"],
+                        "predicted_ms": card["predicted_ms"],
+                        "analysis": card["analysis"],
+                    }
+                    for digest, card in costmodel.cards().items()
+                },
+                "drift_flagged": drift["flagged"],
+            }
+    except Exception as exc:  # noqa: BLE001 — diagnostics must not kill the sweep
+        record = {"error": f"{type(exc).__name__}: {exc}"}
+    finally:
+        cache.clear_all()
+    return [{"bench": "costmodel[era5-nanmean]", "value": record, "unit": "cards"}]
+
+
 def bench_cohort_detection(scale: str):
     """time_find_group_cohorts + track_num_cohorts parity."""
     from flox_tpu import cache
@@ -514,6 +554,7 @@ def main() -> None:
             results += bench_scan_blelloch(args.scale)
             results += bench_streaming(args.scale)
             results += bench_telemetry(args.scale)
+            results += bench_costmodel(args.scale)
         results += bench_cohort_detection(args.scale)
         return results
 

@@ -455,7 +455,15 @@ def decide(
         return fallback
     rec = lookup(family, dtype=dtype, ngroups=ngroups, nelems=nelems)
     if rec is None:
-        return fallback
+        # no measured band close enough: the analytical cost model (when
+        # its plane is on) supplies a cold-start prior for the families it
+        # can reason about — measured observations outrank it the moment
+        # one lands in the store
+        prior = _analytic_prior(
+            family, fallback, tuple(candidates),
+            dtype=dtype, ngroups=ngroups, nelems=nelems,
+        )
+        return prior if prior is not None else fallback
     cands = rec.get("candidates") or {}
     eligible = {name: cands[name]["gbps"] for name in cands if name in set(candidates)}
     if not eligible:
@@ -468,6 +476,28 @@ def decide(
             "autotune: %s -> %r (heuristic said %r)", family, winner, fallback
         )
     return winner
+
+
+def _analytic_prior(
+    family: str,
+    fallback: str,
+    candidates: tuple,
+    *,
+    dtype: Any,
+    ngroups: int,
+    nelems: int,
+) -> str | None:
+    """``costmodel.analytic_prior`` behind a guard: the tuner must work
+    identically when the cost-model plane is off or unimportable."""
+    try:
+        from .costmodel import analytic_prior
+
+        return analytic_prior(
+            family, fallback, candidates,
+            dtype=dtype, ngroups=ngroups, nelems=nelems,
+        )
+    except Exception:  # noqa: BLE001 — a prior failure is a fallback, never a fault
+        return None
 
 
 def decision_fingerprint() -> tuple:

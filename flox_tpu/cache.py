@@ -110,6 +110,7 @@ def stats() -> dict:
     from .autotune import _AUTOTUNE_CACHE
     from .cohorts import _COHORTS_CACHE
     from .core import _jitted_bundle
+    from .costmodel import _CARD_REGISTRY
     from .factorize import _FACTORIZE_CACHE
     from .fusion import _FUSED_PROGRAM_CACHE
     from .parallel.mapreduce import _PROGRAM_CACHE
@@ -138,6 +139,10 @@ def stats() -> dict:
         # as its own view (the operator's answer to "which compiled program
         # is eating the chip")
         "hbm_by_program": hbm_by_program(),
+        # compiled-program card registry (flox_tpu/costmodel.py): one card
+        # per (program label, input signature) holding the analytical
+        # flops/bytes/footprint the roofline join divides by
+        "costmodel_cards": len(_CARD_REGISTRY),
         "flight_recorder": len(FLIGHT_RECORDER),
         # the on-demand capture guard: whether a jax.profiler capture is
         # running right now (profiling.start_capture / /debug/profile)
@@ -185,6 +190,7 @@ def clear_all() -> None:
     from .autotune import _AUTOTUNE_CACHE, _AUTOTUNE_STATE
     from .cohorts import _COHORTS_CACHE
     from .core import _jitted_bundle
+    from .costmodel import _CARD_LABELS, _CARD_REGISTRY
     from .factorize import _FACTORIZE_CACHE, _FACTORIZE_CACHE_BYTES
     from .fusion import _FUSED_PROGRAM_CACHE
     from .kernels import (
@@ -261,6 +267,10 @@ def clear_all() -> None:
     # prefetch-occupancy gauge counter reset with the metrics they
     # annotate. METRICS.reset() also drops the histograms' exemplar slots
     # — they live inside the registry's histogram state.
+    # cost-model plane (flox_tpu/costmodel.py): the compiled-program card
+    # registry and its label index reset with the ledger they annotate
+    _CARD_REGISTRY.clear()
+    _CARD_LABELS.clear()
     FLIGHT_RECORDER.clear()
     _TAIL_REGISTRY.clear()
     _COST_LEDGER.clear()

@@ -211,20 +211,43 @@ def chunk_reduce(
             compiles0 = telemetry.METRICS.get("jax.compiles")
             compile_ms0 = telemetry.METRICS.get("jax.compile_ms")
             t_dispatch0 = perf_counter()
+        if tm_on:
+            prog = "bundle[" + "+".join(str(p[0]) for p in plan) + "]"
+            # deterministic drift-injection hook (faults.dispatch_delay):
+            # the sentinel tests delay THIS dispatch so the observed wall
+            # honestly diverges from the analytical model
+            from . import faults
+
+            if faults.dispatch_delay_active():
+                faults.dispatch_delay_poke(prog)
         with telemetry.span(
             "dispatch", engine=engine, nkernels=len(plan), size=size,
             funcs=[p[0] for p in plan if isinstance(p[0], str)],
         ):
-            results = bundle(utils.asarray_device(codes), utils.asarray_device(array))
+            # staging stays INSIDE the span: the dispatch span has always
+            # covered transfer + execute, and the trace view must not
+            # silently shrink; the device refs are kept for the card site
+            codes_d = utils.asarray_device(codes)
+            array_d = utils.asarray_device(array)
+            results = bundle(codes_d, array_d)
         if tm_on:
+            # observed wall snapshotted BEFORE the card analysis below: its
+            # lower+compile is bookkeeping, and billing it as device time
+            # would read as drift on the very first dispatch
+            dispatch_ms = (perf_counter() - t_dispatch0) * 1e3
             # HBM pressure right after the device dispatch, attributed to
             # this kernel bundle (cache.stats()["hbm_by_program"]); no-op
             # off-device, and the label join costs nothing when off
-            prog = "bundle[" + "+".join(str(p[0]) for p in plan) + "]"
             telemetry.sample_hbm(program=prog)
+            # the program's analytical card (costmodel plane, opt-in): one
+            # lower+compile per (label, shape signature), memoized — the
+            # roofline join behind program.utilization/predicted_ms
+            from . import costmodel
+
+            costmodel.ensure_card(prog, bundle, (codes_d, array_d))
             telemetry.observe_cost(
                 prog,
-                device_ms=(perf_counter() - t_dispatch0) * 1e3,
+                device_ms=dispatch_ms,
                 nbytes=int(getattr(array, "nbytes", 0))
                 + int(getattr(codes, "nbytes", 0)),
                 compiles=int(telemetry.METRICS.get("jax.compiles") - compiles0),

@@ -73,11 +73,14 @@ def reinitialize() -> bool:
 def memory_stats(devices: Sequence | None = None) -> dict[str, int] | None:
     """Aggregate allocator statistics across the local devices.
 
-    Returns ``{"bytes_in_use", "peak_bytes_in_use", "devices"}`` summed
-    over every local device that reports stats (``peak`` falls back to
-    ``bytes_in_use`` for allocators that track no peak), or ``None`` when
-    no device reports any — CPU backends commonly return nothing, and the
-    telemetry HBM gauges (``telemetry.sample_hbm``) simply stay absent
+    Returns ``{"bytes_in_use", "peak_bytes_in_use", "devices",
+    "bytes_limit"}`` summed over every local device that reports stats
+    (``peak`` falls back to ``bytes_in_use`` for allocators that track no
+    peak; ``bytes_limit`` is the per-device HBM capacity summed the same
+    way, ``None`` when no reporting device exposes one — the in-use gauges
+    then stay unitless rather than inventing a denominator), or ``None``
+    when no device reports any — CPU backends commonly return nothing, and
+    the telemetry HBM gauges (``telemetry.sample_hbm``) simply stay absent
     there. Never raises: observability must not take a dispatch down.
     """
     import jax
@@ -86,8 +89,8 @@ def memory_stats(devices: Sequence | None = None) -> dict[str, int] | None:
         devs = list(jax.local_devices()) if devices is None else list(devices)
     except Exception:  # noqa: BLE001 — no backend at all
         return None
-    in_use = peak = 0
-    reporting = 0
+    in_use = peak = limit = 0
+    reporting = limit_reporting = 0
     for dev in devs:
         stats = _device_stats(dev)
         if not stats:
@@ -96,9 +99,18 @@ def memory_stats(devices: Sequence | None = None) -> dict[str, int] | None:
         dev_in_use = int(stats.get("bytes_in_use", 0))
         in_use += dev_in_use
         peak += int(stats.get("peak_bytes_in_use", dev_in_use))
+        dev_limit = stats.get("bytes_limit")
+        if dev_limit:
+            limit_reporting += 1
+            limit += int(dev_limit)
     if not reporting:
         return None
-    return {"bytes_in_use": in_use, "peak_bytes_in_use": peak, "devices": reporting}
+    return {
+        "bytes_in_use": in_use,
+        "peak_bytes_in_use": peak,
+        "devices": reporting,
+        "bytes_limit": limit if limit_reporting else None,
+    }
 
 
 def _teardown_quietly(fn: Any) -> bool:

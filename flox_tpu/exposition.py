@@ -60,6 +60,29 @@ __all__ = [
 _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
 
+def _parse_top(params: dict) -> tuple[int | None, tuple[bytes, int] | None]:
+    """Parse a ``?top=K`` query param shared by the ``/debug/*`` endpoints:
+    ``(K, None)`` for a valid positive integer, ``(None, None)`` when
+    absent, and ``(None, (body, 400))`` for anything malformed — one
+    contract, one implementation, both endpoints."""
+    top_raw = params.get("top", [None])[0]
+    if top_raw is None:
+        return None, None
+    try:
+        top = int(top_raw)
+    except ValueError:
+        top = -1
+    if top < 1:
+        body = (
+            json.dumps(
+                {"ok": False, "error": f"top must be a positive integer, got {top_raw!r}"}
+            )
+            + "\n"
+        ).encode()
+        return None, (body, 400)
+    return top, None
+
+
 def _top_rows(table: dict, top: int) -> dict:
     """The ``top`` most expensive ledger rows, ranked by device time then
     dispatch count — the same order the costs CLI prints."""
@@ -246,6 +269,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/debug/costs":
             body, status = self._costs(query)
             ctype = "application/json; charset=utf-8"
+        elif path == "/debug/programs":
+            body, status = self._programs(query)
+            ctype = "application/json; charset=utf-8"
         elif path == "/debug/profile":
             body, status = self._profile(query)
             ctype = "application/json; charset=utf-8"
@@ -272,20 +298,9 @@ class _Handler(BaseHTTPRequestHandler):
         from . import telemetry
 
         params = urllib.parse.parse_qs(query)
-        top_raw = params.get("top", [None])[0]
-        top: int | None = None
-        if top_raw is not None:
-            try:
-                top = int(top_raw)
-            except ValueError:
-                top = -1
-            if top < 1:
-                return (
-                    json.dumps(
-                        {"ok": False, "error": f"top must be a positive integer, got {top_raw!r}"}
-                    )
-                    + "\n"
-                ).encode(), 400
+        top, error = _parse_top(params)
+        if error is not None:
+            return error
         tenant = params.get("tenant", [None])[0]
         programs = telemetry.cost_by_program()
         tenants = telemetry.cost_by_tenant()
@@ -306,6 +321,28 @@ class _Handler(BaseHTTPRequestHandler):
             "replica": telemetry.replica_instance(),
             "host": telemetry.host_name(),
         }
+        return (json.dumps(payload, default=str) + "\n").encode(), 200
+
+    @staticmethod
+    def _programs(query: str = "") -> tuple[bytes, int]:
+        """The compiled-program card table joined with the observed cost
+        ledger, as JSON — the machine-readable face of
+        ``costmodel.program_report()`` (``python -m flox_tpu.telemetry
+        programs <scrape>`` tabulates exactly this payload).
+
+        ``?top=K`` keeps the K rows with the most observed device time
+        (malformed = 400, same contract as ``/debug/costs``);
+        ``?program=<substr>`` narrows to labels containing the substring."""
+        from . import costmodel, telemetry
+
+        params = urllib.parse.parse_qs(query)
+        top, error = _parse_top(params)
+        if error is not None:
+            return error
+        program = params.get("program", [None])[0]
+        payload = costmodel.program_report(top=top, program=program)
+        payload["replica"] = telemetry.replica_instance()
+        payload["host"] = telemetry.host_name()
         return (json.dumps(payload, default=str) + "\n").encode(), 200
 
     @staticmethod
@@ -391,6 +428,9 @@ def start_metrics_server(port: int | None = None, host: str = "127.0.0.1") -> in
     # opt-in sampler (OPTIONS["metrics_sample_interval"]) starts with the
     # endpoint it feeds.
     telemetry.seed_saturation_gauges()
+    # the HBM capacity denominator is static per backend: publish it once
+    # at endpoint start so utilization math never reads an absent gauge
+    telemetry.seed_hbm_limit()
     telemetry.start_saturation_sampler()
     return server.port
 

@@ -48,6 +48,9 @@ __all__ = [
     "inject",
     "poke",
     "active",
+    "dispatch_delay_inject",
+    "dispatch_delay_poke",
+    "dispatch_delay_active",
     "serve_inject",
     "serve_poke",
     "serve_active",
@@ -221,6 +224,69 @@ class FlakyLoader:
         """How many times the underlying slab at ``start`` was actually
         requested (fault firings included)."""
         return sum(1 for (s, _e) in self.calls if s == start)
+
+
+# ---------------------------------------------------------------------------
+# dispatch-delay injection: the drift-sentinel substrate
+
+
+@dataclass
+class _DelayPlan:
+    """A deterministic dispatch slowdown: program labels containing
+    ``substr`` sleep ``seconds`` before their device dispatch ``times``
+    times (-1 = always). The substrate for ``costmodel.drift_report``
+    tests — the observed wall honestly diverges from the analytical model
+    because the dispatch really was that slow."""
+
+    substr: str = ""
+    seconds: float = 0.0
+    times: int = -1
+    fired: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+
+_DELAY_PLAN: _DelayPlan | None = None
+
+
+def dispatch_delay_active() -> bool:
+    return _DELAY_PLAN is not None
+
+
+def dispatch_delay_poke(label: str) -> None:
+    """Dispatch-side hook (``core.chunk_reduce`` calls this just before the
+    eager bundle dispatch with its program label). No-op unless a plan is
+    installed via :func:`dispatch_delay_inject`."""
+    plan = _DELAY_PLAN
+    if plan is None or plan.substr not in str(label):
+        return
+    with plan._lock:
+        if plan.times == 0:
+            return
+        if plan.times > 0:
+            plan.times -= 1
+        plan.fired += 1
+        seconds = plan.seconds
+    import time
+
+    time.sleep(seconds)
+
+
+@contextlib.contextmanager
+def dispatch_delay_inject(
+    substr: str, seconds: float, *, times: int = -1
+) -> Iterator[_DelayPlan]:
+    """Install a deterministic dispatch-delay plan for the scope: every
+    dispatch whose program label contains ``substr`` sleeps ``seconds``
+    first, ``times`` times (-1 = for the whole scope). Yields the plan;
+    ``fired`` counts the injected delays."""
+    global _DELAY_PLAN
+    plan = _DelayPlan(substr=str(substr), seconds=float(seconds), times=int(times))
+    prev = _DELAY_PLAN
+    _DELAY_PLAN = plan
+    try:
+        yield plan
+    finally:
+        _DELAY_PLAN = prev
 
 
 # ---------------------------------------------------------------------------

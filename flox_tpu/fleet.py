@@ -61,6 +61,7 @@ __all__ = [
     "parse_metrics_text",
     "render_prometheus",
     "render_top",
+    "render_top_json",
 ]
 
 
@@ -293,6 +294,7 @@ class ReplicaSnapshot:
     ready_reason: str = ""
     metrics: dict = field(default_factory=dict)
     costs: dict = field(default_factory=dict)
+    programs: dict = field(default_factory=dict)
     scraped_at: float = 0.0
 
     @property
@@ -325,6 +327,11 @@ def scrape_replica(name: str, url: str, timeout: float = 5.0) -> ReplicaSnapshot
         status, body = _http_get(f"{snap.url}/debug/costs", timeout)
         if status == 200:
             snap.costs = json.loads(body)
+        # compiled-program cards (costmodel plane): absent on replicas
+        # running with the plane off — an empty table, never a scrape fail
+        status, body = _http_get(f"{snap.url}/debug/programs", timeout)
+        if status == 200:
+            snap.programs = json.loads(body).get("programs") or {}
         status, body = _http_get(f"{snap.url}/readyz", timeout)
         snap.ready = status == 200
         snap.ready_reason = body.strip()
@@ -352,6 +359,7 @@ def federate(snapshots: list[ReplicaSnapshot]) -> dict[str, Any]:
         "cost_by_program": {},
         "cost_by_tenant": {},
         "cost_by_replica": {},
+        "programs": {},     # card digest -> {card fields, labels, observed merged}
         "replicas": [],
     }
     for snap in snapshots:
@@ -399,6 +407,8 @@ def federate(snapshots: list[ReplicaSnapshot]) -> dict[str, Any]:
                 view["cost_by_replica"].setdefault(axis, {}).setdefault(
                     row_key, {}
                 )[label] = dict(row)
+        for prog_label, row in (snap.programs or {}).items():
+            _merge_program_row(view["programs"], prog_label, row)
     # a merge error poisons EVERY label set of its metric: sibling keys
     # processed before the error still hold a partial (first-replicas-only)
     # merged histogram, and publishing that as the fleet aggregate would be
@@ -407,6 +417,72 @@ def federate(snapshots: list[ReplicaSnapshot]) -> dict[str, Any]:
         if metric in view["merge_errors"]:
             slot["merged"] = None
     return view
+
+
+def _merge_program_row(table: dict, label: str, row: dict) -> None:
+    """Union one replica's compiled-program card row into the fleet view.
+
+    Cards union by DIGEST (the (label, input signature) identity — two
+    replicas serving the same program hold byte-identical analytical
+    numbers, so the card fields come from whichever scraped first), labels
+    accumulate, and the observed ledger rows merge exactly like cost rows.
+    Utilization and drift recompute from the merged totals: utilization is
+    model-time / observed-time, so ``predicted_ms x dispatches /
+    device_ms`` holds across replicas."""
+    digest = str(row.get("digest") or f"?{label}")
+    held = table.get(digest)
+    if held is None:
+        # card fields only: the observed-JOIN fields (utilization,
+        # achieved_*, drift) are per-replica numbers and must be
+        # recomputed from the merged totals below, never copied from
+        # whichever replica scraped first
+        held = table[digest] = {
+            k: v
+            for k, v in row.items()
+            if k
+            not in (
+                "observed", "label", "utilization", "achieved_gbps",
+                "achieved_gflops", "observed_ms_per_dispatch", "drift_ratio",
+            )
+        }
+        held["digest"] = digest  # present even for rows scraped without one
+        held["labels"] = []
+        held["observed"] = None
+    if label not in held["labels"]:
+        held["labels"].append(label)
+    observed = row.get("observed")
+    if observed:
+        held["observed"] = (
+            dict(observed)
+            if held["observed"] is None
+            else merge_cost_rows(held["observed"], observed)
+        )
+        merged = held["observed"]
+        dispatches = int(merged.get("dispatches", 0))
+        # compile-net, mirroring costmodel._net_device_ms: the merged row
+        # carries the fleet's compile wall too, and cold replicas must not
+        # read as fleet-wide drift
+        device_ms = max(
+            0.0,
+            float(merged.get("device_ms", 0.0)) - float(merged.get("compile_ms", 0.0)),
+        )
+        predicted = float(held.get("predicted_ms") or 0.0)
+        if dispatches > 0 and device_ms > 0:
+            held["utilization"] = round(predicted * dispatches / device_ms, 6)
+            held["observed_ms_per_dispatch"] = round(device_ms / dispatches, 6)
+            seconds = device_ms / 1e3
+            held["achieved_gbps"] = round(
+                float(held.get("bytes_accessed") or 0.0) * dispatches / seconds / 1e9, 6
+            )
+            held["achieved_gflops"] = round(
+                float(held.get("flops") or 0.0) * dispatches / seconds / 1e9, 6
+            )
+            model_ms = row.get("model_ms")
+            if model_ms:
+                held["model_ms"] = float(model_ms)
+                held["drift_ratio"] = round(
+                    (device_ms / dispatches) / float(model_ms), 6
+                )
 
 
 # ---------------------------------------------------------------------------
@@ -530,26 +606,77 @@ def render_top(
     """One ops-console frame: per-replica vitals + the fleet's top cost
     rows. ``prev``/``interval`` turn the monotonically increasing
     ``serve.requests`` counter into a qps column (blank on the first
-    frame)."""
-
-    def counter(view_: dict, metric: str, replica: str) -> float:
-        slot = view_.get("counters", {}).get((metric, ()))
-        if not slot:
-            return 0.0
-        return float(slot["replicas"].get(replica, 0.0))
-
-    def gauge(metric: str, replica: str) -> float:
-        slot = view.get("gauges", {}).get((metric, ()))
-        return float(slot["replicas"].get(replica, 0.0)) if slot else 0.0
-
+    frame). This is the ANSI *formatting* of exactly the dict
+    :func:`render_top_json` builds — the two views cannot drift."""
+    frame = render_top_json(view, prev=prev, interval=interval, top=top)
     lines = [
-        f"flox_tpu fleet — {len(view.get('replicas', []))} replica(s), "
+        f"flox_tpu fleet — {len(frame['replicas'])} replica(s), "
         f"{time.strftime('%H:%M:%S')}",
         "",
         f"{'replica':<16} {'state':<12} {'qps':>7} {'p50 ms':>9} {'p99 ms':>9} "
         f"{'queue':>6} {'brk':>4} {'hbm':>10}  endpoint",
         "-" * width,
     ]
+    for row in frame["replicas"]:
+        qps = f"{row['qps']:.1f}" if row["qps"] is not None else ""
+        p50 = f"{row['p50_ms']:.2f}" if row["p50_ms"] is not None else "-"
+        p99 = f"{row['p99_ms']:.2f}" if row["p99_ms"] is not None else "-"
+        hbm = row["hbm_bytes"]
+        limit = row["hbm_bytes_limit"]
+        hbm_s = f"{hbm / 2**30:.2f}GiB" if hbm else "-"
+        if hbm and limit:
+            # the bytes_limit gauge makes the column a fraction of capacity
+            hbm_s = f"{hbm / 2**30:.2f}G/{100 * hbm / limit:.0f}%"
+        lines.append(
+            f"{row['replica'][:16]:<16} {row['state'][:12]:<12} {qps:>7} "
+            f"{p50:>9} {p99:>9} {row['queue_depth']:>6} "
+            f"{row['breakers_open']:>4} {hbm_s:>10}  {row['url']}"
+        )
+    lines += [
+        "",
+        f"top {top} cost rows (fleet-unioned /debug/costs, by device time):",
+        f"{'program key':<46} {'disp':>6} {'device ms':>11} {'MBytes':>9} "
+        f"{'util':>7}  slow trace",
+        "-" * width,
+    ]
+    if not frame["top_costs"]:
+        lines.append("  (no cost rows yet)")
+    for row in frame["top_costs"]:
+        util = row["utilization"]
+        lines.append(
+            f"{row['program'][:46]:<46} {row['dispatches']:>6} "
+            f"{row['device_ms']:>11.2f} "
+            f"{row['bytes'] / 1e6:>9.2f} "
+            f"{('%.1f%%' % (100 * util)) if util is not None else '-':>7}  "
+            f"{str(row['last_slow_trace'] or '-')[:24]}"
+        )
+    if frame["merge_errors"]:
+        lines += ["", "merge errors (per-replica series kept, fleet sum withheld):"]
+        for metric, err in sorted(frame["merge_errors"].items()):
+            lines.append(f"  {metric}: {err[:width - 4]}")
+    return "\n".join(lines)
+
+
+def render_top_json(
+    view: dict[str, Any],
+    prev: dict[str, Any] | None = None,
+    interval: float = 0.0,
+    top: int = 5,
+) -> dict[str, Any]:
+    """The ops-console frame as a JSON-safe object (``fleet top --json``):
+    the same per-replica vitals and fleet-unioned top cost rows the ANSI
+    frame renders, shaped for alerting scripts instead of eyeballs. ``qps``
+    is ``None`` on the first frame (no prior counter sample to diff)."""
+
+    def counter(view_: dict, metric: str, replica: str) -> float:
+        slot = view_.get("counters", {}).get((metric, ()))
+        return float(slot["replicas"].get(replica, 0.0)) if slot else 0.0
+
+    def gauge(metric: str, replica: str) -> float:
+        slot = view.get("gauges", {}).get((metric, ()))
+        return float(slot["replicas"].get(replica, 0.0)) if slot else 0.0
+
+    replicas = []
     for row in view.get("replicas", []):
         label = row["replica"]
         if not row.get("ok"):
@@ -558,28 +685,41 @@ def render_top(
             state = "ready"
         else:
             state = row.get("reason") or "not-ready"
-        qps = ""
+        qps = None
         if prev is not None and interval > 0:
             delta = counter(view, "flox_tpu_serve_requests_total", label) - counter(
                 prev, "flox_tpu_serve_requests_total", label
             )
-            qps = f"{max(0.0, delta) / interval:.1f}"
+            qps = round(max(0.0, delta) / interval, 3)
         hist = (
             view.get("histograms", {})
             .get(("flox_tpu_serve_request_ms", ()), {})
             .get("replicas", {})
             .get(label)
         )
-        p50 = f"{_hist_percentile(hist, 0.50):.2f}" if hist else "-"
-        p99 = f"{_hist_percentile(hist, 0.99):.2f}" if hist else "-"
-        hbm = gauge("flox_tpu_hbm_bytes_in_use", label)
-        hbm_s = f"{hbm / 2**30:.2f}GiB" if hbm else "-"
-        lines.append(
-            f"{label[:16]:<16} {state[:12]:<12} {qps:>7} {p50:>9} {p99:>9} "
-            f"{int(gauge('flox_tpu_serve_queue_depth', label)):>6} "
-            f"{int(gauge('flox_tpu_serve_breakers_open', label)):>4} "
-            f"{hbm_s:>10}  {row['url']}"
+        limit = gauge("flox_tpu_hbm_bytes_limit", label)
+        replicas.append(
+            {
+                "replica": label,
+                "url": row["url"],
+                "state": state,
+                "error": row.get("error"),
+                "qps": qps,
+                "p50_ms": round(_hist_percentile(hist, 0.50), 4) if hist else None,
+                "p99_ms": round(_hist_percentile(hist, 0.99), 4) if hist else None,
+                "queue_depth": int(gauge("flox_tpu_serve_queue_depth", label)),
+                "breakers_open": int(gauge("flox_tpu_serve_breakers_open", label)),
+                "hbm_bytes": gauge("flox_tpu_hbm_bytes_in_use", label),
+                "hbm_bytes_limit": limit or None,
+            }
         )
+    util_by_label: dict[str, float] = {}
+    programs = []
+    for digest, prow in sorted(view.get("programs", {}).items()):
+        for plabel in prow.get("labels", []):
+            if prow.get("utilization") is not None:
+                util_by_label[plabel] = float(prow["utilization"])
+        programs.append(dict(prow))
     ranked = sorted(
         view.get("cost_by_program", {}).items(),
         key=lambda kv: (
@@ -587,26 +727,24 @@ def render_top(
             -int(kv[1].get("dispatches", 0)),
         ),
     )[:top]
-    lines += [
-        "",
-        f"top {top} cost rows (fleet-unioned /debug/costs, by device time):",
-        f"{'program key':<52} {'disp':>6} {'device ms':>11} {'MBytes':>9}  slow trace",
-        "-" * width,
+    top_costs = [
+        {
+            "program": label,
+            "dispatches": int(row.get("dispatches", 0)),
+            "device_ms": float(row.get("device_ms", 0.0)),
+            "bytes": float(row.get("bytes", 0)),
+            "utilization": util_by_label.get(label),
+            "last_slow_trace": row.get("last_slow_trace"),
+        }
+        for label, row in ranked
     ]
-    if not ranked:
-        lines.append("  (no cost rows yet)")
-    for label, row in ranked:
-        lines.append(
-            f"{label[:52]:<52} {int(row.get('dispatches', 0)):>6} "
-            f"{float(row.get('device_ms', 0.0)):>11.2f} "
-            f"{float(row.get('bytes', 0)) / 1e6:>9.2f}  "
-            f"{str(row.get('last_slow_trace') or '-')[:24]}"
-        )
-    if view.get("merge_errors"):
-        lines += ["", "merge errors (per-replica series kept, fleet sum withheld):"]
-        for metric, err in sorted(view["merge_errors"].items()):
-            lines.append(f"  {metric}: {err[:width - 4]}")
-    return "\n".join(lines)
+    return {
+        "ts": time.time(),
+        "replicas": replicas,
+        "top_costs": top_costs,
+        "programs": programs,
+        "merge_errors": dict(view.get("merge_errors", {})),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -858,6 +996,12 @@ def main(argv: list[str] | None = None) -> int:
         "--plain", action="store_true",
         help="never clear the screen between frames (logs, pipes)",
     )
+    top_cmd.add_argument(
+        "--json", action="store_true",
+        help="emit one JSON document per frame instead of the ANSI console "
+        "— alerting scripts consume per-replica state without scraping the "
+        "frame (implies --plain)",
+    )
     args = parser.parse_args(argv)
     try:
         targets = parse_replica_targets(args.replicas or OPTIONS["fleet_replicas"])
@@ -891,13 +1035,23 @@ def main(argv: list[str] | None = None) -> int:
         while True:
             t0 = time.time()
             view = federator.scrape_once()
-            frame = render_top(
-                view, prev=prev,
-                interval=federator.interval if prev is not None else 0.0,
-                top=args.top,
-            )
-            if not args.plain:
-                print("\x1b[2J\x1b[H", end="")
+            if args.json:
+                frame = json.dumps(
+                    render_top_json(
+                        view, prev=prev,
+                        interval=federator.interval if prev is not None else 0.0,
+                        top=args.top,
+                    ),
+                    default=str,
+                )
+            else:
+                frame = render_top(
+                    view, prev=prev,
+                    interval=federator.interval if prev is not None else 0.0,
+                    top=args.top,
+                )
+                if not args.plain:
+                    print("\x1b[2J\x1b[H", end="")
             print(frame, flush=True)
             if args.once:
                 return 0

@@ -366,6 +366,31 @@ OPTIONS: dict[str, Any] = {
     # allowed: "a=http://...") consumed when `fleet federate` / `fleet
     # top` get no --replicas flag. None requires the flag.
     "fleet_replicas": os.environ.get("FLOX_TPU_FLEET_REPLICAS") or None,
+    # Analytical cost model (flox_tpu/costmodel.py): when on (with
+    # telemetry), every compile site records a compiled-program card
+    # (XLA's analytical flops / bytes accessed / memory footprint via
+    # Compiled.cost_analysis()/memory_analysis(), a roofline predicted_ms)
+    # and dispatches publish program.utilization / program.predicted_ms
+    # gauges plus the /debug/programs surface. The analysis pass compiles
+    # each unique program ONE extra time purely for inspection (never
+    # executed; counted on costmodel.card_* — jax.compiles untouched), so
+    # the plane is opt-in. Off (the default) is a true no-op.
+    "costmodel": bool(_env_int("FLOX_TPU_COSTMODEL", 0, 0, 1)),
+    # drift-sentinel flag ratio: a program whose observed per-dispatch
+    # device time exceeds threshold x the model (roofline prediction
+    # floored at costmodel_overhead_ms) is flagged by
+    # costmodel.drift_report — the "silently got 10x slower after a JAX
+    # upgrade" detector
+    "costmodel_drift_threshold": _env_float(
+        "FLOX_TPU_COSTMODEL_DRIFT_THRESHOLD", 10.0, 1.0, 1e6
+    ),
+    # dispatch-overhead floor (ms) for the drift model: microsecond-scale
+    # analytical predictions are floored here before the ratio, so tiny
+    # programs are judged against dispatch overhead (an honest CPU run
+    # must exit clean) while genuinely slow programs still flag
+    "costmodel_overhead_ms": _env_float(
+        "FLOX_TPU_COSTMODEL_OVERHEAD_MS", 25.0, 0.0, 60_000.0
+    ),
 }
 
 # single source of truth for the accumulation disciplines — referenced by
@@ -455,6 +480,12 @@ _VALIDATORS = {
     "fleet_scrape_interval": lambda x: _is_finite_num(x) and 0.05 <= x <= 3600,
     "fleet_port": lambda x: _is_int(x) and 0 <= x <= 65535,
     "fleet_replicas": lambda x: x is None or (isinstance(x, str) and bool(x)),
+    # cost-model knobs: same at-set-time discipline — a non-bool switch, a
+    # sub-1x drift threshold (everything would flag), or a negative
+    # overhead floor raises here, not inside the dispatch-time gauge join
+    "costmodel": lambda x: isinstance(x, bool),
+    "costmodel_drift_threshold": lambda x: _is_finite_num(x) and 1 <= x <= 1e6,
+    "costmodel_overhead_ms": lambda x: _is_finite_num(x) and 0 <= x <= 60_000,
 }
 
 # rebind the literal through the overlay-aware view: same object contents,

@@ -463,9 +463,27 @@ def segment_multistat_pallas(
     return crop(sums), crop(nan_c), crop(pos_c), crop(neg_c), crop(mins), crop(maxs)
 
 
+def _probe_card(label: str, compiled, compile_ms: float) -> None:
+    """Record the probe executable's analytical card (costmodel plane):
+    the probe already holds a ``Compiled`` in hand, so the card costs one
+    ``cost_analysis()`` read — no extra compile. No-op when the plane is
+    off; never raises (probe contract)."""
+    try:
+        from . import costmodel
+
+        if costmodel.enabled():
+            costmodel.record_compiled(
+                label, compiled, compile_ms=compile_ms, sig="probe"
+            )
+    except Exception:  # noqa: BLE001 — observability never fails a probe
+        pass
+
+
 def probe_compile_multistat() -> None:
     """Compile-only probe for the multi-statistic megakernel (see
     probe_compile)."""
+    import time
+
     import jax
     import jax.numpy as jnp
 
@@ -475,10 +493,12 @@ def probe_compile_multistat() -> None:
         128, 128, 2, 8, "float32", "float32", 128, 128, False,
         str(OPTIONS["pallas_accum"]),
     )
-    fn.lower(
+    t0 = time.perf_counter()
+    compiled = fn.lower(
         jax.ShapeDtypeStruct((1, 128), jnp.int32),
         jax.ShapeDtypeStruct((128, 128), jnp.float32),
     ).compile()
+    _probe_card("pallas[multistat]", compiled, (time.perf_counter() - t0) * 1e3)
 
 
 def _scan_kernel(
@@ -788,32 +808,42 @@ def segment_cumsum_pallas(data, codes, size: int, *, skipna: bool, interpret: bo
 
 def probe_compile_scan() -> None:
     """Compile-only probe for the scan kernel (see probe_compile)."""
+    import time
+
     import jax
     import jax.numpy as jnp
 
     fn = _build_scan(128, 128, 128, 8, "float32", "float32", 128, 128, False, False)
-    fn.lower(
+    t0 = time.perf_counter()
+    compiled = fn.lower(
         jax.ShapeDtypeStruct((1, 128), jnp.int32),
         jax.ShapeDtypeStruct((128, 128), jnp.float32),
     ).compile()
+    _probe_card("pallas[scan]", compiled, (time.perf_counter() - t0) * 1e3)
 
 
 def probe_compile_minmax() -> None:
     """Compile-only probe for the min/max kernel (see probe_compile)."""
+    import time
+
     import jax
     import jax.numpy as jnp
 
     fn = _build_minmax(128, 128, 2, 8, "float32", 128, 128, False, "max")
-    fn.lower(
+    t0 = time.perf_counter()
+    compiled = fn.lower(
         jax.ShapeDtypeStruct((1, 128), jnp.int32),
         jax.ShapeDtypeStruct((128, 128), jnp.float32),
     ).compile()
+    _probe_card("pallas[minmax]", compiled, (time.perf_counter() - t0) * 1e3)
 
 
 def probe_compile() -> None:
     """Lower + compile a tiny instance of the kernel on the real backend
     WITHOUT executing it — safe to call while an outer jit is tracing
     (no concrete arrays are created, so nothing can leak a tracer)."""
+    import time
+
     import jax
     import jax.numpy as jnp
 
@@ -823,10 +853,12 @@ def probe_compile() -> None:
         128, 128, 8, "float32", "float32", 128, 128, False,
         str(OPTIONS["pallas_accum"]),
     )
-    fn.lower(
+    t0 = time.perf_counter()
+    compiled = fn.lower(
         jax.ShapeDtypeStruct((1, 128), jnp.int32),
         jax.ShapeDtypeStruct((128, 128), jnp.float32),
     ).compile()
+    _probe_card("pallas[segment_sum]", compiled, (time.perf_counter() - t0) * 1e3)
 
 
 def segment_sum_pallas(

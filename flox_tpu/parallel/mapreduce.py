@@ -554,11 +554,21 @@ def sharded_groupby_reduce(
         ):
             result = fn(arr, codes_dev)
     if tm_on:
+        # observed wall snapshotted BEFORE the card analysis: its
+        # lower+compile must not bill as device time (it would read as
+        # drift on the first dispatch)
+        dispatch_ms = (perf_counter() - t_dispatch0) * 1e3
         prog = f"mesh[{agg.name}/{method}]"
         telemetry.sample_hbm(program=prog)
+        # analytical card of the SPMD program (costmodel plane): lowering
+        # re-enters the same shard_map closure, so the card reflects the
+        # per-device program actually dispatched
+        from .. import costmodel
+
+        costmodel.ensure_card(prog, fn, (arr, codes_dev))
         telemetry.observe_cost(
             prog,
-            device_ms=(perf_counter() - t_dispatch0) * 1e3,
+            device_ms=dispatch_ms,
             nbytes=int(getattr(arr, "nbytes", 0))
             + int(getattr(codes_dev, "nbytes", 0)),
             compiles=int(telemetry.METRICS.get("jax.compiles") - compiles0),

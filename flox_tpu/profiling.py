@@ -185,8 +185,19 @@ def start_capture(seconds: float = 5.0, root: Any = None) -> str:
             jax.profiler.start_trace(capture_dir)
         except Exception as exc:  # noqa: BLE001 — no profiler on this backend
             raise CaptureUnavailableError(f"profiler unavailable: {exc}") from exc
+        # dispatch-mark snapshot: the finished capture is stamped with
+        # exactly the program labels (and card digests) dispatched during
+        # the window — the join from a capture dir back to /debug/costs
+        # and /debug/programs rows (best-effort; never blocks the start)
+        try:
+            from .costmodel import dispatch_marks
+
+            marks = dispatch_marks()
+        except Exception:  # noqa: BLE001 — stamping is best-effort by contract
+            marks = {}
         _CAPTURE_STATE["active"] = {
             "dir": capture_dir, "seconds": seconds, "started": time.time(),
+            "marks": marks,
         }
 
     def _finish() -> None:
@@ -199,8 +210,22 @@ def start_capture(seconds: float = 5.0, root: Any = None) -> str:
             # the guard must clear either way or no capture ever runs again
             logger.warning("on-demand capture stop failed: %s", exc)
         with _CAPTURE_LOCK:
-            if _CAPTURE_STATE.get("active", {}).get("dir") == capture_dir:
+            active = _CAPTURE_STATE.get("active", {})
+            marks_then = active.get("marks") if active.get("dir") == capture_dir else None
+            if active.get("dir") == capture_dir:
                 _CAPTURE_STATE.pop("active", None)
+        if marks_then is not None:
+            # stamp the capture with the programs dispatched inside the
+            # window (cumulative ledger dispatches minus the start marks),
+            # each with its card digest — documented in the capture
+            # runbook. A vanished guard (cache.clear_all mid-window) has
+            # no baseline: skip rather than attribute history to the window
+            try:
+                from .costmodel import stamp_capture
+
+                stamp_capture(capture_dir, marks_then)
+            except Exception:  # noqa: BLE001 — stamping never breaks a capture
+                pass
         telemetry.count("profile.captures")
         telemetry.event("profile.capture", dir=capture_dir, seconds=seconds)
 

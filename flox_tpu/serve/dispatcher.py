@@ -922,16 +922,29 @@ class Dispatcher:
         # must not make the post-dispatch block read baselines that were
         # never taken (same discipline as core.chunk_reduce)
         tm_on = telemetry.enabled()
+        prog = None
         if tm_on:
             # cost-ledger baseline for this dispatch's compile delta
             compiles0 = telemetry.METRICS.get("jax.compiles")
             compile_ms0 = telemetry.METRICS.get("jax.compile_ms")
+            # the serve program key, computed BEFORE the dispatch so the
+            # costmodel alias can index whatever program compiles inside
+            # it under this serving label (the /debug/programs join key)
+            pdigest = _digest_bytes(repr(batch.pkey).encode())[:8]
+            prog = "serve[" + _func_label(batch.func) + f"#{pdigest}]"
+            # card-analysis baseline: the costmodel's lower+compile runs
+            # INSIDE this window (chunk_reduce/fusion record cards mid-
+            # dispatch) but is bookkeeping, not served work — net its wall
+            # out of device_ms below, like the compile wall is netted by
+            # the drift model
+            analysis0 = telemetry.METRICS.get("costmodel.card_analysis_ms")
         t0 = time.perf_counter()
         from ..core import groupby_reduce
+        from ..costmodel import serve_alias
 
         kwargs = {k: v for k, v in batch.agg_kwargs.items() if v is not None}
         multi = _is_multi(batch.func)
-        with options.scoped(**batch.overrides):
+        with serve_alias(prog), options.scoped(**batch.overrides):
             with telemetry.span(
                 "serve.execute", func=_func_label(batch.func), batch=len(live),
             ):
@@ -972,12 +985,18 @@ class Dispatcher:
         groups = np.asarray(groups)
         device_ms = (time.perf_counter() - t0) * 1e3
         if tm_on:
+            device_ms = max(
+                0.0,
+                device_ms
+                - (
+                    telemetry.METRICS.get("costmodel.card_analysis_ms")
+                    - analysis0
+                ),
+            )
             # HBM pressure right after the dispatch, attributed to THIS
             # program key (cache.stats()["hbm_by_program"]): the digest
             # keeps the label bounded while separating shape/dtype/option
             # variants. Gated: the repr+hash must cost nothing when off.
-            pdigest = _digest_bytes(repr(batch.pkey).encode())[:8]
-            prog = "serve[" + _func_label(batch.func) + f"#{pdigest}]"
             telemetry.sample_hbm(program=prog)
             # the program's cost-ledger row: one dispatch (however many
             # coalesced/batched waiters it served), its device wall, the

@@ -547,7 +547,32 @@ def _streaming_groupby_reduce_impl(
     done = skip
     throttle = DispatchThrottle()
 
+    from . import costmodel
+
+    # the cost-ledger key pipeline.stream_slabs bills this stream under —
+    # the card label must match it exactly or the roofline join misses.
+    # The step arguments are captured as ShapeDtypeStructs DURING the loop
+    # but the card (one lower+compile for analysis) is recorded AFTER it,
+    # so the analysis wall never lands in the pass's billed dispatch time.
+    stream_prog = f"stream[reduce[{agg.name}]]"
+    card_capture: list = []
+
     def apply_step(st, sb):
+        if costmodel.enabled() and len(card_capture) < 2:
+            # first slab captures the init program, second the steady-state
+            # carry program (the one that dominates a long stream) — mesh
+            # runners expose both on _jitted/_jitted_init, the single-device
+            # step covers both arities through one jitted function
+            if st is None and hasattr(step, "_jitted_init"):
+                card_capture.append((
+                    step._jitted_init,
+                    costmodel.aval_args((sb.data, sb.codes, sb.offset)),
+                ))
+            else:
+                card_capture.append((
+                    getattr(step, "_jitted", None),
+                    costmodel.aval_args((st, sb.data, sb.codes, sb.offset)),
+                ))
         return step(st, sb.data, sb.codes, sb.offset)
 
     with timed(f"stream [{agg.name}] {nbatches} slab(s) x {batch_len}"):
@@ -568,6 +593,12 @@ def _streaming_groupby_reduce_impl(
             throttle.tick(state)
             done += 1
             ckpt.tick(lambda: state, slabs_done=done)
+
+    if card_capture:
+        # steady-state program preferred (the capture list's tail); the
+        # analysis compile runs here, outside the stream's timed window
+        fn, sds = card_capture[-1]
+        costmodel.ensure_card(stream_prog, fn, sds)
 
     out_shape = tuple(lead_shape) + tuple(keep_by_shape) + grp_shape
     if mesh is not None:
@@ -841,6 +872,10 @@ def _mesh_step_runner(local_step, mesh, slab_spec, spec_entry):
             return init_fn(slab, ccodes, offset)
         return step_fn(state, slab, ccodes, offset)
 
+    # the costmodel card site lowers the underlying jitted programs (the
+    # steady-state carry step and the first-slab init) without executing
+    run._jitted = step_fn
+    run._jitted_init = init_fn
     return run
 
 

@@ -95,6 +95,7 @@ __all__ = [
     "MetricsRegistry",
     "anchor_event",
     "annotated",
+    "card_compile_accounting",
     "cost_by_program",
     "cost_by_tenant",
     "count",
@@ -122,6 +123,7 @@ __all__ = [
     "reset",
     "sample_hbm",
     "sample_saturation",
+    "seed_hbm_limit",
     "seed_saturation_gauges",
     "span",
     "spans",
@@ -1046,6 +1048,7 @@ def observe_cost(
     if not enabled():
         return
     trace_id = _TRACE.get()
+    program_entry: dict | None = None
     with _RECORDS_LOCK:
         for axis, label in (("program", program), ("tenant", tenant)):
             if label is None:
@@ -1060,6 +1063,19 @@ def observe_cost(
                 entry["device_ms_max"] = float(device_ms)
                 if trace_id is not None:
                     entry["last_slow_trace"] = trace_id
+            if axis == "program":
+                program_entry = dict(entry)
+    if program is not None and program_entry is not None:
+        from .options import OPTIONS
+
+        if OPTIONS["costmodel"]:
+            # roofline join at dispatch time: the ledger row meets its
+            # compiled-program card and the program.utilization /
+            # program.predicted_ms gauges update (outside the ledger lock
+            # — the registry takes its own)
+            from . import costmodel
+
+            costmodel.publish_gauges(str(program), program_entry)
 
 
 def _ledger_axis(axis: str) -> dict[str, dict]:
@@ -1140,11 +1156,32 @@ def sample_hbm(program: str | None = None) -> None:
     peak = float(stats.get("peak_bytes_in_use", in_use))
     METRICS.set_gauge("hbm.bytes_in_use", in_use)
     METRICS.max_gauge("hbm.peak_bytes_in_use", peak)
+    limit = stats.get("bytes_limit")
+    if limit:
+        # per-device capacity summed by device.memory_stats(): the
+        # denominator that makes the in-use gauge an HBM fraction
+        METRICS.set_gauge("hbm.bytes_limit", float(limit))
     if program is not None:
         with _RECORDS_LOCK:
             entry = _cost_entry("program", program)
             if in_use > entry["hbm_peak"]:
                 entry["hbm_peak"] = in_use
+
+
+def seed_hbm_limit() -> None:
+    """Publish the ``hbm.bytes_limit`` gauge (per-device HBM capacity
+    summed by ``device.memory_stats()``) once, at metrics-server start —
+    utilization math and the ``fleet top`` HBM column need the denominator
+    BEFORE the first dispatch samples it. No-op while telemetry is off or
+    when no device reports a capacity (CPU)."""
+    if not enabled():
+        return
+    from . import device
+
+    stats = device.memory_stats()
+    limit = (stats or {}).get("bytes_limit")
+    if limit:
+        METRICS.set_gauge("hbm.bytes_limit", float(limit))
 
 
 def hbm_by_program() -> dict[str, float]:
@@ -1360,6 +1397,32 @@ def _bootstrap() -> None:
         _install_jax_listener()
 
 
+#: thread-local compile-accounting route: the costmodel's card analysis
+#: lowers+compiles programs that are never executed — those compile events
+#: must count on ``costmodel.card_*``, not ``jax.compiles`` (whose value
+#: the AOT zero-compile acceptance and the per-program ledger depend on).
+#: Thread-local because jax compiles synchronously on the calling thread,
+#: so the monitoring events fire on the thread that set the route.
+_COMPILE_ROUTE = threading.local()
+
+
+class card_compile_accounting:
+    """Scope under which jax compile/trace monitoring events count on the
+    ``costmodel.card_*`` counters instead of ``jax.compiles``/``jax.traces``
+    — the costmodel's analysis compiles are bookkeeping, not served work."""
+
+    __slots__ = ("_prev",)
+
+    def __enter__(self) -> "card_compile_accounting":
+        self._prev = getattr(_COMPILE_ROUTE, "route", None)
+        _COMPILE_ROUTE.route = "costmodel"
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        _COMPILE_ROUTE.route = self._prev
+        return False
+
+
 def _install_jax_listener() -> None:
     """Count every backend compile / jaxpr trace the process performs.
 
@@ -1375,6 +1438,15 @@ def _install_jax_listener() -> None:
     def _on_duration(name: str, duration_s: float, **kw: Any) -> None:
         if not enabled():
             return
+        if getattr(_COMPILE_ROUTE, "route", None) == "costmodel":
+            # card-analysis compiles: real wall, but not served programs —
+            # routed so `jax.compiles` keeps meaning NEW backend work
+            if name.endswith("backend_compile_duration"):
+                METRICS.inc("costmodel.card_compiles")
+                METRICS.inc("costmodel.card_compile_ms", duration_s * 1e3)
+            elif name.endswith("jaxpr_trace_duration"):
+                METRICS.inc("costmodel.card_traces")
+            return
         if name.endswith("backend_compile_duration"):
             METRICS.inc("jax.compiles")
             METRICS.inc("jax.compile_ms", duration_s * 1e3)
@@ -1386,6 +1458,12 @@ def _install_jax_listener() -> None:
 
     def _on_event(name: str, **kw: Any) -> None:
         if not enabled():
+            return
+        if getattr(_COMPILE_ROUTE, "route", None) == "costmodel":
+            # a card compile served from the persistent cache must not net
+            # -1 against jax.compiles (its +1 was routed away above)
+            if name.endswith("compilation_cache/cache_hits"):
+                METRICS.inc("costmodel.card_cache_hits")
             return
         if name.endswith("compilation_cache/cache_hits"):
             # jax fires backend_compile_duration even when the persistent
@@ -1440,6 +1518,12 @@ def reset() -> None:
         _TENANT_LABELS.clear()
     FLIGHT_RECORDER.clear()
     METRICS.reset()
+    # the compiled-program cards annotate the ledger being dropped; a
+    # reset must not leave cards pointing at vanished observations
+    from .costmodel import _CARD_LABELS, _CARD_REGISTRY
+
+    _CARD_REGISTRY.clear()
+    _CARD_LABELS.clear()
 
 
 def _counters_record() -> dict:
@@ -1856,6 +1940,99 @@ def _cost_lines(
     return lines
 
 
+def _load_programs(path: str | None) -> tuple[dict, str | None]:
+    """(program rows, replica stamp) — from a ``/debug/programs`` scrape
+    (possibly ``?top=``/``?program=``-filtered) or a bare ``{label: row}``
+    mapping; with no file, the live in-process card/ledger join."""
+    if path is None:
+        from . import costmodel
+
+        report = costmodel.program_report()
+        return report["programs"], None
+    with open(path) as f:
+        payload = json.load(f)
+    if not isinstance(payload, dict):
+        raise ValueError(
+            f"{path}: expected a JSON object, got {type(payload).__name__}"
+        )
+    if "programs" in payload:
+        return payload.get("programs") or {}, payload.get("replica")
+    return payload, None
+
+
+def _program_lines(
+    rows: dict, top: int | None = None, source: str = "live process"
+) -> list[str]:
+    """The ``programs`` CLI table: compiled-program cards joined with the
+    observed ledger, ranked by observed device time — the operator's
+    answer to "is this program GOOD, not just how long did it take"."""
+    ranked = sorted(
+        rows.items(),
+        key=lambda kv: (
+            -float((kv[1].get("observed") or {}).get("device_ms", 0.0)),
+            -int((kv[1].get("observed") or {}).get("dispatches", 0)),
+            kv[0],
+        ),
+    )
+    dropped = 0
+    if top is not None:
+        dropped = max(0, len(ranked) - top)
+        ranked = ranked[:top]
+    lines = [
+        f"compiled-program cards — {source}",
+        "",
+        f"{'program':<40} {'flops':>11} {'MB acc':>8} {'pred ms':>9} "
+        f"{'obs ms/disp':>12} {'util':>7} {'drift':>7} {'disp':>6}  analysis",
+        "-" * 118,
+    ]
+    if not ranked:
+        lines.append("  (no program cards recorded)")
+    for label, row in ranked:
+        obs = row.get("observed") or {}
+        obs_ms = row.get("observed_ms_per_dispatch")
+        util = row.get("utilization")
+        drift = row.get("drift_ratio")
+        lines.append(
+            f"{label[:40]:<40} {float(row.get('flops', 0.0)):>11.3g} "
+            f"{float(row.get('bytes_accessed', 0.0)) / 1e6:>8.2f} "
+            f"{float(row.get('predicted_ms', 0.0)):>9.4f} "
+            f"{('%.3f' % obs_ms) if obs_ms is not None else '-':>12} "
+            f"{('%.1f%%' % (100 * util)) if util is not None else '-':>7} "
+            f"{('%.1fx' % drift) if drift is not None else '-':>7} "
+            f"{int(obs.get('dispatches', 0)):>6}  {str(row.get('analysis', '?'))[:20]}"
+        )
+    if dropped:
+        lines.append(f"  ... {dropped} more program row(s) below --top")
+    return lines
+
+
+def _drift_lines(report: dict) -> list[str]:
+    """The drift-sentinel table (``programs --drift``)."""
+    lines = [
+        f"drift sentinel — threshold {report['threshold']:g}x, "
+        f"overhead floor {report['overhead_ms']:g} ms",
+        "",
+        f"{'program':<44} {'obs ms/disp':>12} {'model ms':>10} {'drift':>8}  verdict",
+        "-" * 92,
+    ]
+    if not report["rows"]:
+        lines.append("  (no program has both a card and observed dispatches)")
+    for row in report["rows"]:
+        lines.append(
+            f"{row['program'][:44]:<44} "
+            f"{float(row.get('observed_ms_per_dispatch') or 0.0):>12.3f} "
+            f"{float(row.get('model_ms') or 0.0):>10.4f} "
+            f"{float(row.get('drift_ratio') or 0.0):>7.1f}x  "
+            f"{'DRIFT' if row['flagged'] else 'ok'}"
+        )
+    if report["flagged"]:
+        lines += ["", f"{len(report['flagged'])} program(s) flagged: "
+                  + ", ".join(report["flagged"])]
+    else:
+        lines += ["", "clean: no program diverges from the model"]
+    return lines
+
+
 def _fmt_bytes(value: Any) -> str:
     value = float(value or 0.0)
     if value <= 0:
@@ -1895,6 +2072,31 @@ def main(argv: list[str] | None = None) -> int:
         "--top", type=int, default=None, metavar="K",
         help="show only the K most expensive rows per axis",
     )
+    progs = sub.add_parser(
+        "programs",
+        help="compiled-program card table (analytical flops/bytes, roofline "
+        "predicted ms, observed-vs-predicted drift) — reads a "
+        "/debug/programs scrape, or the live in-process registry when no "
+        "file is given",
+    )
+    progs.add_argument(
+        "file", nargs="?", default=None,
+        help="a /debug/programs JSON scrape (default: the live registry)",
+    )
+    progs.add_argument(
+        "--top", type=int, default=None, metavar="K",
+        help="show only the K rows with the most observed device time",
+    )
+    progs.add_argument(
+        "--drift", action="store_true",
+        help="run the drift sentinel over the rows instead: exit 2 when any "
+        "program's observed time diverges past the threshold, 0 when clean",
+    )
+    progs.add_argument(
+        "--threshold", type=float, default=None, metavar="N",
+        help="drift ratio that flags a program (default: "
+        "OPTIONS['costmodel_drift_threshold'])",
+    )
     srv = sub.add_parser(
         "serve-metrics",
         help="standalone /metrics + /healthz + /readyz HTTP endpoint "
@@ -1920,6 +2122,29 @@ def main(argv: list[str] | None = None) -> int:
             parser.error(f"cannot read {args.file}: {exc}")
         except (ValueError, KeyError, TypeError, AttributeError) as exc:
             parser.error(f"{args.file} is not a readable cost export: {exc}")
+        print("\n".join(lines))
+        return 0
+    if args.command == "programs":
+        if args.top is not None and args.top < 1:
+            parser.error("--top must be >= 1")
+        if args.threshold is not None and args.threshold <= 0:
+            parser.error("--threshold must be > 0")
+        try:
+            rows, replica = _load_programs(args.file)
+            source = args.file or "live process"
+            if replica:
+                source = f"{source} (replica {replica})"
+            if args.drift:
+                from . import costmodel
+
+                report = costmodel.drift_report(rows, threshold=args.threshold)
+                print("\n".join(_drift_lines(report)))
+                return 2 if report["flagged"] else 0
+            lines = _program_lines(rows, top=args.top, source=source)
+        except OSError as exc:
+            parser.error(f"cannot read {args.file}: {exc}")
+        except (ValueError, KeyError, TypeError, AttributeError) as exc:
+            parser.error(f"{args.file} is not a readable program-card export: {exc}")
         print("\n".join(lines))
         return 0
     if args.command == "serve-metrics":

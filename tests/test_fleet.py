@@ -503,13 +503,16 @@ class TestHistogramMergeMath:
 
 
 class _FakeReplica:
-    """A canned replica endpoint: /metrics + /debug/costs + /readyz."""
+    """A canned replica endpoint: /metrics + /debug/costs +
+    /debug/programs + /readyz."""
 
-    def __init__(self, metrics_text, costs=None, ready=True, reason="ready"):
+    def __init__(self, metrics_text, costs=None, ready=True, reason="ready",
+                 programs=None):
         self.metrics_text = metrics_text
         self.costs = costs or {}
         self.ready = ready
         self.reason = reason
+        self.programs = programs
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -519,6 +522,14 @@ class _FakeReplica:
                     body, status = outer.metrics_text.encode(), 200
                 elif path == "/debug/costs":
                     body, status = json.dumps(outer.costs).encode(), 200
+                elif path == "/debug/programs":
+                    if outer.programs is None:
+                        # a replica running with the costmodel plane off
+                        # (or an older build) simply has no endpoint
+                        body, status = b"not found\n", 404
+                    else:
+                        body = json.dumps({"programs": outer.programs}).encode()
+                        status = 200
                 elif path == "/readyz":
                     body = outer.reason.encode() + b"\n"
                     status = 200 if outer.ready else 503
@@ -757,6 +768,119 @@ class TestFederation:
             frame = fleet.render_top(view, top=3)
             assert "a" in frame and "ready" in frame
             assert "top 3 cost rows" in frame
+        finally:
+            a.close()
+
+
+def _program_row(digest, label, *, dispatches, device_ms, compile_ms=0.0,
+                 predicted_ms=1.0):
+    net = max(0.0, device_ms - compile_ms)
+    return {
+        "label": label, "digest": digest, "platform": "cpu",
+        "flops": 100.0, "bytes_accessed": 800.0, "analysis": "ok",
+        "predicted_ms": predicted_ms, "model_ms": 25.0,
+        "hlo_hash": "cafe" * 4,
+        "observed": {
+            "dispatches": dispatches, "device_ms": device_ms,
+            "device_ms_max": device_ms, "bytes": 64 * dispatches,
+            "compiles": 0, "compile_ms": compile_ms, "hbm_peak": 0.0,
+            "last_slow_trace": None,
+        },
+        "utilization": predicted_ms * dispatches / net if net else 0.0,
+        "observed_ms_per_dispatch": net / dispatches if dispatches else None,
+        "drift_ratio": (net / dispatches) / 25.0 if dispatches else None,
+    }
+
+
+class TestProgramCardFederation:
+    def test_cards_union_by_digest_and_observed_merges(self):
+        # ISSUE 14: two replicas serving the same compiled program (same
+        # digest) union into one card whose observed rows merge like cost
+        # rows and whose utilization recomputes from the merged totals
+        a = _FakeReplica(
+            _replica_text("a", 1, 1.0),
+            programs={"bundle[sum]": _program_row(
+                "d1", "bundle[sum]", dispatches=2, device_ms=10.0
+            )},
+        )
+        b = _FakeReplica(
+            _replica_text("b", 1, 1.0),
+            programs={
+                "bundle[sum]": _program_row(
+                    "d1", "bundle[sum]", dispatches=3, device_ms=30.0
+                ),
+                "serve[sum#ab]": _program_row(
+                    "d1", "serve[sum#ab]", dispatches=1, device_ms=5.0
+                ),
+            },
+        )
+        try:
+            federator = fleet.Federator([("a", a.url), ("b", b.url)], interval=60)
+            view = federator.scrape_once()
+            progs = view["programs"]
+            assert set(progs) == {"d1"}
+            card = progs["d1"]
+            assert sorted(card["labels"]) == ["bundle[sum]", "serve[sum#ab]"]
+            assert card["observed"]["dispatches"] == 6
+            assert card["observed"]["device_ms"] == pytest.approx(45.0)
+            assert card["utilization"] == pytest.approx(1.0 * 6 / 45.0, abs=1e-6)
+            # the console joins utilization onto the cost rows
+            frame = fleet.render_top(view, top=3)
+            assert "util" in frame
+        finally:
+            a.close()
+            b.close()
+
+    def test_planeless_replica_is_an_empty_table_not_an_error(self):
+        a = _FakeReplica(_replica_text("a", 1, 1.0))  # 404s /debug/programs
+        try:
+            federator = fleet.Federator([("a", a.url)], interval=60)
+            view = federator.scrape_once()
+            assert view["programs"] == {}
+            assert view["replicas"][0]["ok"]
+        finally:
+            a.close()
+
+
+class TestTopJson:
+    def test_render_top_json_is_machine_readable(self):
+        a = _FakeReplica(
+            _replica_text("a", 4, 2.0),
+            programs={"bundle[sum]": _program_row(
+                "d1", "bundle[sum]", dispatches=2, device_ms=10.0
+            )},
+        )
+        try:
+            federator = fleet.Federator([("a", a.url)], interval=60)
+            view = federator.scrape_once()
+            frame = fleet.render_top_json(view, top=3)
+            text = json.dumps(frame)  # must be JSON-safe as-is
+            parsed = json.loads(text)
+            row = parsed["replicas"][0]
+            assert row["replica"] == "a"
+            assert row["state"] == "ready"
+            assert row["queue_depth"] == 1
+            assert row["qps"] is None  # first frame: nothing to diff
+            assert parsed["programs"][0]["digest"] == "d1"
+            assert isinstance(parsed["top_costs"], list)
+        finally:
+            a.close()
+
+    def test_top_json_cli_once(self, capsys):
+        # the satellite end to end: `fleet top --json --once` prints one
+        # JSON document an alerting script can consume without scraping
+        # the ANSI frame
+        a = _FakeReplica(_replica_text("a", 2, 1.5))
+        try:
+            rc = fleet.main([
+                "top", "--replicas", f"a={a.url}", "--json", "--once",
+                "--interval", "60",
+            ])
+            assert rc == 0
+            out = capsys.readouterr().out
+            parsed = json.loads(out)
+            assert parsed["replicas"][0]["replica"] == "a"
+            assert "\x1b[2J" not in out  # --json implies no screen clear
         finally:
             a.close()
 

@@ -285,6 +285,67 @@ def test_real_cost_ledger_is_registered():
     assert _COST_LEDGER == {}
 
 
+def test_unregistered_card_registry_fails_flx008(tmp_path):
+    # ISSUE 14 satellite: the costmodel's compiled-program card registry
+    # accretes one card per program exactly like a cache — a
+    # REGISTRY-named container mutated one level through a helper (the
+    # costmodel.record_compiled shape) without the matching clear_all
+    # registration must be flagged
+    pkg = tmp_path / "minipkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "costmodel.py").write_text(
+        '"""Mini costmodel with a card registry."""\n\n'
+        "_CARD_REGISTRY: dict = {}\n\n\n"
+        "def _store(registry, digest, card):\n"
+        "    registry[digest] = card\n\n\n"
+        "def record_compiled(label, compiled):\n"
+        "    card = {'label': label, 'flops': 0.0}\n"
+        "    _store(_CARD_REGISTRY, label, card)\n"
+        "    return card\n"
+    )
+    (pkg / "cache.py").write_text(
+        '"""clear_all that forgets the card registry."""\n\n\n'
+        "def clear_all():\n    pass\n"
+    )
+    findings = [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+    assert len(findings) == 1
+    assert "_CARD_REGISTRY" in findings[0].message
+    # registering it makes the package clean again — the spelling the real
+    # flox_tpu.cache.clear_all uses
+    (pkg / "cache.py").write_text(
+        '"""clear_all that registers the card registry."""\n\n\n'
+        "def clear_all():\n"
+        "    from .costmodel import _CARD_REGISTRY\n\n"
+        "    _CARD_REGISTRY.clear()\n"
+    )
+    assert not [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+
+
+def test_real_card_registry_is_registered():
+    # the runtime complement: the REAL card registry must be reachable
+    # from the real clear_all (named here so a refactor cannot lose it)
+    import flox_tpu
+    import flox_tpu.cache as flox_cache
+    from flox_tpu.costmodel import _CARD_LABELS, _CARD_REGISTRY, record_compiled
+
+    class _FakeCompiled:
+        def cost_analysis(self):
+            return [{"flops": 4.0, "bytes accessed": 8.0}]
+
+        def memory_analysis(self):
+            return None
+
+        def as_text(self):
+            return "HloModule probe"
+
+    with flox_tpu.set_options(telemetry=True, costmodel=True):
+        record_compiled("probe[card]", _FakeCompiled(), sig="probe")
+    assert len(_CARD_REGISTRY) >= 1 and _CARD_LABELS
+    flox_cache.clear_all()
+    assert _CARD_REGISTRY == {} and _CARD_LABELS == {}
+
+
 def test_lru_bound_cache_is_flx008_candidate(tmp_path):
     # the compiled-program caches are LRUCache instances now (ISSUE 7
     # eviction fix) — swapping dict for LRUCache must not take a cache off

@@ -1087,3 +1087,430 @@ class TestFullPlaneBitIdentity:
         np.testing.assert_array_equal(np.asarray(expected), np.asarray(got))
         np.testing.assert_array_equal(np.asarray(groups), np.asarray(g2))
         assert cache.stats()["cost_by_program"]
+
+
+# ---------------------------------------------------------------------------
+# analytical cost model (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+from flox_tpu import costmodel, device as device_mod, faults  # noqa: E402
+
+
+def _plane(**extra):
+    return flox_tpu.set_options(telemetry=True, costmodel=True, **extra)
+
+
+class TestCostModelCards:
+    def test_off_by_default_is_a_noop(self):
+        # costmodel pinned off explicitly: the assertion must hold under a
+        # CI leg exporting FLOX_TPU_COSTMODEL=1 too
+        with flox_tpu.set_options(telemetry=True, costmodel=False):
+            _run_reduce()
+        assert costmodel.cards() == {}
+        assert cache.stats()["costmodel_cards"] == 0
+        gauges = telemetry.METRICS.gauges()
+        assert not any(k.startswith("program.") for k in gauges)
+
+    def test_eager_bundle_card_nonzero_flops_and_bytes(self):
+        with _plane():
+            _run_reduce()
+        card = costmodel.card_for("bundle[nanmean]")
+        assert card is not None
+        assert card["analysis"] == "ok"
+        assert card["flops"] > 0 and card["bytes_accessed"] > 0
+        assert card["predicted_ms"] > 0
+        assert card["hlo_hash"]
+        assert cache.stats()["costmodel_cards"] >= 1
+
+    def test_card_compiles_never_pollute_jax_compiles(self):
+        # the analysis pass compiles the program a second time; that
+        # compile must count on costmodel.card_* and leave jax.compiles
+        # exactly where a cards-off run puts it
+        cache.clear_all()
+        with flox_tpu.set_options(telemetry=True):
+            _run_reduce()
+            baseline = telemetry.METRICS.get("jax.compiles")
+        assert baseline >= 1  # a fresh bundle really compiled
+        cache.clear_all()
+        with _plane():
+            _run_reduce()
+            assert telemetry.METRICS.get("jax.compiles") == baseline
+            assert telemetry.METRICS.get("costmodel.card_compiles") >= 1
+            assert telemetry.METRICS.get("costmodel.card_compile_ms") > 0
+
+    def test_every_runtime_path_has_a_card(self):
+        # acceptance: eager, fused, streaming, and mesh dispatches on the
+        # CPU backend all yield cards with nonzero analytical flops+bytes
+        from flox_tpu.fusion import groupby_aggregate_many
+
+        vals = RNG.normal(size=48)
+        codes = np.arange(48) % 5
+        with _plane():
+            _run_reduce()
+            groupby_aggregate_many(
+                vals, codes, funcs=("sum", "min", "max"), engine="jax"
+            )
+            streaming_groupby_reduce(vals, codes, func="sum", batch_len=16)
+            mesh = make_mesh(1)
+            groupby_reduce(
+                vals, codes, func="sum", engine="jax",
+                method="map-reduce", mesh=mesh,
+            )
+        by_label = {c["label"]: c for c in costmodel.cards().values()}
+        for label in (
+            "bundle[nanmean]",
+            "fused[sum+min+max]",
+            "stream[reduce[sum]]",
+            "mesh[sum/map-reduce]",
+        ):
+            card = by_label[label]
+            assert card["analysis"] == "ok", (label, card)
+            assert card["flops"] > 0, label
+            assert card["bytes_accessed"] > 0, label
+        # and each label joins its observed ledger row
+        report = costmodel.program_report()["programs"]
+        for label in by_label:
+            assert report[label]["observed"] is not None, label
+
+    def test_cards_memoized_per_signature(self):
+        with _plane():
+            _run_reduce()
+            n0 = telemetry.METRICS.get("costmodel.card_compiles")
+            _run_reduce()  # same program+shape: registry hit, no compile
+            assert telemetry.METRICS.get("costmodel.card_compiles") == n0
+        assert cache.stats()["costmodel_cards"] == 1
+
+    def test_serve_dispatch_aliases_underlying_card(self):
+        import asyncio
+
+        from flox_tpu.serve import Dispatcher
+
+        async def go():
+            d = Dispatcher()
+            res = await d.submit(
+                func="sum",
+                array=np.array([1.0, 2.0, 4.0, 8.0]),
+                by=np.array([0, 0, 1, 1]),
+                # pin the jit engine: a tiny payload under x64 would take
+                # the numpy engine, which compiles no program to card
+                options={"numpy_engine_max_elems": 0},
+            )
+            await d.close()
+            return res
+
+        with _plane():
+            asyncio.run(go())
+        serve_labels = [
+            label
+            for label in costmodel.program_report()["programs"]
+            if label.startswith("serve[")
+        ]
+        assert serve_labels, costmodel.program_report()["programs"].keys()
+        card = costmodel.card_for(serve_labels[0])
+        assert card is not None and card["flops"] > 0
+
+    def test_clear_all_drops_the_registry(self):
+        with _plane():
+            _run_reduce()
+        assert costmodel.cards()
+        cache.clear_all()
+        assert costmodel.cards() == {}
+        assert costmodel.card_for("bundle[nanmean]") is None
+        assert cache.stats()["costmodel_cards"] == 0
+
+    def test_full_plane_bit_identity(self):
+        # acceptance: results with telemetry + cards enabled are
+        # bit-identical to the plane off — eager, mesh, and streaming
+        from flox_tpu.fusion import groupby_aggregate_many
+
+        vals = RNG.normal(size=(3, 48))
+        flat = vals[0]
+        codes = np.arange(48) % 5
+        mesh = make_mesh(1)
+
+        def run_all():
+            out = {}
+            out["eager"], _ = groupby_reduce(vals, codes, func="nanmean", engine="jax")
+            out["mesh"], _ = groupby_reduce(
+                vals, codes, func="sum", engine="jax",
+                method="map-reduce", mesh=mesh,
+            )
+            out["stream"], _ = streaming_groupby_reduce(
+                flat, codes, func="sum", batch_len=16
+            )
+            fused, _ = groupby_aggregate_many(flat, codes, funcs=("sum", "max"))
+            out.update({f"fused[{k}]": v for k, v in fused.items()})
+            return {k: np.asarray(v) for k, v in out.items()}
+
+        cache.clear_all()
+        baseline = run_all()
+        cache.clear_all()
+        with _plane():
+            instrumented = run_all()
+        assert instrumented.keys() == baseline.keys()
+        for key in baseline:
+            np.testing.assert_array_equal(instrumented[key], baseline[key])
+
+
+class TestRooflineJoin:
+    def test_gauges_published_and_scrape_clean(self):
+        with _plane():
+            _run_reduce()
+        gauges = telemetry.METRICS.gauges()
+        assert "program.utilization|program=bundle[nanmean]" in gauges
+        assert "program.predicted_ms|program=bundle[nanmean]" in gauges
+        assert gauges["program.predicted_ms|program=bundle[nanmean]"] > 0
+        with flox_tpu.set_options(telemetry=True):
+            text = exposition.prometheus_text()
+        samples, types, _ = _parse_prometheus(text)
+        assert types["flox_tpu_program_utilization"] == "gauge"
+        assert any(
+            k.startswith('flox_tpu_program_utilization{program="bundle[nanmean]"')
+            for k in samples
+        ), [k for k in samples if "program_util" in k]
+
+    def test_utilization_is_model_over_observed(self):
+        with _plane():
+            _run_reduce()
+        row = costmodel.program_report()["programs"]["bundle[nanmean]"]
+        obs = row["observed"]
+        net_ms = max(0.0, obs["device_ms"] - obs["compile_ms"])
+        if net_ms > 0:
+            expected = row["predicted_ms"] * obs["dispatches"] / net_ms
+            # abs tolerance: the published value is rounded to 6 places
+            assert row["utilization"] == pytest.approx(expected, abs=1e-6)
+
+    def test_program_report_filters(self):
+        with _plane():
+            _run_reduce()
+            streaming_groupby_reduce(
+                RNG.normal(size=48), np.arange(48) % 5, func="sum", batch_len=16
+            )
+        full = costmodel.program_report()["programs"]
+        assert len(full) >= 2
+        only = costmodel.program_report(program="bundle[")["programs"]
+        assert set(only) == {k for k in full if "bundle[" in k}
+        top1 = costmodel.program_report(top=1)["programs"]
+        assert len(top1) == 1
+
+
+class TestDriftSentinel:
+    def test_honest_run_is_clean(self):
+        with _plane():
+            _run_reduce()
+            _run_reduce()
+            report = costmodel.drift_report()
+        assert report["flagged"] == []
+        assert report["rows"], "the bundle row must be judged"
+
+    def test_injected_delay_flags_and_scrape_drift_matches(self):
+        with _plane():
+            _run_reduce()  # cold: pays the compile (net out of the model)
+            with faults.dispatch_delay_inject("bundle[nanmean]", 0.5, times=1):
+                _run_reduce()
+            report = costmodel.drift_report()
+            assert report["flagged"] == ["bundle[nanmean]"]
+            # the sentinel runs identically over a /debug/programs scrape
+            rows = costmodel.program_report()["programs"]
+            again = costmodel.drift_report(rows)
+            assert again["flagged"] == ["bundle[nanmean]"]
+
+    def test_threshold_option_validated(self):
+        with pytest.raises(ValueError):
+            flox_tpu.set_options(costmodel_drift_threshold=0.5)
+        with pytest.raises(ValueError):
+            flox_tpu.set_options(costmodel_overhead_ms=-1.0)
+        with pytest.raises(ValueError):
+            flox_tpu.set_options(costmodel="yes")
+
+
+class TestDebugProgramsEndpoint:
+    def _get(self, port, path):
+        return urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5)
+
+    def test_golden_format_and_filters(self):
+        with _plane():
+            _run_reduce()
+            port = exposition.start_metrics_server(port=0)
+            resp = self._get(port, "/debug/programs")
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("application/json")
+            payload = json.loads(resp.read())
+            assert "programs" in payload and "peaks" in payload
+            assert payload["replica"] and payload["host"]
+            row = payload["programs"]["bundle[nanmean]"]
+            for key in (
+                "digest", "flops", "bytes_accessed", "predicted_ms",
+                "analysis", "observed", "utilization", "hlo_hash",
+            ):
+                assert key in row, key
+            assert row["flops"] > 0
+            assert row["observed"]["dispatches"] >= 1
+            # ?top= keeps the K most expensive rows
+            top = json.loads(self._get(port, "/debug/programs?top=1").read())
+            assert len(top["programs"]) == 1
+            # ?program= filters by substring
+            none = json.loads(
+                self._get(port, "/debug/programs?program=nosuch").read()
+            )
+            assert none["programs"] == {}
+
+    def test_malformed_top_is_400(self):
+        with _plane():
+            port = exposition.start_metrics_server(port=0)
+            for bad in ("abc", "0", "-3"):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    self._get(port, f"/debug/programs?top={bad}")
+                assert err.value.code == 400
+                body = json.loads(err.value.read())
+                assert body["ok"] is False
+
+
+class TestProgramsCLI:
+    def test_live_and_file_and_top(self, tmp_path, capsys):
+        with _plane():
+            _run_reduce()
+            assert telemetry.main(["programs"]) == 0
+            out = capsys.readouterr().out
+            assert "bundle[nanmean]" in out and "live process" in out
+            port = exposition.start_metrics_server(port=0)
+            scrape = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/programs", timeout=5
+            ).read()
+        path = tmp_path / "programs.json"
+        path.write_bytes(scrape)
+        assert telemetry.main(["programs", str(path), "--top", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "bundle[nanmean]" in out
+
+    def test_drift_exit_codes(self, tmp_path, capsys):
+        with _plane():
+            _run_reduce()
+            assert telemetry.main(["programs", "--drift"]) == 0
+            assert "clean" in capsys.readouterr().out
+            with faults.dispatch_delay_inject("bundle[nanmean]", 0.5, times=1):
+                _run_reduce()
+            assert telemetry.main(["programs", "--drift"]) == 2
+            assert "DRIFT" in capsys.readouterr().out
+
+    def test_garbage_file_errors(self, tmp_path, capsys):
+        bad = tmp_path / "bad.json"
+        bad.write_text("[1, 2, 3]")
+        with pytest.raises(SystemExit):
+            telemetry.main(["programs", str(bad)])
+        capsys.readouterr()
+
+
+class TestBytesLimit:
+    class _Dev:
+        def __init__(self, stats):
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    def test_summed_like_the_other_fields(self, monkeypatch):
+        devs = [
+            self._Dev({"bytes_in_use": 10, "peak_bytes_in_use": 20,
+                       "bytes_limit": 100}),
+            self._Dev({"bytes_in_use": 5, "peak_bytes_in_use": 6,
+                       "bytes_limit": 200}),
+        ]
+        stats = device_mod.memory_stats(devices=devs)
+        assert stats["bytes_in_use"] == 15
+        assert stats["bytes_limit"] == 300
+
+    def test_none_safe_when_no_device_reports_a_limit(self):
+        devs = [self._Dev({"bytes_in_use": 10})]
+        stats = device_mod.memory_stats(devices=devs)
+        assert stats["bytes_limit"] is None
+
+    def test_gauge_seeded_at_metrics_server_start(self, monkeypatch):
+        from flox_tpu import device
+
+        monkeypatch.setattr(
+            device, "memory_stats",
+            lambda devices=None: {
+                "bytes_in_use": 1, "peak_bytes_in_use": 2,
+                "devices": 1, "bytes_limit": 16 * 2**30,
+            },
+        )
+        with flox_tpu.set_options(telemetry=True):
+            exposition.start_metrics_server(port=0)
+            assert telemetry.METRICS.get("hbm.bytes_limit") == 16 * 2**30
+            text = exposition.prometheus_text()
+        assert "flox_tpu_hbm_bytes_limit" in text
+
+
+class TestCaptureStamping:
+    def test_capture_dir_stamped_with_window_programs(self, tmp_path):
+        from flox_tpu import profiling
+
+        with _plane(profile_dir=str(tmp_path)):
+            _run_reduce()  # pre-window dispatch: must NOT be stamped
+            capture_dir = profiling.start_capture(seconds=0.3)
+            _run_reduce()  # in-window dispatch: must be stamped
+            deadline = __import__("time").time() + 10
+            stamp = os.path.join(capture_dir, "programs.json")
+            while __import__("time").time() < deadline and not os.path.exists(stamp):
+                __import__("time").sleep(0.05)
+            assert os.path.exists(stamp), "capture never stamped"
+            payload = json.loads(open(stamp).read())
+            progs = payload["programs"]
+            assert "bundle[nanmean]" in progs
+            assert progs["bundle[nanmean]"]["dispatches"] == 1
+            assert progs["bundle[nanmean]"]["digest"]
+
+
+class TestAutotunePrior:
+    @pytest.fixture(autouse=True)
+    def _fresh_store(self):
+        # the autotune store survives telemetry.reset(); these tests
+        # reason about an EMPTY store, so drop it on both sides
+        cache.clear_all()
+        yield
+        cache.clear_all()
+
+    def test_prior_consulted_when_no_measured_band(self):
+        from flox_tpu import autotune
+
+        with _plane(autotune=True):
+            choice = autotune.decide(
+                "fused", "fused", ("fused", "sequential"),
+                dtype="float32", ngroups=8, nelems=4096,
+            )
+            assert choice == "fused"
+            assert telemetry.METRICS.get("costmodel.prior_consults") >= 1
+            assert telemetry.METRICS.get("costmodel.prior_decisions") >= 1
+
+    def test_measured_band_outranks_the_prior(self):
+        from flox_tpu import autotune
+
+        with _plane(autotune=True):
+            autotune.record(
+                "fused", "sequential", 99.0,
+                dtype="float32", ngroups=8, nelems=4096,
+            )
+            autotune.record(
+                "fused", "fused", 1.0,
+                dtype="float32", ngroups=8, nelems=4096,
+            )
+            consults0 = telemetry.METRICS.get("costmodel.prior_consults")
+            choice = autotune.decide(
+                "fused", "fused", ("fused", "sequential"),
+                dtype="float32", ngroups=8, nelems=4096,
+            )
+            assert choice == "sequential"  # the measurement, not the model
+            assert telemetry.METRICS.get("costmodel.prior_consults") == consults0
+
+    def test_plane_off_keeps_the_fallback(self):
+        from flox_tpu import autotune
+
+        with flox_tpu.set_options(telemetry=True, autotune=True, costmodel=False):
+            choice = autotune.decide(
+                "fused", "fused", ("fused", "sequential"),
+                dtype="float32", ngroups=8, nelems=4096,
+            )
+            assert choice == "fused"
+            assert telemetry.METRICS.get("costmodel.prior_consults") == 0

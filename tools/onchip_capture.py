@@ -254,6 +254,62 @@ def _run_history_sweep(timeout_s: float) -> bool:
 _DONE: dict = {}  # per-step success across retry cycles
 
 
+_PROFILE_SNIPPET = r"""
+import json, os, time
+import numpy as np
+import flox_tpu
+from flox_tpu import costmodel, profiling
+from flox_tpu.core import groupby_reduce
+
+with flox_tpu.set_options(
+    telemetry=True, costmodel=True, profile_dir=os.environ["FLOX_PROFILE_OUT"]
+):
+    cap = profiling.start_capture(seconds=3.0)
+    vals = np.random.default_rng(0).normal(size=(256, 4096)).astype("float32")
+    codes = np.arange(4096) % 12
+    np.asarray(groupby_reduce(vals, codes, func="nanmean")[0])
+    time.sleep(4.0)  # past the capture window so the stop+stamp ran
+    stamp = os.path.join(cap, "programs.json")
+    payload = {"capture": cap, "stamped": os.path.exists(stamp)}
+    if payload["stamped"]:
+        payload["programs"] = json.load(open(stamp))["programs"]
+    print(json.dumps(payload))
+"""
+
+
+def run_profile(timeout_s: float = 600.0) -> bool:
+    """Short stamped profiler capture into ``PROFILE_TPU_LAST/``: the
+    capture dir's ``programs.json`` carries the program labels + card
+    digests dispatched inside the window (costmodel.stamp_capture), so the
+    committed xprof evidence is joinable back to /debug/costs and
+    /debug/programs rows — the capture-runbook contract."""
+    log("profile: starting stamped on-chip capture")
+    out_dir = os.path.join(REPO, "PROFILE_TPU_LAST")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROFILE_SNIPPET], cwd=REPO,
+            env={**os.environ, "FLOX_PROFILE_OUT": out_dir},
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        log("profile: TIMED OUT")
+        return False
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        log(f"profile: rc={proc.returncode} stderr_tail={tail}")
+        return False
+    try:
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        log("profile: no stamped-capture record on stdout")
+        return False
+    log(
+        f"profile: capture={rec.get('capture')} stamped={rec.get('stamped')} "
+        f"programs={sorted(rec.get('programs') or {})}"
+    )
+    return bool(rec.get("stamped"))
+
+
 def capture_once() -> bool:
     """One full capture attempt. True iff bench AND tests evidence landed.
     Steps that already succeeded this session are not re-run on retries —
@@ -263,6 +319,7 @@ def capture_once() -> bool:
         ("tests", run_tests_tpu),
         ("accuracy", run_accuracy),
         ("history", run_history_sweep),
+        ("profile", run_profile),
     ):
         if _DONE.get(name):
             log(f"{name}: already captured this session; skipping")
