@@ -450,6 +450,63 @@ def main() -> None:
         print(f"flox-tpu bench: fused sweep failed: {exc}",
               file=sys.stderr, flush=True)
 
+    # --- high-cardinality: dense vs the sort (present-groups) engine ------
+    # (kernels.py sort section) a million-label universe with sparse
+    # presence — the user-ID / geohash / station-ID regime. GB/s against
+    # ONE logical read of the data for BOTH engines, so the dense number
+    # directly shows the ngroups-accumulator penalty. The pair seeds the
+    # "highcard" autotune family, and the coarse universe scan records the
+    # dense-vs-sort crossover band (docs/engines.md).
+    highcard_info = None
+    try:
+        hc_size = 1 << 20
+        hc_n = 1 << 16
+        hc_present = 1 << 12  # 0.4% of the universe present
+        rng_hc = np.random.default_rng(11)
+        hc_ids = rng_hc.choice(hc_size, hc_present, replace=False)
+        hc_codes = hc_ids[rng_hc.integers(0, hc_present, hc_n)]
+        hc_vals = jax.device_put(
+            rng_hc.normal(size=hc_n).astype(np.float32)
+        )
+        hc_reps = max(2, reps // 2)
+
+        def _t_hc(engine, universe):
+            t0 = time.perf_counter()
+            np.asarray(flox_tpu.groupby_reduce(
+                hc_vals, hc_codes % universe, func="nanmean",
+                expected_groups=np.arange(universe), engine=engine,
+            )[0])
+            return time.perf_counter() - t0
+
+        _t_hc("jax", hc_size)  # compile + warm both engines
+        _t_hc("sort", hc_size)
+        t_hc_dense = min(_t_hc("jax", hc_size) for _ in range(hc_reps))
+        t_hc_sort = min(_t_hc("sort", hc_size) for _ in range(hc_reps))
+        # coarse crossover scan: the smallest universe (same data, labels
+        # folded down) where the sort engine wins — the band boundary the
+        # autotuner refines at runtime
+        crossover = None
+        for logu in range(13, 21):
+            u = 1 << logu
+            _t_hc("jax", u), _t_hc("sort", u)
+            td = min(_t_hc("jax", u) for _ in range(2))
+            ts = min(_t_hc("sort", u) for _ in range(2))
+            if ts < td:
+                crossover = u
+                break
+        highcard_info = {
+            "ngroups": hc_size,
+            "nelems": hc_n,
+            "present": hc_present,
+            "dense_gbps": round(hc_vals.nbytes / t_hc_dense / 1e9, 3),
+            "sort_gbps": round(hc_vals.nbytes / t_hc_sort / 1e9, 3),
+            "speedup": round(t_hc_dense / t_hc_sort, 2),
+            "crossover_ngroups": crossover,
+        }
+    except Exception as exc:  # noqa: BLE001 — keep the headline alive
+        print(f"flox-tpu bench: highcard sweep failed: {exc}",
+              file=sys.stderr, flush=True)
+
     # --- telemetry profile of the headline reduction (ISSUE 4) ------------
     # one instrumented pass, OUTSIDE the timed reps so the numbers above
     # stay clean: compile counts + span-phase breakdown make this round
@@ -537,6 +594,17 @@ def main() -> None:
                     nelems=(fused_info or {}).get("nelems", nelems_bench),
                     source="bench",
                 )
+        # the highcard sweep seeds the dense-vs-sort routing family, under
+        # the universe/element bands it measured
+        if highcard_info:
+            for cand in ("dense", "sort"):
+                hc_gbps = highcard_info.get(f"{cand}_gbps")
+                if hc_gbps:
+                    autotune.record(
+                        "highcard", cand, hc_gbps, dtype="float32",
+                        ngroups=highcard_info["ngroups"],
+                        nelems=highcard_info["nelems"], source="bench",
+                    )
         autotune.save()  # no-op without a configured autotune_cache_path
         families = {"headline": gbps}
         families.update({f"segment_sum[{k}]": v for k, v in sweep_gbps.items()})
@@ -545,6 +613,9 @@ def main() -> None:
         )
         families["streaming[sync]"] = streaming["gbps_sync"]
         families["streaming[prefetch]"] = streaming["gbps_prefetch"]
+        if highcard_info:
+            families["highcard[dense]"] = highcard_info["dense_gbps"]
+            families["highcard[sort]"] = highcard_info["sort_gbps"]
         families.update(
             {f"fused[{k}]": v
              for k, v in ((fused_info or {}).get("fused_sweep_gbps") or {}).items()
@@ -576,6 +647,7 @@ def main() -> None:
         "quantile_gbps": quantile_gbps,
         "streaming": streaming,
         "fused": fused_info,
+        "highcard": highcard_info,
         "telemetry": telemetry_profile,
         "costmodel": costmodel_record,
         "autotune": autotune_record,

@@ -386,6 +386,32 @@ def bench_telemetry(scale: str):
     return [{"bench": "telemetry[era5-nanmean]", "value": profile, "unit": "profile"}]
 
 
+def bench_highcard(engine: str, scale: str):
+    """Dense vs the sort (present-groups) engine on a sparse-presence
+    high-cardinality workload — the ``highcard_gbps[...]`` rows the
+    dense-vs-sort crossover (docs/engines.md) is recorded from."""
+    from flox_tpu import groupby_reduce
+
+    size = 1 << (20 if scale == "full" else 17)
+    n = 1 << (16 if scale == "full" else 14)
+    present = max(64, size >> 8)
+    rng = np.random.default_rng(11)
+    ids = rng.choice(size, present, replace=False)
+    codes = ids[rng.integers(0, present, n)]
+    vals = rng.normal(size=n)
+    eg = np.arange(size)
+    out = []
+    for eng, label in ((engine, "dense"), ("sort", "sort")):
+        t = _timeit(lambda e=eng: _block(groupby_reduce(
+            vals, codes, func="nanmean", expected_groups=eg, engine=e,
+        )[0]))
+        out.append({
+            "bench": f"highcard_gbps[{label}-{size}g-{engine}]",
+            "value": round(vals.nbytes / t / 1e9, 3), "unit": "GB/s",
+        })
+    return out
+
+
 def bench_costmodel(scale: str):
     """Analytical-cards sweep (ISSUE 14): run the ERA5 nanmean with the
     cost-model plane on and emit each program's card next to the drift
@@ -546,6 +572,7 @@ def main() -> None:
             results += bench_nwm_zonal(engine, args.scale)
             results += bench_random_big(engine, args.scale)
             results += bench_fused(engine, args.scale)
+            results += bench_highcard(engine, args.scale)
             results += bench_scan(engine, args.scale)
         if "jax" in engines:
             # mesh benchmarks need a working jax backend; keep --engine numpy

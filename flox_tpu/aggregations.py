@@ -48,10 +48,13 @@ def normalize_engine(engine: str) -> str:
 
     The reference's ``engine="flox"`` is its native vectorised engine
     (reference aggregate_flox.py); ours is the jax/XLA engine, so the name
-    aliases to ``"jax"``. ``"numbagg"`` (reference aggregate_numbagg.py)
-    has no analogue by design — every device path here is already
-    JIT-compiled by XLA — so it raises with that explanation rather than
-    "unknown".
+    aliases to ``"jax"``. ``"sort"`` is the present-groups engine
+    (kernels.py sort section): the jax kernels run over the compact domain
+    of groups actually present, the high-cardinality analogue of the
+    reference's sort+``ufunc.reduceat`` engine. ``"numbagg"`` (reference
+    aggregate_numbagg.py) has no analogue by design — every device path
+    here is already JIT-compiled by XLA — so it raises with that
+    explanation rather than "unknown".
     """
     if engine == "flox":
         return "jax"
@@ -63,8 +66,10 @@ def normalize_engine(engine: str) -> str:
             "default; alias 'flox') or engine='numpy' (independent host "
             "engine). See docs/api.md, 'Engines'."
         )
-    if engine not in ("jax", "numpy"):
-        raise ValueError(f"Unknown engine {engine!r}; expected 'jax' or 'numpy'.")
+    if engine not in ("jax", "numpy", "sort"):
+        raise ValueError(
+            f"Unknown engine {engine!r}; expected 'jax', 'numpy' or 'sort'."
+        )
     return engine
 
 
@@ -98,7 +103,13 @@ def generic_aggregate(
         return engine_numpy.generic_kernel(
             func, group_idx, array, axis=axis, size=size, fill_value=fill_value, dtype=dtype, **kwargs
         )
-    raise ValueError(f"Unknown engine {engine!r}; expected 'jax' or 'numpy'.")
+    if engine == "sort":
+        from . import kernels
+
+        return kernels.sort_kernel(
+            func, group_idx, array, axis=axis, size=size, fill_value=fill_value, dtype=dtype, **kwargs
+        )
+    raise ValueError(f"Unknown engine {engine!r}; expected 'jax', 'numpy' or 'sort'.")
 
 
 # ---------------------------------------------------------------------------

@@ -125,6 +125,14 @@ _REGRESSION_THRESHOLD = 0.15
 _NEAREST_TOLERANCE = {"engine": 1}
 _NEAREST_TOLERANCE_DEFAULT = 6
 
+#: families whose winner is governed by the GROUP band: the highcard
+#: dense-vs-sort crossover lives on the ngroups axis, so its nearest-band
+#: match additionally bounds the group-band distance — a record swept at
+#: the capped 2^20 universe must not decide for workloads on the other
+#: side of the crossover. Families absent here keep the legacy behavior
+#: (group band is a tiebreak only).
+_NEAREST_TOLERANCE_GROUPS = {"highcard": 2}
+
 
 def enabled() -> bool:
     """Whether autotuned dispatch is on (``OPTIONS["autotune"]``).
@@ -425,6 +433,7 @@ def lookup(
         if want is None:
             return None
         tolerance = _NEAREST_TOLERANCE.get(family, _NEAREST_TOLERANCE_DEFAULT)
+        gtolerance = _NEAREST_TOLERANCE_GROUPS.get(family)
         best_rec, best_dist = None, None
         for other_key, other in _AUTOTUNE_CACHE.items():
             got = _split_key(other_key)
@@ -432,6 +441,8 @@ def lookup(
                 continue
             dist = (abs(got[4] - want[4]), abs(got[3] - want[3]))
             if dist[0] > tolerance:
+                continue
+            if gtolerance is not None and dist[1] > gtolerance:
                 continue
             if best_dist is None or dist < best_dist:
                 best_rec, best_dist = other, dist
@@ -603,6 +614,22 @@ def _seed_from_bench_record(payload: Mapping[str, Any]) -> int:
                     ngroups=ngroups, nelems=nelems, platform=plat, source="seed",
                 )
                 count += 1
+    highcard = payload.get("highcard")
+    if isinstance(highcard, Mapping):
+        # the highcard sweep records its own workload bands (universe size
+        # and elements actually timed) so the seed lands where it measured
+        hc_ngroups = highcard.get("ngroups")
+        hc_nelems = highcard.get("nelems")
+        if isinstance(hc_ngroups, int) and isinstance(hc_nelems, int):
+            for cand in ("dense", "sort"):
+                gbps = highcard.get(f"{cand}_gbps")
+                if isinstance(gbps, (int, float)) and gbps > 0:
+                    record(
+                        "highcard", cand, float(gbps), dtype="float32",
+                        ngroups=hc_ngroups, nelems=hc_nelems, platform=plat,
+                        source="seed",
+                    )
+                    count += 1
     fused = payload.get("fused")
     if isinstance(fused, Mapping):
         sweep_f = fused.get("fused_sweep_gbps")
@@ -828,6 +855,78 @@ def _sweep_engine(dtype: Any, nelems: int) -> None:
         "engine", ("numpy", "jax"), runner, data.nbytes,
         dtype=dtype, ngroups=0, nelems=n,
     )
+
+
+#: highcard-sweep workload caps: the dense-vs-sort crossover is governed by
+#: the label-universe size and the present density, so the sweep keeps the
+#: caller's density at a capped universe — a 1M-group dense accumulator is
+#: only ~8 MB host-side, cheap enough to time honestly
+_SWEEP_HIGHCARD_SIZE_MAX = 1 << 20
+_SWEEP_HIGHCARD_N_MAX = 1 << 16
+
+
+def _sweep_highcard(dtype: Any, ngroups: int, n_present: int, nelems: int) -> None:
+    """Time the dense jax engine against the sort (present-groups) engine
+    on a synthetic workload with the caller's universe size and present
+    density (both capped), feeding the "highcard" family the eager
+    dense-vs-sort routing consults."""
+    import numpy as np
+
+    from .aggregations import generic_aggregate
+
+    n = max(16, min(_SWEEP_HIGHCARD_N_MAX, nelems or _SWEEP_HIGHCARD_N_MAX))
+    size = max(2, min(int(ngroups) or 2, _SWEEP_HIGHCARD_SIZE_MAX))
+    frac = min(1.0, max(1, int(n_present)) / max(1, int(ngroups)))
+    p = max(1, min(int(frac * size), size, n))
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=n).astype(str(dtype), copy=False)
+    present_ids = rng.choice(size, p, replace=False).astype(np.int64)
+    codes = present_ids[rng.integers(0, p, n)]
+
+    def runner(engine: str) -> Callable[[], Any]:
+        eng = "jax" if engine == "dense" else "sort"
+
+        def run() -> Any:
+            out = generic_aggregate(
+                codes, data, engine=eng, func="nansum", size=size, fill_value=0
+            )
+            return np.asarray(out)
+
+        return run
+
+    # record under the universe/elements actually timed (size/n, not the
+    # caller's bands): the workload is capped, and a winner measured at the
+    # cap must not masquerade as a measurement of a 100x larger universe
+    _sweep(
+        "highcard", ("dense", "sort"), runner, data.nbytes,
+        dtype=dtype, ngroups=size, nelems=n,
+    )
+
+
+def prime_highcard(dtype: Any, ngroups: int, n_present: int, nelems: int) -> None:
+    """Highcard-family analogue of :func:`prime_engine`: one budgeted
+    dense-vs-sort sweep per banded key, before the routing decision that
+    wants to consult it. A no-op unless the tuner is on."""
+    if not _sweep_allowed():
+        return
+    dt = str(dtype)
+    if dt not in ("float32", "float64"):
+        return
+    swept_size = max(2, min(int(ngroups) or 2, _SWEEP_HIGHCARD_SIZE_MAX))
+    swept_n = max(16, min(_SWEEP_HIGHCARD_N_MAX, nelems or _SWEEP_HIGHCARD_N_MAX))
+    tolerance = _NEAREST_TOLERANCE.get("highcard", _NEAREST_TOLERANCE_DEFAULT)
+    if (
+        abs(_gband(ngroups) - _gband(swept_size)) > tolerance
+        or abs(_eband(nelems) - _eband(swept_n)) > tolerance
+    ):
+        # the capped sweep could not serve this band anyway (records land
+        # under the swept sizes); don't burn budget on it
+        return
+    try:
+        if _needs_sweep("highcard", dt, swept_size, swept_n):
+            _sweep_highcard(dt, ngroups, n_present, nelems)
+    except Exception as exc:  # noqa: BLE001 — priming must never kill a reduction
+        logger.debug("autotune: prime_highcard(%s) failed: %s", dt, exc)
 
 
 #: reduction families whose chunk kernels ride the additive segment-sum

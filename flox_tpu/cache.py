@@ -113,6 +113,7 @@ def stats() -> dict:
     from .costmodel import _CARD_REGISTRY
     from .factorize import _FACTORIZE_CACHE
     from .fusion import _FUSED_PROGRAM_CACHE
+    from .kernels import _PRESENT_CACHE
     from .parallel.mapreduce import _PROGRAM_CACHE
     from .parallel.scan import _SCAN_CACHE
     from .profiling import capture_active
@@ -149,6 +150,9 @@ def stats() -> dict:
         "profile_capture_active": capture_active() is not None,
         "cohorts": len(_COHORTS_CACHE),
         "factorize": len(_FACTORIZE_CACHE),
+        # present-group tables of the sort engine (kernels.present_groups):
+        # one sorted-unique table per distinct code-content fingerprint
+        "present_tables": len(_PRESENT_CACHE),
         "mesh_programs": len(_PROGRAM_CACHE),
         "scan_programs": len(_SCAN_CACHE),
         "stream_steps": len(_STEP_CACHE),
@@ -200,8 +204,11 @@ def clear_all() -> None:
         _PALLAS_MULTISTAT_COMPILE_PROBE,
         _PALLAS_MULTISTAT_PROBE_RESULT,
         _PALLAS_PROBE_RESULT,
+        _PALLAS_RADIXBIN_COMPILE_PROBE,
+        _PALLAS_RADIXBIN_PROBE_RESULT,
         _PALLAS_SCAN_COMPILE_PROBE,
         _PALLAS_SCAN_PROBE_RESULT,
+        _PRESENT_CACHE,
     )
     from .parallel.mapreduce import _PROGRAM_CACHE
     from .parallel.scan import _SCAN_CACHE
@@ -252,6 +259,10 @@ def clear_all() -> None:
     _PALLAS_SCAN_COMPILE_PROBE.clear()
     _PALLAS_MULTISTAT_PROBE_RESULT.clear()
     _PALLAS_MULTISTAT_COMPILE_PROBE.clear()
+    _PALLAS_RADIXBIN_PROBE_RESULT.clear()
+    _PALLAS_RADIXBIN_COMPILE_PROBE.clear()
+    # sort-engine present-group tables (content-fingerprint keyed)
+    _PRESENT_CACHE.clear()
     # autotune measurement store + its counters/lazy-load flag: clearing
     # returns the tuner to the unloaded state, so the next consult reloads
     # the persisted file (or runs plain heuristics when no path is set) —

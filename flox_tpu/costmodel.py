@@ -584,6 +584,22 @@ def analytic_prior(
         # every size, and the PR 10 measurements agree (fused won even the
         # small shapes, 5.4x). The analytical prior is unconditional.
         choice = "fused"
+    elif family == "highcard" and {"dense", "sort"} <= cands:
+        # dense streams the data once and writes ~3 dense (ngroups-sized)
+        # intermediates; sort pays ~2 extra passes over the data (the
+        # stable binning sort / host unique + compact relabel) but its
+        # accumulators track the present groups, bounded above by nelems.
+        # Both modeled as bandwidth passes — grouped reductions are
+        # memory-bound on every platform in the peak table.
+        n_acc = 3
+        present_cap_elems = min(max(0, int(nelems)), max(1, int(ngroups)))
+        dense_ms = (data_bytes + n_acc * max(1, ngroups) * itemsize) / (
+            peaks["bw_gbps"] * 1e9
+        ) * 1e3
+        sort_ms = (3 * data_bytes + n_acc * present_cap_elems * itemsize) / (
+            peaks["bw_gbps"] * 1e9
+        ) * 1e3
+        choice = "sort" if sort_ms < dense_ms else "dense"
     elif family == "segment_sum" and "matmul" in cands and "scatter" in cands:
         # one-hot GEMM: 2·N·G flops at peak compute vs scatter's serialized
         # updates, modeled as a deeply de-rated bandwidth pass (scatters

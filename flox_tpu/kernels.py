@@ -333,6 +333,25 @@ def _pallas_runtime_ok() -> bool:
     )
 
 
+_PALLAS_RADIXBIN_PROBE_RESULT: list = []
+_PALLAS_RADIXBIN_COMPILE_PROBE: list = []
+
+
+def _pallas_radixbin_runtime_ok() -> bool:
+    from .pallas_kernels import probe_compile_radixbin, segment_sum_radixbin_pallas
+
+    def _exec():
+        probe = segment_sum_radixbin_pallas(
+            jnp.ones((8, 128), jnp.float32), jnp.zeros(8, jnp.int32), 2
+        )
+        return np.asarray(probe)[0, 0] == 8.0
+
+    return _probed_ok(
+        _PALLAS_RADIXBIN_PROBE_RESULT, _PALLAS_RADIXBIN_COMPILE_PROBE, _exec,
+        probe_compile_radixbin, "radixbin-segment-sum",
+    )
+
+
 _PALLAS_SCAN_PROBE_RESULT: list = []
 _PALLAS_SCAN_COMPILE_PROBE: list = []
 
@@ -412,13 +431,29 @@ def _segment_sum_impl(data, size: int) -> str:
         and size <= OPTIONS["pallas_num_groups_max"]
         and data.shape[0] >= 8
     )
+    # the radix-binning grid covers the group counts past the dense
+    # kernel's VMEM cap — the sort engine's compact domain lives here
+    radixbin_ok = (
+        str(data.dtype) in ("float32", "bfloat16")
+        and size <= OPTIONS["radixbin_num_groups_max"]
+        and data.shape[0] >= 8
+    )
     on_tpu = _on_tpu()
     if policy == "pallas":
         return "pallas" if pallas_ok and (not on_tpu or _pallas_runtime_ok()) else "scatter"
-    # auto on TPU: pallas if it validates at runtime, else the GEMM path if
-    # its guards pass (pure XLA, no custom lowering), else scatter
+    if policy == "radixbin":
+        return (
+            "radixbin"
+            if radixbin_ok and (not on_tpu or _pallas_radixbin_runtime_ok())
+            else "scatter"
+        )
+    # auto on TPU: pallas if it validates at runtime, else radix-binning for
+    # the group counts past its VMEM cap, else the GEMM path if its guards
+    # pass (pure XLA, no custom lowering), else scatter
     if on_tpu and pallas_ok and _pallas_runtime_ok():
         heuristic = "pallas"
+    elif on_tpu and not pallas_ok and radixbin_ok and _pallas_radixbin_runtime_ok():
+        heuristic = "radixbin"
     elif on_tpu and _use_matmul_path("sum", data, size):
         heuristic = "matmul"
     else:
@@ -431,6 +466,8 @@ def _segment_sum_impl(data, size: int) -> str:
             eligible.append("matmul")
         if pallas_ok and on_tpu and _pallas_runtime_ok():
             eligible.append("pallas")
+        if radixbin_ok and on_tpu and _pallas_radixbin_runtime_ok():
+            eligible.append("radixbin")
         nelems = data.shape[0] * (
             int(np.prod(data.shape[1:])) if data.ndim > 1 else 1
         )
@@ -490,6 +527,12 @@ def _seg(op: str, data, codes, size: int):
 
             # interpret mode keeps the kernel testable off-TPU
             return segment_sum_pallas(
+                data, codes, size, interpret=not _on_tpu()
+            )
+        if impl == "radixbin":
+            from .pallas_kernels import segment_sum_radixbin_pallas
+
+            return segment_sum_radixbin_pallas(
                 data, codes, size, interpret=not _on_tpu()
             )
         if impl == "matmul":
@@ -1705,3 +1748,208 @@ def generic_kernel(func: str, group_idx, array, **kwargs):
         # eager (jit=False) calls count once per execution
         telemetry.METRICS.inc(f"kernel.trace.{func}")
     return fn(group_idx, array, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# sort engine: present-groups execution (the high-cardinality regime).
+#
+# Every kernel above is dense over the static label universe ``size`` — the
+# right shape contract for XLA, and an OOM machine when ``size`` is millions
+# while each call touches a few thousand groups (user IDs, geohashes,
+# station IDs). The sort engine is the TPU-native analogue of the
+# reference's sort+``ufunc.reduceat`` engine (aggregate_flox.py:133-192):
+# unique-ify the codes once (a sort), relabel them into the compact
+# [0, n_present) domain, run the UNCHANGED dense kernels over a small
+# banded capacity, and scatter back to the dense layout only where the
+# caller asks for it — so accumulator bytes track the data, not the label
+# universe. Element order is never permuted (only the codes are relabeled,
+# monotonically), so every kernel family — including float sums, order
+# statistics and position-tracking argreductions — is bit-identical to the
+# dense path on the present groups WHEN both domains resolve to the same
+# segment-op lowering. Off-TPU (the tier-1 surface) that is always true
+# (auto = scatter at every size); on TPU the compact domain may cross the
+# pallas/radixbin/matmul size gates the dense domain did not, reassociating
+# float sums within the documented accuracy of those lowerings — the same
+# caveat any segment_sum_impl flip has always carried (docs/engines.md).
+# ---------------------------------------------------------------------------
+
+
+from .cache import LRUCache
+
+#: host-side memo of present-group tables: the serve/pipeline hot loops
+#: re-reduce over the same factorized codes many times, and the O(N log N)
+#: unique pass is pure overhead after the first call. Keyed on a content
+#: fingerprint (not object identity — factorize_cached may rebuild equal
+#: codes). Registered in cache.clear_all / cache.stats ("present_tables").
+_PRESENT_CACHE: LRUCache = LRUCache(maxsize=64)
+
+#: capacity bands are powers of two so repeated calls with drifting
+#: present-group counts reuse the same compiled programs (the same reason
+#: resilience's OOM ladder re-stages on a power-of-two ladder)
+_PRESENT_CAP_MIN = 8
+
+
+def _codes_fingerprint(codes: "np.ndarray", size: int) -> tuple:
+    """Cheap content key for the present-table memo: blake2b over the raw
+    code bytes (a few ms/1e6 codes — an order cheaper than the unique pass
+    it saves) + shape/dtype/size."""
+    import hashlib
+
+    h = hashlib.blake2b(np.ascontiguousarray(codes).view(np.uint8), digest_size=16)
+    return (h.hexdigest(), codes.shape, str(codes.dtype), int(size))
+
+
+def present_groups(codes: "np.ndarray", size: int) -> "np.ndarray":
+    """Sorted unique valid codes of a host code array (the "present" table).
+
+    ``codes``: integer codes with -1 meaning "missing label". Memoized on
+    content (see :data:`_PRESENT_CACHE`).
+    """
+    codes = np.asarray(codes).reshape(-1)
+    fingerprint = _codes_fingerprint(codes, size)
+    hit = _PRESENT_CACHE.get(fingerprint)
+    if hit is not None:
+        return hit
+    present = np.unique(codes[codes >= 0]).astype(np.int64, copy=False)
+    _PRESENT_CACHE[fingerprint] = present
+    return present
+
+
+def compact_codes(codes: "np.ndarray", present: "np.ndarray") -> "np.ndarray":
+    """Relabel ``codes`` into the compact [0, n_present) domain.
+
+    Monotone (present is sorted), order-preserving, and -1 (missing) maps
+    to -1 — so per-group element order, and therefore every accumulation
+    order, is exactly the dense path's.
+    """
+    codes = np.asarray(codes).reshape(-1)
+    out = np.searchsorted(present, codes).astype(np.int32)
+    out[codes < 0] = -1
+    return np.ascontiguousarray(out)
+
+
+def present_cap(n_present: int, size: int) -> int:
+    """Banded compact-domain capacity: the next power of two above
+    ``n_present``, with at least one empty pad slot whenever the dense
+    universe has absent groups. The pad slot is load-bearing for
+    bit-identity: it makes the compact reduction contain an empty group
+    exactly when the dense one does, so the empty-fill dtype promotions
+    (``_promote_for_nan_fill``) and ``_astype_final``'s NaN-carrying
+    downcast guard fire identically on both paths — and its value is
+    byte-for-byte the dense path's empty-group value, which the dense
+    scatter-back reuses as its fill.
+    """
+    n_present = int(n_present)
+    if n_present >= size:
+        return max(1, n_present)
+    want = max(_PRESENT_CAP_MIN, n_present + 1)
+    cap = 1 << (want - 1).bit_length()
+    return min(cap, size)
+
+
+def scatter_present_dense(result_c, present: "np.ndarray", size: int):
+    """Expand a compact (..., cap) result to the dense (..., size) layout.
+
+    Host-side by design: the dense layout exists only in host RAM, never as
+    a device allocation — that is the whole point of the engine. Absent
+    groups take the value of the compact result's first pad slot (an empty
+    group that went through the identical kernel/finalize pipeline), so the
+    fill is bit-identical to the dense path's empty-group value for every
+    aggregation family, min_count mask and datetime round-trip included.
+    Thin wrapper over :class:`multiarray.PresentGroups` — the container
+    every runtime's compact layer rides to the host boundary.
+    """
+    from .multiarray import PresentGroups
+
+    return PresentGroups(present, np.asarray(result_c), size).scatter_dense()
+
+
+def sort_segment_reduce(op: str, data, codes, *, ncap: int):
+    """Device-side present-groups segment reduction: ONE stable lex-sort of
+    ``(codes, position)`` bins the rows by group, run boundaries on the
+    sorted codes yield compact segment ids, and a single segment-``op``
+    over ``ncap`` segments reduces each run.
+
+    This is the jit-safe sibling of the host unique+compact orchestration
+    (``present_groups``/``compact_codes``) for callers whose codes are
+    traced. No shipped runtime needs it yet — every current flow's codes
+    are host-known before tracing, so compaction happens once up front —
+    but traced-codes callers (a fully-fused serve program, per-shard
+    re-compaction) get the same shape contract from it, tested directly.
+    ``ncap`` must be a static upper bound on the number of distinct
+    present groups (overflowing runs are dropped, so size the cap from
+    host knowledge).
+
+    ``data``: (N, ...) leading layout; ``codes``: (N,) int, -1 missing.
+    Returns ``(present, out, n_present)``: the sorted present codes padded
+    with -1 to (ncap,), the per-present-group reductions (ncap, ...), and
+    the scalar count of distinct present groups.
+
+    The position key makes the sort stable, so within a group the data
+    keeps stream order and additive reductions accumulate in exactly the
+    dense scatter path's order (bit-identity, not just equality).
+    """
+    codes = jnp.asarray(codes).astype(jnp.int32).reshape(-1)
+    n = codes.shape[0]
+    data = jnp.asarray(data)
+    safe = jnp.where(codes < 0, _BIG, codes)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    sorted_codes, perm = jax.lax.sort((safe, iota), dimension=0, num_keys=2)
+    data_s = jnp.take(data, perm, axis=0)
+    valid = sorted_codes != _BIG
+    boundary = jnp.concatenate(
+        [valid[:1], valid[1:] & (sorted_codes[1:] != sorted_codes[:-1])]
+    )
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1  # -1 until the first run
+    n_present = jnp.sum(boundary.astype(jnp.int32))
+    # invalid rows (missing labels) and cap overflow park in segment ncap
+    seg = jnp.where(valid & (seg >= 0) & (seg < ncap), seg, ncap)
+    out = _seg_op_dense(op, data_s, seg, ncap)
+    present = jax.ops.segment_max(
+        jnp.where(valid, sorted_codes, -1), seg, num_segments=ncap + 1
+    )[:ncap]
+    present = jnp.where(present < 0, -1, present)  # empty segment_max -> INT_MIN
+    return present, out, n_present
+
+
+def _seg_op_dense(op: str, data_s, seg, ncap: int):
+    """The segment-reduce leg of :func:`sort_segment_reduce` (split out so
+    the radix-binning Pallas path can swap in below it)."""
+    if op == "sum" and jnp.issubdtype(data_s.dtype, jnp.floating):
+        acc = _acc_dtype(data_s.dtype)
+        if data_s.dtype != acc:
+            data_s = data_s.astype(acc)
+    fn = {
+        "sum": jax.ops.segment_sum,
+        "prod": jax.ops.segment_prod,
+        "max": jax.ops.segment_max,
+        "min": jax.ops.segment_min,
+    }[op]
+    return fn(data_s, seg, num_segments=ncap + 1)[:ncap]
+
+
+def sort_kernel(func: str, group_idx, array, *, axis=-1, size, fill_value=None,
+                dtype=None, **kwargs):
+    """Engine entry point for the 'sort' engine: host unique + compact
+    relabel, the unchanged dense kernel over the banded capacity, then the
+    dense scatter-back (this per-kernel form keeps the dense (..., size)
+    return contract of ``generic_aggregate``; the memory-saving flows —
+    eager/mesh/streaming orchestration in core/streaming — compact once
+    per call and scatter once at the very end instead).
+
+    Traced codes cannot be uniqued host-side; those calls fall back to the
+    dense jax kernel (the mesh/fused programs compact before tracing).
+    """
+    if not isinstance(group_idx, np.ndarray):
+        return generic_kernel(
+            func, group_idx, array, axis=axis, size=size,
+            fill_value=fill_value, dtype=dtype, **kwargs
+        )
+    present = present_groups(group_idx, size)
+    ncap = present_cap(len(present), size)
+    ccodes = compact_codes(group_idx, present)
+    out = generic_kernel(
+        func, ccodes, array, axis=axis, size=ncap,
+        fill_value=fill_value, dtype=dtype, **kwargs
+    )
+    return scatter_present_dense(np.asarray(out), present, size)

@@ -78,3 +78,128 @@ if _jtu is not None:
         lambda ma: (ma.arrays, None),
         lambda _, children: MultiArray(children),
     )
+
+
+# ---------------------------------------------------------------------------
+# PresentGroups: the sparse (present-groups) intermediate of the sort engine
+# ---------------------------------------------------------------------------
+
+
+def _combine_identity(op: str, dtype):
+    """Identity element of a combine op — what a group absent from one side
+    of a merge contributes. Mirrors kernels.minmax_identity for min/max
+    (multiarray sits below kernels, so the few lines are restated rather
+    than imported)."""
+    dt = np.dtype(dtype)
+    if op == "sum":
+        return dt.type(0)
+    if op == "prod":
+        return dt.type(1)
+    if op in ("max", "min"):
+        if dt.kind == "f":
+            return dt.type(-np.inf if op == "max" else np.inf)
+        info = np.iinfo(dt)
+        return dt.type(info.min if op == "max" else info.max)
+    raise ValueError(f"no identity for combine op {op!r}")
+
+
+class PresentGroups:
+    """A ``(present_codes, values)`` pair: one grouped-reduction layer whose
+    trailing axis covers only the groups actually present, not the label
+    universe — the host-boundary form of the sort engine's intermediates
+    (docs/implementation.md "High-cardinality engine").
+
+    ``present``: sorted unique dense codes, shape ``(n_present,)``.
+    ``values``: ``(..., cap)`` with ``cap >= n_present``; column ``j < n_present``
+    belongs to dense group ``present[j]``. When ``cap > n_present`` the
+    first pad column carries the pipeline's empty-group value, which
+    :meth:`scatter_dense` uses as the dense fill — that is what makes the
+    expansion bit-identical to a dense run for every aggregation family.
+    ``size``: the dense label universe the codes index into.
+    """
+
+    __slots__ = ("present", "values", "size")
+
+    def __init__(self, present, values, size: int) -> None:
+        self.present = np.asarray(present).reshape(-1)
+        self.values = values
+        self.size = int(size)
+        if np.asarray(values).shape[-1] < len(self.present):
+            raise ValueError(
+                f"values trailing axis {np.asarray(values).shape[-1]} cannot "
+                f"hold {len(self.present)} present groups"
+            )
+
+    @property
+    def n_present(self) -> int:
+        return int(self.present.shape[0])
+
+    def __repr__(self) -> str:
+        return (
+            f"PresentGroups(n_present={self.n_present}, size={self.size}, "
+            f"values={np.asarray(self.values).shape})"
+        )
+
+    def scatter_dense(self):
+        """Expand to the dense ``(..., size)`` layout, host-side — absent
+        groups take the first pad column's (empty-group) value."""
+        res = np.asarray(self.values)
+        npres = self.n_present
+        if npres >= self.size:
+            return np.ascontiguousarray(res[..., : self.size])
+        if res.shape[-1] <= npres:
+            raise ValueError(
+                "scatter_dense needs >= 1 pad column when groups are absent "
+                f"(cap {res.shape[-1]}, n_present {npres})"
+            )
+        fill = res[..., npres : npres + 1]
+        out = np.empty(res.shape[:-1] + (self.size,), dtype=res.dtype)
+        out[...] = fill
+        out[..., self.present] = res[..., :npres]
+        return out
+
+    def merge(self, other: "PresentGroups", combine: str) -> "PresentGroups":
+        """Union-merge two present-group INTERMEDIATES under one combine op
+        ("sum" | "prod" | "max" | "min"): groups absent from one side
+        contribute the op's identity, and the union table is re-banded with
+        a pad column carrying the identity (an empty group's intermediate
+        value), so the merged layer scatters like any other.
+
+        No shipped runtime calls this yet — every current flow compacts
+        against ONE host-known present table up front, so its carries never
+        disagree. It exists (tested) as the building block for stores whose
+        present sets grow between ingests — the incremental-aggregation
+        direction of ROADMAP item 1, where two checkpointed compact layers
+        with different tables must fold.
+
+        Finalized values (a mean, a variance) do not merge — merge the
+        underlying intermediate layers and finalize once, as every runtime
+        here does.
+        """
+        if self.size != other.size:
+            raise ValueError(f"universe mismatch: {self.size} != {other.size}")
+        union = np.union1d(self.present, other.present)
+        n_u = len(union)
+        a = np.asarray(self.values)
+        b = np.asarray(other.values)
+        dtype = np.result_type(a.dtype, b.dtype)
+        ident = _combine_identity(combine, dtype)
+        cap = n_u + 1 if n_u < self.size else n_u
+        lead = np.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+        out = np.full(lead + (cap,), ident, dtype=dtype)
+        ia = np.searchsorted(union, self.present)
+        ib = np.searchsorted(union, other.present)
+        out[..., ia] = a[..., : self.n_present]
+        bb = np.broadcast_to(b[..., : other.n_present], lead + (other.n_present,))
+        sel = out[..., ib]
+        if combine == "sum":
+            out[..., ib] = sel + bb
+        elif combine == "prod":
+            out[..., ib] = sel * bb
+        elif combine == "max":
+            out[..., ib] = np.maximum(sel, bb)
+        elif combine == "min":
+            out[..., ib] = np.minimum(sel, bb)
+        else:
+            raise ValueError(f"unsupported combine op {combine!r}")
+        return PresentGroups(union, out, self.size)

@@ -121,8 +121,22 @@ OPTIONS: dict[str, Any] = {
         "FLOX_TPU_RECHUNK_BLOCKWISE_CHUNK_SIZE_THRESHOLD", 1.5, 1.0
     ),
     # TPU policy knobs (no reference analogue):
-    # default engine for device arrays
-    "default_engine": _env_choice("FLOX_TPU_DEFAULT_ENGINE", "jax", ("jax", "numpy")),
+    # default engine for device arrays. "sort" is the present-groups engine
+    # (docs/engines.md "High-cardinality"): accumulators sized by the groups
+    # actually present, not the label universe — the remedy the dense-OOM
+    # errors name.
+    "default_engine": _env_choice(
+        "FLOX_TPU_DEFAULT_ENGINE", "jax", ("jax", "numpy", "sort")
+    ),
+    # label-universe size at which the eager/streaming dispatch starts
+    # weighing the sort (present-groups) engine against the dense kernels:
+    # below it the dense accumulators are cheap enough that the unique pass
+    # is pure overhead; above it the dense-vs-sort choice goes through the
+    # "highcard" autotune family (measured bands, then the cost-model
+    # analytic prior, then the density heuristic).
+    "sort_engine_min_groups": _env_int(
+        "FLOX_TPU_SORT_ENGINE_MIN_GROUPS", 1 << 16, 1
+    ),
     # additive segment reductions with at most this many groups may use the
     # one-hot matmul (MXU) or Pallas path instead of scatter-add
     "matmul_num_groups_max": _env_int("FLOX_TPU_MATMUL_NUM_GROUPS_MAX", 384, 0),
@@ -131,11 +145,20 @@ OPTIONS: dict[str, Any] = {
     # footprint guards pass, then scatter; off-TPU auto is always scatter.
     # Explicit "scatter" | "matmul" | "pallas" override.
     "segment_sum_impl": _env_choice(
-        "FLOX_TPU_SEGMENT_SUM_IMPL", "auto", ("auto", "scatter", "matmul", "pallas")
+        "FLOX_TPU_SEGMENT_SUM_IMPL", "auto",
+        ("auto", "scatter", "matmul", "pallas", "radixbin"),
     ),
     # group-count ceiling for the Pallas path (VMEM-bounded; independent of
     # the matmul knob so disabling one path does not disable the other)
     "pallas_num_groups_max": _env_int("FLOX_TPU_PALLAS_NUM_GROUPS_MAX", 512, 0, 512),
+    # group-count ceiling for the radix-binning Pallas grid (the
+    # high-cardinality sibling of the dense kernel: the group axis is
+    # partitioned into VMEM-sized blocks, so the bound is HBM output bytes
+    # and grid overhead, not VMEM — sized for the sort engine's compact
+    # domains)
+    "radixbin_num_groups_max": _env_int(
+        "FLOX_TPU_RADIXBIN_NUM_GROUPS_MAX", 1 << 14, 0
+    ),
     # Cross-tile accumulation discipline for the Pallas segment-sum, on
     # hardware without float64:
     #   "plain" — a bare f32 running sum (fastest, drifts over many tiles)
@@ -400,10 +423,12 @@ VALID_ACCUMS = ("plain", "kahan", "dd")
 _VALIDATORS = {
     "rechunk_blockwise_num_chunks_threshold": lambda x: 0 < x <= 1,
     "rechunk_blockwise_chunk_size_threshold": lambda x: x >= 1,
-    "default_engine": lambda x: x in ("jax", "numpy"),
+    "default_engine": lambda x: x in ("jax", "numpy", "sort"),
+    "sort_engine_min_groups": lambda x: _is_int(x) and x >= 1,
     "matmul_num_groups_max": lambda x: isinstance(x, int) and x >= 0,
-    "segment_sum_impl": lambda x: x in ("auto", "scatter", "matmul", "pallas"),
+    "segment_sum_impl": lambda x: x in ("auto", "scatter", "matmul", "pallas", "radixbin"),
     "pallas_num_groups_max": lambda x: isinstance(x, int) and 0 <= x <= 512,
+    "radixbin_num_groups_max": lambda x: isinstance(x, int) and x >= 0,
     "pallas_accum": lambda x: x in VALID_ACCUMS,
     "matmul_block_bytes": lambda x: isinstance(x, int) and x >= 2**20,
     "segment_minmax_impl": lambda x: x in ("auto", "scatter", "pallas"),
@@ -517,6 +542,7 @@ def trace_fingerprint() -> tuple:
         OPTIONS["segment_sum_impl"],
         OPTIONS["matmul_num_groups_max"],
         OPTIONS["pallas_num_groups_max"],
+        OPTIONS["radixbin_num_groups_max"],
         OPTIONS["pallas_accum"],
         OPTIONS["matmul_block_bytes"],
         OPTIONS["segment_minmax_impl"],

@@ -108,6 +108,31 @@ def _kernel(
             precision=precision,
         )
 
+    _accum_update(out_ref, comp_ref, onehot, zeroed, contract, accum)
+
+    # Marker contracts are the MXU-bound tail: at HIGHEST each costs as much
+    # as the sums pass (f32 = multi-pass bf16 on the MXU) and they triple the
+    # kernel's FLOPs. Two savings: (1) 0/1 masks are exact in bf16 and the
+    # MXU accumulates into f32 natively, so DEFAULT precision (single pass)
+    # loses nothing; (2) all-finite tiles — the overwhelmingly common case —
+    # skip the contracts entirely on a data-dependent scalar branch.
+    @pl.when(jnp.any(nonfinite))
+    def _markers():
+        import jax as _jax
+
+        d = _jax.lax.Precision.DEFAULT
+        nan_ref[:] += contract(isnan.astype(data.dtype), d)
+        pos_ref[:] += contract(ispos.astype(data.dtype), d)
+        neg_ref[:] += contract(isneg.astype(data.dtype), d)
+
+
+def _accum_update(out_ref, comp_ref, onehot, zeroed, contract, accum):
+    """Cross-tile accumulation of one tile's contraction into the running
+    (out_ref, comp_ref) state, under the selected discipline — shared by
+    the dense megakernel grid and the radix-binning blocked grid."""
+    import jax
+    import jax.numpy as jnp
+
     if accum == "kahan":
         # Kahan summation across the sequential n-grid: recovers most of the
         # bits a plain f32 running sum loses over many tiles — the accuracy
@@ -162,19 +187,6 @@ def _kernel(
         comp_ref[:] = lo2
     else:
         out_ref[:] += contract(zeroed, jax.lax.Precision.HIGHEST)
-
-    # Marker contracts are the MXU-bound tail: at HIGHEST each costs as much
-    # as the sums pass (f32 = multi-pass bf16 on the MXU) and they triple the
-    # kernel's FLOPs. Two savings: (1) 0/1 masks are exact in bf16 and the
-    # MXU accumulates into f32 natively, so DEFAULT precision (single pass)
-    # loses nothing; (2) all-finite tiles — the overwhelmingly common case —
-    # skip the contracts entirely on a data-dependent scalar branch.
-    @pl.when(jnp.any(nonfinite))
-    def _markers():
-        d = jax.lax.Precision.DEFAULT
-        nan_ref[:] += contract(isnan.astype(data.dtype), d)
-        pos_ref[:] += contract(ispos.astype(data.dtype), d)
-        neg_ref[:] += contract(isneg.astype(data.dtype), d)
 
 
 @functools.lru_cache(maxsize=128)
@@ -936,3 +948,195 @@ def segment_sum_raw_pallas(
         return x[:size, :k].reshape((size,) + orig_shape[1:])
 
     return crop(sums), crop(nan_c), crop(pos_c), crop(neg_c)
+
+
+# ---------------------------------------------------------------------------
+# radix-binning segment sum: the high-cardinality sibling of the kernel
+# above. The dense megakernel holds ONE (size_p, k_tile) accumulator block
+# in VMEM, which caps it at ~pallas_num_groups_max groups; here the group
+# axis is partitioned into g_tile-wide blocks and the grid walks
+# (k_tiles, g_blocks, n_tiles) — each (g, i) accumulator tile stays
+# VMEM-resident across its whole n sweep and is written back to HBM exactly
+# once per pass, so VMEM holds only (n_tile x g_tile) one-hot +
+# (g_tile, k_tile) accumulator blocks regardless of the group count.
+#
+# Intended input is the sort engine's compact domain with rows SORTED by
+# code (kernels.sort_segment_reduce's binning pass): each data tile then
+# intersects exactly one group block, every other (g, j) step skips the
+# MXU contraction on a scalar branch, and consecutive skipped steps cost
+# only the tile DMA. Unsorted input stays correct (out-of-block codes
+# contract against zero one-hot rows) but pays the full g_blocks x MXU
+# sweep.
+# ---------------------------------------------------------------------------
+
+
+def _radixbin_kernel(
+    codes_ref, data_ref, out_ref, nan_ref, pos_ref, neg_ref, comp_ref=None,
+    *, g_tile, n_tile, accum,
+):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    g = pl.program_id(1)  # group-block position
+    j = pl.program_id(2)  # position along the reduced (N) axis
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+        nan_ref[:] = jnp.zeros_like(nan_ref)
+        pos_ref[:] = jnp.zeros_like(pos_ref)
+        neg_ref[:] = jnp.zeros_like(neg_ref)
+        if accum != "plain":
+            comp_ref[:] = jnp.zeros_like(comp_ref)
+
+    local = codes_ref[0, :] - g * g_tile  # (n_tile,) block-local codes
+    inblock = (local >= 0) & (local < g_tile)
+    data = data_ref[:]  # (k_tile, n_tile)
+
+    @pl.when(jnp.any(inblock))
+    def _contribute():
+        # sentinel g_tile matches no one-hot column: out-of-block rows (and
+        # the caller's missing/pad sentinel) contract to exactly 0.0
+        codes = jnp.where(inblock, local, g_tile)
+        onehot = (
+            codes[:, None]
+            == jax.lax.broadcasted_iota(jnp.int32, (n_tile, g_tile), 1)
+        ).astype(data.dtype)  # (n_tile, g_tile) — lives only in VMEM
+
+        isnan = jnp.isnan(data)
+        ispos = jnp.isposinf(data)
+        isneg = jnp.isneginf(data)
+        nonfinite = isnan | ispos | isneg
+        zeroed = jnp.where(nonfinite, jnp.zeros((), data.dtype), data)
+
+        def contract(tile, precision):
+            return jax.lax.dot_general(
+                onehot,
+                tile,
+                dimension_numbers=(((0,), (1,)), ((), ())),
+                preferred_element_type=out_ref.dtype,
+                precision=precision,
+            )
+
+        _accum_update(out_ref, comp_ref, onehot, zeroed, contract, accum)
+
+        # same two marker savings as the dense kernel, with the gate
+        # narrowed to non-finite values that actually fall in this block
+        @pl.when(jnp.any(nonfinite & inblock[None, :]))
+        def _markers():
+            d = jax.lax.Precision.DEFAULT
+            nan_ref[:] += contract(isnan.astype(data.dtype), d)
+            pos_ref[:] += contract(ispos.astype(data.dtype), d)
+            neg_ref[:] += contract(isneg.astype(data.dtype), d)
+
+
+@functools.lru_cache(maxsize=128)
+def _build_radixbin(
+    k_pad: int, n_pad: int, size_p: int, g_tile: int, dtype_str: str,
+    acc_str: str, n_tile: int, k_tile: int, interpret: bool, accum: str,
+):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    kern = functools.partial(
+        _radixbin_kernel, g_tile=g_tile, n_tile=n_tile, accum=accum
+    )
+    grid = (k_pad // k_tile, size_p // g_tile, n_pad // n_tile)
+    acc = jnp.dtype(acc_str)
+    n_out = 4 if accum == "plain" else 5
+    out_shape = [jax.ShapeDtypeStruct((size_p, k_pad), acc)] * n_out
+
+    fn = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, n_tile), lambda i, g, j: (0, j)),  # codes
+            pl.BlockSpec((k_tile, n_tile), lambda i, g, j: (i, j)),  # data (K, N)
+        ],
+        out_specs=[pl.BlockSpec((g_tile, k_tile), lambda i, g, j: (g, i))] * n_out,
+        out_shape=out_shape,
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+#: group-block width: lane-width multiple for the one-hot's minor axis and
+#: sublane multiple for the accumulator block — one 512-wide block holds
+#: the whole dense-kernel regime, more blocks scale the group axis
+_RADIXBIN_G_TILE = 512
+
+
+def segment_sum_radixbin_pallas(
+    data, codes, size: int, *, interpret: bool = False, accum: str | None = None,
+    skipna: bool = False,
+):
+    """Segment-sum ``data`` (N, K...) by ``codes`` (N,) -> (size, K...) via
+    the radix-binning blocked grid (see the section comment above): exact
+    IEEE semantics and accumulation disciplines identical to
+    :func:`segment_sum_pallas`, with the group count bounded by the
+    ``segment_sum_radixbin_num_groups_max`` option instead of VMEM."""
+    import jax.numpy as jnp
+
+    from .options import OPTIONS, VALID_ACCUMS
+
+    if accum is None:
+        accum = OPTIONS["pallas_accum"]
+    if accum not in VALID_ACCUMS:
+        raise ValueError(f"accum must be one of {VALID_ACCUMS}; got {accum!r}")
+
+    data = jnp.asarray(data)
+    orig_shape = data.shape
+    n = data.shape[0]
+    flat = data.reshape(n, -1)
+    k = flat.shape[1]
+    flat_t = flat.T  # (K, N) — cancels the caller's moveaxis; no copy
+
+    n_tile, k_tile, n_pad, k_pad, _ = _tiles(n, k, size)
+    g_tile = min(_RADIXBIN_G_TILE, max(8, ((size + 7) // 8) * 8))
+    size_p = -(-size // g_tile) * g_tile
+
+    codes = jnp.asarray(codes).astype(jnp.int32).reshape(-1)
+    # out-of-range codes (missing labels, padding) fall outside every block
+    codes = jnp.where((codes < 0) | (codes >= size), size_p, codes)
+    codes_p = jnp.pad(codes, (0, n_pad - n), constant_values=size_p).reshape(1, n_pad)
+
+    from .kernels import _acc_dtype
+
+    fn = _build_radixbin(
+        k_pad, n_pad, size_p, g_tile, str(flat.dtype),
+        str(jnp.dtype(_acc_dtype(flat.dtype))), n_tile, k_tile, interpret,
+        str(accum),
+    )
+    sums, nan_c, pos_c, neg_c, *_comp = fn(codes_p, flat_t)
+
+    def crop(x):
+        return x[:size, :k].reshape((size,) + orig_shape[1:])
+
+    from .utils import reapply_nonfinite
+
+    return reapply_nonfinite(
+        crop(sums), crop(nan_c), crop(pos_c), crop(neg_c), skipna=skipna
+    )
+
+
+def probe_compile_radixbin() -> None:
+    """Compile-only probe for the radix-binning kernel (see probe_compile)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from .options import OPTIONS
+
+    fn = _build_radixbin(
+        128, 128, 16, 8, "float32", "float32", 128, 128, False,
+        str(OPTIONS["pallas_accum"]),
+    )
+    t0 = time.perf_counter()
+    compiled = fn.lower(
+        jax.ShapeDtypeStruct((1, 128), jnp.int32),
+        jax.ShapeDtypeStruct((128, 128), jnp.float32),
+    ).compile()
+    _probe_card("radixbin[segment_sum]", compiled, (time.perf_counter() - t0) * 1e3)

@@ -456,11 +456,14 @@ def sharded_groupby_reduce(
                 f"{agg.name!r} over {size} groups needs ~{fmt_bytes(est)} of "
                 f"dense (..., size) intermediates per device, above the "
                 f"{fmt_bytes(ceiling)} dense_intermediate_bytes_max ceiling, "
-                f"and {how}. Options: reduce expected_groups; shard over more "
-                "devices; use method='blockwise' after "
-                "rechunk.reshard_for_blockwise (whole groups per shard, no dense "
-                "combine); or raise set_options(dense_intermediate_bytes_max=...) "
-                "if the device really has the headroom."
+                f"and {how}. Options: use engine='sort' "
+                "(FLOX_TPU_DEFAULT_ENGINE=sort — intermediates and collectives "
+                "then cover only the groups actually present); reduce "
+                "expected_groups; shard over more devices; use "
+                "method='blockwise' after rechunk.reshard_for_blockwise (whole "
+                "groups per shard, no dense combine); or raise "
+                "set_options(dense_intermediate_bytes_max=...) if the device "
+                "really has the headroom."
             )
 
     cohort_perm = None

@@ -69,10 +69,21 @@ __all__ = [
     "call_with_retry",
     "StreamCounters",
     "dispatch_slab",
+    "HighCardinalityOOMError",
     "Snapshot",
     "StreamCheckpointer",
     "device_restore",
 ]
+
+
+class HighCardinalityOOMError(RuntimeError):
+    """The OOM ladder bottomed out on an allocation the splitting cannot
+    shrink: the dense per-group accumulators, sized by the label universe,
+    not the slab. Raised in place of the bare re-raised OOM when the
+    caller flagged the run as ngroups-dominated, carrying the actionable
+    remedy (the sort / present-groups engine) in the message. Classified
+    FATAL — re-splitting an accumulator-bound failure would loop the
+    ladder for nothing."""
 
 TRANSIENT = "transient"
 OOM = "oom"
@@ -143,6 +154,11 @@ def classify_error(exc: BaseException) -> str:
     explicitly transient/oom outer classification is already the most
     actionable verdict and never consults the chain.
     """
+    if isinstance(exc, HighCardinalityOOMError):
+        # terminal by construction: its __cause__ IS an OOM, but the ladder
+        # already proved splitting cannot shrink an ngroups-bound
+        # allocation — the chain walk must not re-open the split loop
+        return FATAL
     cls = _classify_one(exc)
     if cls != FATAL:
         return cls
@@ -166,6 +182,10 @@ def classify_error(exc: BaseException) -> str:
 def _classify_one(exc: BaseException) -> str:
     """Classification of one exception, ignoring its chain."""
     msg = str(exc)
+    if isinstance(exc, HighCardinalityOOMError):
+        # the ladder already proved splitting cannot help (the allocation
+        # is ngroups-bound); OOM classification would re-enter the ladder
+        return FATAL
     if isinstance(exc, MemoryError):
         # host-side slab allocation failure: splitting halves that too
         return OOM
@@ -378,6 +398,7 @@ def dispatch_slab(
     counters: StreamCounters | None = None,
     shard_quantum: int = 1,
     reverse: bool = False,
+    highcard_hint: str | None = None,
 ) -> Any:
     """Run one slab step — ``apply_fn(carry, slab) -> carry`` — with the
     fault-injection hook and graceful OOM degradation.
@@ -389,6 +410,14 @@ def dispatch_slab(
     reversed streams, so scan carry semantics hold); a sub-slab that still
     OOMs splits again, down to single elements. ``stager=None`` disables
     splitting (the error propagates). Non-oom errors always propagate.
+
+    ``highcard_hint``: set by callers whose accumulators are dense over an
+    ngroups-dominated label universe (streaming runtime, size past
+    ``sort_engine_min_groups``). When the ladder bottoms out — the span
+    can no longer split, meaning the allocation that still fails is the
+    accumulator, not the slab — the bare OOM is re-raised as a typed
+    :class:`HighCardinalityOOMError` carrying the hint, which names the
+    sort engine as the remedy.
     """
     from . import faults
 
@@ -404,17 +433,30 @@ def dispatch_slab(
         return _split_dispatch(
             apply_fn, carry, sl.start, sl.stop, stager,
             counters=counters, quantum=shard_quantum, reverse=reverse, cause=exc,
+            highcard_hint=highcard_hint,
         )
 
 
 def _split_dispatch(
-    apply_fn, carry, s, e, stager, *, counters, quantum, reverse, cause, depth=0
+    apply_fn, carry, s, e, stager, *, counters, quantum, reverse, cause, depth=0,
+    highcard_hint=None,
 ):
     from . import faults
 
     length = e - s
     half = _ladder_half(length, quantum)
     if length <= max(1, quantum) or half >= length or depth >= 48:
+        # cannot split further: the failing allocation does not scale with
+        # the span. On an ngroups-dominated run that is the dense
+        # accumulator — surface the typed remedy instead of the bare OOM
+        # (message deliberately free of OOM/status tokens so the
+        # classifier cannot re-enter the ladder on it).
+        if highcard_hint:
+            raise HighCardinalityOOMError(
+                "the slab-split ladder bottomed out at span "
+                f"[{s}:{e}) but the step still exhausts device memory — "
+                f"{highcard_hint}"
+            ) from cause
         raise cause  # cannot split further: surface the original OOM
     if counters is not None:
         counters.record_split()
@@ -437,7 +479,7 @@ def _split_dispatch(
             carry = _split_dispatch(
                 apply_fn, carry, ss, ee, stager,
                 counters=counters, quantum=quantum, reverse=reverse,
-                cause=exc, depth=depth + 1,
+                cause=exc, depth=depth + 1, highcard_hint=highcard_hint,
             )
     return carry
 

@@ -33,6 +33,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import logging
 import math
 from typing import Any, Callable
 
@@ -41,6 +42,8 @@ import numpy as np
 from . import cache, factorize as fct, utils
 from .aggregations import Aggregation, _initialize_aggregation
 from .multiarray import MultiArray
+
+logger = logging.getLogger("flox_tpu.streaming")
 
 __all__ = [
     "streaming_groupby_reduce",
@@ -122,6 +125,7 @@ def streaming_groupby_reduce(
     finalize_kwargs: dict | None = None,
     mesh: Any = None,
     axis_name: str | tuple[str, ...] = "data",
+    engine: str | None = None,
 ) -> tuple:
     """Grouped reduction streaming slabs to device.
 
@@ -181,6 +185,7 @@ def streaming_groupby_reduce(
             expected_groups=expected_groups, isbin=isbin, sort=sort, axis=axis,
             fill_value=fill_value, dtype=dtype, min_count=min_count,
             finalize_kwargs=finalize_kwargs, mesh=mesh, axis_name=axis_name,
+            engine=engine,
         )
 
 
@@ -201,6 +206,7 @@ def _streaming_groupby_reduce_impl(
     finalize_kwargs: dict | None,
     mesh: Any,
     axis_name: str | tuple[str, ...],
+    engine: str | None = None,
 ) -> tuple:
     """The :func:`streaming_groupby_reduce` body, under the public
     wrapper's root telemetry span (per-pass ``stream[...]`` spans come from
@@ -365,6 +371,33 @@ def _streaming_groupby_reduce_impl(
         lead_shape = probe.shape[:-1]
     itemsize = probe.dtype.itemsize
     row_bytes = int(np.prod(lead_shape, dtype=np.int64)) * itemsize if lead_shape else itemsize
+
+    # -- present-groups (sort) engine: compact once for the WHOLE stream ---
+    # The stream's codes are host-known upfront, so the union of groups any
+    # slab can touch is known before the first slab stages: compact the
+    # code span once and the carry — through every step program, OOM
+    # split, checkpoint snapshot and the mesh collectives — is sized by
+    # the groups present in the stream, not the label universe. A resumed
+    # process recomputes the identical present table from the identical
+    # inputs, so checkpoint identities (which fingerprint the compact
+    # codes + capacity) match bit-for-bit across kill/resume.
+    present_table = None
+    size_full = size
+    engine = _route_stream_highcard(
+        engine, codes, size, probe, lead_shape, agg, n=n
+    )
+    if engine == "sort":
+        from .core import _note_highcard
+        from .kernels import compact_codes, present_cap, present_groups
+
+        present_table = present_groups(codes, size)
+        if len(present_table) < size:
+            ncap = present_cap(len(present_table), size)
+            codes = compact_codes(codes, present_table)
+            _note_highcard(size, ncap, len(present_table))
+            size = ncap
+        else:
+            present_table = None  # universe fully present: dense == compact
     if batch_len is None:
         from .options import OPTIONS
 
@@ -406,6 +439,7 @@ def _streaming_groupby_reduce_impl(
         from .core import _astype_final, _index_values
 
         result = _astype_final(result, agg, datetime_dtype)
+        result = _scatter_stream(result, present_table, size_full)
         out_shape = (
             agg.new_dims() + tuple(lead_shape) + tuple(keep_by_shape) + grp_shape
         )
@@ -460,6 +494,8 @@ def _streaming_groupby_reduce_impl(
                     f"~{fmt_bytes(est)} of dense (..., size) accumulators per "
                     f"device, above the {fmt_bytes(ceiling)} "
                     f"dense_intermediate_bytes_max ceiling, and {how}. Options: "
+                    "use engine='sort' (FLOX_TPU_DEFAULT_ENGINE=sort — the "
+                    "carry then covers only the groups present in the stream); "
                     "reduce expected_groups; shard over more devices; or raise "
                     "set_options(dense_intermediate_bytes_max=...) if the "
                     "devices really have the headroom."
@@ -589,6 +625,7 @@ def _streaming_groupby_reduce_impl(
             state = dispatch_slab(
                 apply_step, state, sl, stager=stager, counters=counters,
                 shard_quantum=shard_quantum,
+                highcard_hint=_highcard_oom_hint(agg, size, present_table),
             )
             throttle.tick(state)
             done += 1
@@ -608,11 +645,12 @@ def _streaming_groupby_reduce_impl(
             from .core import _astype_final, _index_values
 
             if fused_funcs is not None:
-                from .fusion import finalize_many
-
-                out = finalize_many(agg, result, out_shape)
+                out = _finalize_many_stream(
+                    agg, result, out_shape, present_table, size_full
+                )
                 return (out,) + tuple(_index_values(g) for g in found_groups)
             result = _astype_final(result, agg, datetime_dtype)
+            result = _scatter_stream(result, present_table, size_full)
             if result.shape != out_shape:
                 result = result.reshape(out_shape)
         return (result,) + tuple(_index_values(g) for g in found_groups)
@@ -627,11 +665,12 @@ def _streaming_groupby_reduce_impl(
 
         if fused_funcs is not None:
             # one streaming pass -> the whole statistic set
-            from .fusion import finalize_many
-
-            out = finalize_many(agg, result, out_shape)
+            out = _finalize_many_stream(
+                agg, result, out_shape, present_table, size_full
+            )
             return (out,) + tuple(_index_values(g) for g in found_groups)
         result = _astype_final(result, agg, datetime_dtype)
+        result = _scatter_stream(result, present_table, size_full)
         # (..., size) -> (..., *keep_by, *groups): kept by-dims ride the group
         # axis as disjoint code ranges (factorize_ offsetting) and unfold here
         if result.shape != out_shape:
@@ -656,6 +695,7 @@ def streaming_groupby_aggregate_many(
     finalize_kwargs: dict | None = None,
     mesh: Any = None,
     axis_name: str | tuple[str, ...] = "data",
+    engine: str | None = None,
 ) -> tuple:
     """N grouped statistics in ONE streaming pass over the loader.
 
@@ -682,8 +722,120 @@ def streaming_groupby_aggregate_many(
             batch_bytes=batch_bytes, expected_groups=expected_groups,
             isbin=isbin, sort=sort, axis=axis, fill_value=fill_value,
             dtype=dtype, min_count=min_count, finalize_kwargs=finalize_kwargs,
-            mesh=mesh, axis_name=axis_name,
+            mesh=mesh, axis_name=axis_name, engine=engine,
         )
+
+
+def _route_stream_highcard(engine, codes, size, probe, lead_shape, agg, *, n):
+    """Dense-vs-sort routing for the streaming runtime — the streaming
+    sibling of ``core._route_highcard``. ``engine=None`` auto-routes:
+    above ``dense_intermediate_bytes_max`` the sort engine is taken
+    whenever its compact domain fits (the carry the ladder could never
+    shrink now tracks present groups); between ``sort_engine_min_groups``
+    and the ceiling the "highcard" autotune family decides — except when a
+    checkpoint path is configured, where routing must be reproducible by
+    the resuming process, so only the static heuristic applies (the same
+    rule the adaptive slab sizing follows). Explicit engines are never
+    second-guessed; "numpy" has no streaming form and is rejected.
+    """
+    from .options import OPTIONS
+
+    if engine is not None:
+        from .aggregations import normalize_engine
+
+        engine = normalize_engine(engine)
+        if engine == "numpy":
+            raise ValueError(
+                "the streaming runtime folds slabs on device; engine='numpy' "
+                "has no streaming form (use groupby_reduce on host data)."
+            )
+        return engine
+    from .parallel.mapreduce import dense_intermediate_bytes
+
+    lead_elems = int(np.prod(lead_shape, dtype=np.int64)) if lead_shape else 1
+    est = dense_intermediate_bytes(lead_elems, size, probe.dtype, agg, 1)
+    ceiling = OPTIONS["dense_intermediate_bytes_max"]
+    over = est > ceiling
+    if OPTIONS["default_engine"] == "sort":
+        return "sort"
+    if not over and size < OPTIONS["sort_engine_min_groups"]:
+        return "jax"
+    from .kernels import present_cap, present_groups
+
+    present = present_groups(codes, size)  # memoized; the sort path reuses it
+    ncap = present_cap(len(present), size)
+    if over:
+        est_sort = dense_intermediate_bytes(lead_elems, ncap, probe.dtype, agg, 1)
+        if est_sort <= ceiling:
+            from . import telemetry
+
+            telemetry.count("highcard.ceiling_routes")
+            logger.debug(
+                "stream highcard: dense estimate over ceiling -> sort engine "
+                "(size=%d present=%d)", size, len(present),
+            )
+            return "sort"
+        return "jax"  # the mesh blocked program / ceiling error downstream decides
+    from .core import _HIGHCARD_DENSITY_DEN
+
+    heuristic = "sort" if ncap * _HIGHCARD_DENSITY_DEN <= size else "dense"
+    chosen = heuristic
+    if OPTIONS["autotune"] and not OPTIONS["stream_checkpoint_path"]:
+        from . import autotune
+
+        nelems = int(n) * lead_elems
+        autotune.prime_highcard(probe.dtype, size, len(present), nelems)
+        chosen = autotune.decide(
+            "highcard", heuristic, ("dense", "sort"),
+            dtype=str(probe.dtype), ngroups=size, nelems=nelems,
+        )
+    return "sort" if chosen == "sort" else "jax"
+
+
+def _highcard_oom_hint(agg, size: int, present_table) -> str | None:
+    """The ngroups-dominated flag for the OOM ladder (see
+    ``resilience.dispatch_slab``): set on dense runs whose accumulators
+    span a universe past ``sort_engine_min_groups`` — the allocation the
+    ladder can never shrink — and never on already-compacted runs."""
+    from .options import OPTIONS
+
+    if present_table is not None or size < OPTIONS["sort_engine_min_groups"]:
+        return None
+    return (
+        f"the {agg.name!r} accumulators are dense over the {size}-label "
+        "universe, which slab-splitting cannot shrink. The sort "
+        "(present-groups) engine accumulates only over groups actually "
+        "present: pass engine='sort' (or set FLOX_TPU_DEFAULT_ENGINE=sort), "
+        "or lower expected_groups."
+    )
+
+
+def _scatter_stream(result, present_table, size_full: int):
+    """Expand a compact streaming result to the dense (..., size) layout
+    (host-side; no-op on dense runs)."""
+    if present_table is None:
+        return result
+    from .kernels import scatter_present_dense
+
+    return scatter_present_dense(np.asarray(result), present_table, size_full)
+
+
+def _finalize_many_stream(agg, result, out_shape, present_table, size_full: int):
+    """Fused finalize with the present-groups scatter-back: each statistic
+    expands from the compact domain before the (dense) reshape. Dense runs
+    take the shared :func:`fusion.finalize_many` unchanged."""
+    from .fusion import finalize_many
+
+    if present_table is None:
+        return finalize_many(agg, result, out_shape)
+    outs = finalize_many(agg, result, None)
+    fixed = {}
+    for f, v in outs.items():
+        v = _scatter_stream(v, present_table, size_full)
+        if tuple(v.shape) != tuple(out_shape):
+            v = v.reshape(out_shape)
+        fixed[f] = v
+    return fixed
 
 
 def _slab_stats(agg: Aggregation, slab, ccodes, offset, *, size: int,
@@ -1681,6 +1833,7 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
                 nn, hasnan = dispatch_slab(
                     apply_count, (nn, hasnan), sl, stager=stager,
                     counters=counters, shard_quantum=shard_quantum,
+                    highcard_hint=_highcard_oom_hint(agg, size, None),
                 )
                 throttle.tick(nn)
                 done += 1
@@ -1711,6 +1864,7 @@ def _stream_quantile(agg: Aggregation, loader, codes, *, size: int, n: int,
                 cnt = dispatch_slab(
                     apply_bit, cnt, sl, stager=stager, counters=counters,
                     shard_quantum=shard_quantum,
+                    highcard_hint=_highcard_oom_hint(agg, size, None),
                 )
                 throttle.tick(cnt)
                 done += 1

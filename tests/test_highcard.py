@@ -1,0 +1,717 @@
+"""The high-cardinality (present-groups / "sort") engine.
+
+The dense runtimes materialize ``(..., ngroups)`` accumulators — the
+"dense ceiling" of docs/distributed.md. The sort engine (kernels.py sort
+section) compacts the codes to the groups actually present, runs the
+UNCHANGED dense kernels over a banded capacity, and scatters the dense
+layout back host-side — the TPU-native analogue of the reference's
+sort+``ufunc.reduceat`` engine (aggregate_flox.py:133-192). Everything
+here asserts BIT-identity against the dense path: compaction relabels
+codes monotonically and never permutes elements, so per-group accumulation
+order is byte-for-byte the dense path's.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import flox_tpu
+from flox_tpu import groupby_reduce
+from flox_tpu.kernels import (
+    compact_codes,
+    present_cap,
+    present_groups,
+    scatter_present_dense,
+    sort_segment_reduce,
+)
+from flox_tpu.multiarray import PresentGroups
+from flox_tpu.parallel import make_mesh
+from flox_tpu.streaming import streaming_groupby_reduce
+
+RNG = np.random.default_rng(1234)
+
+#: a sparse-presence workload: UNIVERSE labels, PRESENT distinct ones
+UNIVERSE = 200_000
+PRESENT = 300
+N = 4096
+
+
+def _sparse_codes(n=N, present=PRESENT, universe=UNIVERSE, rng=None):
+    rng = rng or RNG
+    ids = rng.choice(universe, present, replace=False)
+    return ids[rng.integers(0, present, n)]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+@pytest.fixture(scope="module")
+def codes():
+    return _sparse_codes()
+
+
+# ---------------------------------------------------------------------------
+# the compaction primitives
+# ---------------------------------------------------------------------------
+
+
+class TestPrimitives:
+    def test_present_groups_sorted_unique(self, codes):
+        p = present_groups(codes, UNIVERSE)
+        assert (np.diff(p) > 0).all()
+        np.testing.assert_array_equal(p, np.unique(codes[codes >= 0]))
+
+    def test_compact_codes_monotone_and_missing(self, codes):
+        withmiss = codes.copy()
+        withmiss[:7] = -1
+        p = present_groups(withmiss, UNIVERSE)
+        cc = compact_codes(withmiss, p)
+        assert cc.dtype == np.int32
+        assert (cc[:7] == -1).all()
+        valid = cc[withmiss >= 0]
+        assert valid.min() == 0 and valid.max() == len(p) - 1
+        # monotone relabel: order of group ids preserved
+        np.testing.assert_array_equal(p[valid], withmiss[withmiss >= 0])
+
+    def test_present_cap_bands_and_pad_slot(self):
+        # an absent-groups universe always keeps >= 1 empty pad slot (the
+        # scatter fill source) and bands to powers of two
+        assert present_cap(5, 1000) == 8
+        assert present_cap(8, 1000) == 16  # 8 present needs a 9th slot
+        assert present_cap(1000, 1000) == 1000  # fully present: no pad
+        assert present_cap(0, 10) == 8
+        cap = present_cap(300, UNIVERSE)
+        assert cap == 512
+
+    def test_scatter_uses_pad_slot_fill(self):
+        p = np.array([3, 5])
+        comp = np.array([[1.0, 2.0, -7.5, 0.0]])  # pad slot carries -7.5
+        out = scatter_present_dense(comp, p, 6)
+        np.testing.assert_array_equal(out, [[-7.5, -7.5, -7.5, 1.0, -7.5, 2.0]])
+
+    def test_sort_segment_reduce_device(self, codes):
+        data = RNG.normal(size=codes.shape[0])
+        p = present_groups(codes, UNIVERSE)
+        ncap = present_cap(len(p), UNIVERSE)
+        pres, out, n_present = sort_segment_reduce("sum", data, codes, ncap=ncap)
+        assert int(n_present) == len(p)
+        np.testing.assert_array_equal(np.asarray(pres)[: len(p)], p)
+        assert (np.asarray(pres)[len(p):] == -1).all()
+        # bit-identical to the dense scatter's per-group accumulation
+        import jax.numpy as jnp
+
+        dense = jax.ops.segment_sum(
+            jnp.asarray(data),
+            jnp.asarray(codes).astype(jnp.int32),
+            num_segments=UNIVERSE,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out)[: len(p)], np.asarray(dense)[p]
+        )
+
+
+# ---------------------------------------------------------------------------
+# bit-identity matrix: every family x NaN x min_count x dtypes x supersets
+# ---------------------------------------------------------------------------
+
+FAMILIES = [
+    "sum", "nansum", "prod", "nanprod", "mean", "nanmean", "var", "nanvar",
+    "std", "nanstd", "max", "nanmax", "min", "nanmin", "count", "any", "all",
+    "argmax", "nanargmax", "argmin", "nanargmin", "first", "last",
+    "nanfirst", "nanlast", "median", "nanmedian", "quantile", "nanquantile",
+]
+
+
+def _run_pair(vals, codes, func, **kw):
+    rs, gs = groupby_reduce(vals, codes, func=func, engine="sort", **kw)
+    rd, gd = groupby_reduce(vals, codes, func=func, engine="jax", **kw)
+    np.testing.assert_array_equal(np.asarray(gs), np.asarray(gd))
+    assert np.asarray(rs).dtype == np.asarray(rd).dtype
+    np.testing.assert_array_equal(np.asarray(rs), np.asarray(rd), err_msg=func)
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("func", FAMILIES)
+    def test_family_superset_universe(self, func, codes):
+        vals = RNG.normal(size=(2, N))
+        vals[..., RNG.random(N) < 0.15] = np.nan
+        kw = {"expected_groups": np.arange(UNIVERSE)}
+        if func in ("quantile", "nanquantile"):
+            kw["finalize_kwargs"] = {"q": [0.25, 0.75]}
+        _run_pair(vals, codes, func, **kw)
+
+    @pytest.mark.parametrize("func", ["sum", "nanmean", "nanmax", "count"])
+    def test_family_labels_present_only(self, func, codes):
+        # expected_groups exactly the present set: compact == dense domain
+        vals = RNG.normal(size=N)
+        _run_pair(vals, codes, func, expected_groups=np.unique(codes))
+
+    @pytest.mark.parametrize("dtype", ["int32", "int64", "float32"])
+    def test_int_and_narrow_dtypes(self, dtype, codes):
+        vals = RNG.integers(-50, 50, N).astype(dtype)
+        for func in ("sum", "max", "count", "first"):
+            _run_pair(vals, codes, func, expected_groups=np.arange(UNIVERSE))
+
+    @pytest.mark.parametrize("min_count", [1, 2, 4])
+    def test_min_count_mask(self, min_count, codes):
+        vals = RNG.normal(size=N)
+        _run_pair(
+            vals, codes, "nansum",
+            expected_groups=np.arange(UNIVERSE), min_count=min_count,
+        )
+
+    def test_nan_fill_int_promotion(self, codes):
+        # NaN fill on integer sums promotes on BOTH paths (the pad slot
+        # makes the compact run contain an empty group exactly when the
+        # dense one does — the dtype-parity mechanism)
+        vals = RNG.integers(0, 100, N)
+        _run_pair(
+            vals, codes, "sum",
+            expected_groups=np.arange(UNIVERSE), fill_value=np.nan, min_count=2,
+        )
+
+    def test_datetime_roundtrip(self, codes):
+        vals = np.array(
+            RNG.integers(0, 10**15, N), dtype="datetime64[ns]"
+        )
+        for func in ("nanmax", "first", "count", "nanmean"):
+            _run_pair(vals, codes, func, expected_groups=np.arange(UNIVERSE))
+
+    def test_multi_by_kept_dims(self):
+        # kept by-dims fold into disjoint code ranges; the present set
+        # lives in the flat offset space and scatters back flat
+        rng = np.random.default_rng(7)
+        by = rng.choice(rng.choice(50_000, 40, replace=False), size=(6, 128))
+        vals = rng.normal(size=(6, 128))
+        rs, _ = groupby_reduce(
+            vals, by, func="nanmean", axis=-1,
+            expected_groups=np.arange(50_000), engine="sort",
+        )
+        rd, _ = groupby_reduce(
+            vals, by, func="nanmean", axis=-1,
+            expected_groups=np.arange(50_000), engine="jax",
+        )
+        np.testing.assert_array_equal(np.asarray(rs), np.asarray(rd))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance workload: >= 1M labels, <= 1% present, no dense allocation
+# ---------------------------------------------------------------------------
+
+
+class TestMillionLabels:
+    SIZE = 1_000_000
+    PRESENT = 8_000  # 0.8% of the universe
+    N = 60_000
+
+    def test_million_label_sort_no_dense_allocation(self):
+        rng = np.random.default_rng(42)
+        ids = rng.choice(self.SIZE, self.PRESENT, replace=False)
+        codes = ids[rng.integers(0, self.PRESENT, self.N)]
+        vals = rng.normal(size=self.N)
+        vals[rng.random(self.N) < 0.1] = np.nan
+        eg = np.arange(self.SIZE)
+        dense_bytes = self.SIZE * 8
+
+        with flox_tpu.set_options(telemetry=True):
+            rs, _ = groupby_reduce(
+                vals, codes, func="nanmean", expected_groups=eg, engine="sort"
+            )
+            # allocation accounting, leg 1: no live device buffer anywhere
+            # near a dense (..., ngroups) accumulator's size survived the
+            # sort run (the compact domain is <= 16384 slots)
+            live_max = max(
+                (a.nbytes for a in jax.live_arrays()), default=0
+            )
+            assert live_max < dense_bytes // 8, live_max
+            # leg 2: the engine's own gauges record the compact capacity
+            from flox_tpu import telemetry
+
+            acc = telemetry.METRICS.gauges()["highcard.acc_groups"]
+            assert 0 < acc <= 2 * present_cap(self.PRESENT, self.SIZE)
+            assert (
+                telemetry.METRICS.gauges()["highcard.dense_groups_avoided"]
+                >= self.SIZE - 2 * present_cap(self.PRESENT, self.SIZE)
+            )
+            # leg 3 (when the backend reports memory at all): peak in use
+            # stays far below the dense accumulator estimate
+            from flox_tpu import device
+
+            stats = device.memory_stats()
+            if stats and stats.get("peak_bytes_in_use"):
+                assert stats["peak_bytes_in_use"] < 4 * dense_bytes
+
+        # bit-identical to the dense path on the present groups (the dense
+        # run happens AFTER the allocation assertions so its buffers cannot
+        # contaminate the live-array scan)
+        rd, _ = groupby_reduce(
+            vals, codes, func="nanmean", expected_groups=eg, engine="jax"
+        )
+        rs, rd = np.asarray(rs), np.asarray(rd)
+        np.testing.assert_array_equal(rs[ids], rd[ids])
+        np.testing.assert_array_equal(rs, rd)  # and everywhere (fills too)
+
+    def test_million_label_over_ceiling_autoroutes(self):
+        # heuristic-chosen engines degrade to sort instead of raising once
+        # the dense estimate crosses the ceiling
+        rng = np.random.default_rng(43)
+        codes = rng.choice(self.SIZE, 64, replace=False)[
+            rng.integers(0, 64, 2048)
+        ]
+        vals = rng.normal(size=(8, 2048))
+        with flox_tpu.set_options(dense_intermediate_bytes_max=2**20):
+            got, _ = groupby_reduce(
+                vals, codes, func="nanmean",
+                expected_groups=np.arange(self.SIZE),
+            )
+        want, _ = groupby_reduce(
+            vals, codes, func="nanmean", expected_groups=np.arange(self.SIZE),
+            engine="jax",
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_explicit_dense_over_ceiling_still_raises_naming_sort(self):
+        rng = np.random.default_rng(44)
+        codes = rng.integers(0, 8, 64)
+        vals = np.ones((4, 64))
+        with flox_tpu.set_options(dense_intermediate_bytes_max=2**20):
+            with pytest.raises(ValueError, match="engine='sort'"):
+                groupby_reduce(
+                    vals, codes, func="sum",
+                    expected_groups=np.arange(300_000), engine="jax",
+                )
+
+
+# ---------------------------------------------------------------------------
+# mesh: compact collectives
+# ---------------------------------------------------------------------------
+
+
+class TestMesh:
+    @pytest.mark.parametrize("method", ["map-reduce", "cohorts", "blockwise"])
+    def test_methods_bit_identical(self, mesh, method, codes):
+        vals = RNG.normal(size=N)
+        vals[RNG.random(N) < 0.1] = np.nan
+        eg = np.arange(UNIVERSE)
+        rs, _ = groupby_reduce(
+            vals, codes, func="nanmean", expected_groups=eg,
+            engine="sort", method=method, mesh=mesh,
+        )
+        rd, _ = groupby_reduce(
+            vals, codes, func="nanmean", expected_groups=eg,
+            method=method, mesh=mesh,
+        )
+        np.testing.assert_array_equal(np.asarray(rs), np.asarray(rd))
+
+    @pytest.mark.parametrize("func", ["sum", "nanvar", "nanargmax", "first"])
+    def test_mapreduce_families(self, mesh, func, codes):
+        vals = RNG.normal(size=N)
+        eg = np.arange(UNIVERSE)
+        rs, _ = groupby_reduce(
+            vals, codes, func=func, expected_groups=eg,
+            engine="sort", method="map-reduce", mesh=mesh,
+        )
+        rd, _ = groupby_reduce(
+            vals, codes, func=func, expected_groups=eg,
+            method="map-reduce", mesh=mesh,
+        )
+        np.testing.assert_array_equal(np.asarray(rs), np.asarray(rd))
+
+
+# ---------------------------------------------------------------------------
+# streaming: compact carry, checkpoint/resume, OOM ladder
+# ---------------------------------------------------------------------------
+
+
+class TestStreaming:
+    @pytest.mark.parametrize("func", ["nanmean", "sum", "nanmax", "nanvar", "nanmedian"])
+    def test_stream_bit_identical(self, func, codes):
+        vals = RNG.normal(size=N)
+        vals[RNG.random(N) < 0.1] = np.nan
+        eg = np.arange(UNIVERSE)
+        rs, _ = streaming_groupby_reduce(
+            vals, codes, func=func, expected_groups=eg, batch_len=700,
+            engine="sort",
+        )
+        rd, _ = streaming_groupby_reduce(
+            vals, codes, func=func, expected_groups=eg, batch_len=700,
+            engine="jax",
+        )
+        np.testing.assert_array_equal(np.asarray(rs), np.asarray(rd))
+
+    def test_stream_mesh_bit_identical(self, mesh, codes):
+        vals = RNG.normal(size=N)
+        eg = np.arange(UNIVERSE)
+        rs, _ = streaming_groupby_reduce(
+            vals, codes, func="nanmean", expected_groups=eg, batch_len=1024,
+            engine="sort", mesh=mesh,
+        )
+        rd, _ = streaming_groupby_reduce(
+            vals, codes, func="nanmean", expected_groups=eg, batch_len=1024,
+            engine="jax", mesh=mesh,
+        )
+        np.testing.assert_array_equal(np.asarray(rs), np.asarray(rd))
+
+    def test_stream_fused_bit_identical(self, codes):
+        from flox_tpu.streaming import streaming_groupby_aggregate_many
+
+        vals = RNG.normal(size=N)
+        eg = np.arange(UNIVERSE)
+        rs, _ = streaming_groupby_aggregate_many(
+            vals, codes, funcs=("sum", "count", "min", "max", "var"),
+            expected_groups=eg, batch_len=700, engine="sort",
+        )
+        rd, _ = streaming_groupby_aggregate_many(
+            vals, codes, funcs=("sum", "count", "min", "max", "var"),
+            expected_groups=eg, batch_len=700, engine="jax",
+        )
+        assert set(rs) == set(rd)
+        for f in rs:
+            np.testing.assert_array_equal(
+                np.asarray(rs[f]), np.asarray(rd[f]), err_msg=f
+            )
+
+    def test_kill_at_slab_k_resume(self, tmp_path, codes):
+        # the checkpointed carry is the COMPACT state; a resuming process
+        # recomputes the identical present table from the identical inputs,
+        # so the snapshot folds back bit-identically
+        from flox_tpu import faults
+
+        vals = RNG.normal(size=N)
+        eg = np.arange(UNIVERSE)
+        with flox_tpu.set_options(
+            stream_checkpoint_every=2, stream_checkpoint_path=str(tmp_path)
+        ):
+            with pytest.raises(Exception, match="killed|Killed|stream"):
+                with faults.inject(kill_at=(2800,)):
+                    streaming_groupby_reduce(
+                        vals, codes, func="nanmean", expected_groups=eg,
+                        batch_len=700, engine="sort",
+                    )
+            rs, _ = streaming_groupby_reduce(
+                vals, codes, func="nanmean", expected_groups=eg,
+                batch_len=700, engine="sort",
+            )
+        rd, _ = streaming_groupby_reduce(
+            vals, codes, func="nanmean", expected_groups=eg, batch_len=700,
+            engine="jax",
+        )
+        np.testing.assert_array_equal(np.asarray(rs), np.asarray(rd))
+
+    def test_numpy_engine_rejected(self, codes):
+        with pytest.raises(ValueError, match="numpy"):
+            streaming_groupby_reduce(
+                np.ones(N), codes, func="sum",
+                expected_groups=np.arange(UNIVERSE), engine="numpy",
+            )
+
+    def test_oom_ladder_bottom_names_sort_engine(self, codes):
+        # an ngroups-dominated dense stream whose ladder bottoms out gets
+        # the typed remedy, not a bare ladder-exhausted RuntimeError
+        from flox_tpu import faults
+        from flox_tpu.resilience import (
+            FATAL,
+            HighCardinalityOOMError,
+            classify_error,
+        )
+
+        vals = RNG.normal(size=N)
+        with pytest.raises(HighCardinalityOOMError, match="engine='sort'"):
+            with faults.inject(oom_at=(0,), oom_times=99):
+                streaming_groupby_reduce(
+                    vals, codes, func="nanmean",
+                    expected_groups=np.arange(UNIVERSE), batch_len=700,
+                    engine="jax",
+                )
+        # terminal: the classifier must never re-enter the split ladder
+        err = HighCardinalityOOMError("x")
+        err.__cause__ = faults.SimulatedOOM("RESOURCE_EXHAUSTED")
+        assert classify_error(err) == FATAL
+
+    def test_sorted_stream_splits_without_hint(self, codes):
+        # compact (sort-engine) streams never flag ngroups domination: the
+        # ladder handles their OOMs the ordinary way (split + recover).
+        # Integer-valued data: an OOM split changes slab boundaries, and
+        # float associativity across DIFFERENT boundaries is out of scope —
+        # exact sums keep the comparison byte-for-byte.
+        from flox_tpu import faults
+
+        vals = RNG.integers(-5, 5, N).astype(np.float64)
+        with faults.inject(oom_at=(0,), oom_times=1):
+            rs, _ = streaming_groupby_reduce(
+                vals, codes, func="nanmean",
+                expected_groups=np.arange(UNIVERSE), batch_len=700,
+                engine="sort",
+            )
+        rd, _ = streaming_groupby_reduce(
+            vals, codes, func="nanmean", expected_groups=np.arange(UNIVERSE),
+            batch_len=700, engine="jax",
+        )
+        np.testing.assert_array_equal(np.asarray(rs), np.asarray(rd))
+
+
+# ---------------------------------------------------------------------------
+# PresentGroups container
+# ---------------------------------------------------------------------------
+
+
+class TestPresentGroupsContainer:
+    def test_scatter_dense_fill(self):
+        pg = PresentGroups(np.array([1, 4]), np.array([2.0, 3.0, np.nan]), 6)
+        out = pg.scatter_dense()
+        np.testing.assert_array_equal(
+            out, [np.nan, 2.0, np.nan, np.nan, 3.0, np.nan]
+        )
+
+    def test_fully_present_roundtrip(self):
+        pg = PresentGroups(np.arange(4), np.array([[1.0, 2.0, 3.0, 4.0]]), 4)
+        np.testing.assert_array_equal(pg.scatter_dense(), [[1.0, 2.0, 3.0, 4.0]])
+
+    @pytest.mark.parametrize("op,expect", [
+        ("sum", 12.0), ("max", 10.0), ("min", 2.0), ("prod", 20.0),
+    ])
+    def test_merge_ops(self, op, expect):
+        a = PresentGroups(np.array([2, 7]), np.array([1.0, 2.0, 0.0]), 100)
+        b = PresentGroups(np.array([7, 50]), np.array([10.0, 20.0, 0.0]), 100)
+        m = a.merge(b, op)
+        assert list(m.present) == [2, 7, 50]
+        d = m.scatter_dense()
+        assert d[7] == expect
+        assert d[50] == 20.0
+
+    def test_merge_universe_mismatch_raises(self):
+        a = PresentGroups(np.array([0]), np.array([1.0, 0.0]), 10)
+        b = PresentGroups(np.array([0]), np.array([1.0, 0.0]), 11)
+        with pytest.raises(ValueError, match="universe"):
+            a.merge(b, "sum")
+
+    def test_cap_contract_raises(self):
+        with pytest.raises(ValueError, match="trailing axis"):
+            PresentGroups(np.array([0, 1, 2]), np.array([1.0, 2.0]), 10)
+
+
+# ---------------------------------------------------------------------------
+# routing, autotune family, cost-model prior, caches, gauges
+# ---------------------------------------------------------------------------
+
+
+class TestRoutingAndTuning:
+    def test_default_engine_option_routes_sort(self, codes):
+        import jax.numpy as jnp
+
+        # device input: the small-host numpy fast path does not apply, so
+        # engine=None resolves straight to the session default
+        vals = jnp.asarray(RNG.normal(size=N))
+        eg = np.arange(UNIVERSE)
+        with flox_tpu.set_options(default_engine="sort", telemetry=True):
+            from flox_tpu import telemetry
+
+            n0 = telemetry.METRICS.get("highcard.sort_dispatches")
+            rs, _ = groupby_reduce(vals, codes, func="nanmean", expected_groups=eg)
+            assert telemetry.METRICS.get("highcard.sort_dispatches") > n0
+        # return-type contract: a device-array input yields a device-array
+        # result even when routing scattered host-side
+        from flox_tpu import utils
+
+        assert utils.is_jax_array(rs)
+        rd, _ = groupby_reduce(
+            vals, codes, func="nanmean", expected_groups=eg, engine="jax"
+        )
+        np.testing.assert_array_equal(np.asarray(rs), np.asarray(rd))
+
+    def test_explicit_small_universe_sort_works(self):
+        # explicitly chosen sort below every threshold still runs (and is
+        # identical) — the thresholds gate only the automatic routing
+        codes = RNG.integers(0, 10, 256)
+        vals = RNG.normal(size=256)
+        _run_pair(vals, codes, "nanmean", expected_groups=np.arange(10))
+
+    def test_highcard_sweep_and_decide(self, codes):
+        import jax.numpy as jnp
+
+        from flox_tpu import autotune
+        from flox_tpu.autotune import (
+            _SWEEP_HIGHCARD_N_MAX,
+            _SWEEP_HIGHCARD_SIZE_MAX,
+        )
+
+        vals = jnp.asarray(RNG.normal(size=100_000))
+        big_codes = _sparse_codes(n=100_000)
+        with flox_tpu.set_options(autotune=True):
+            groupby_reduce(
+                vals, big_codes, func="nanmean",
+                expected_groups=np.arange(UNIVERSE),
+            )
+            rec = autotune.lookup(
+                "highcard", dtype="float64",
+                ngroups=min(UNIVERSE, _SWEEP_HIGHCARD_SIZE_MAX),
+                nelems=min(100_000, _SWEEP_HIGHCARD_N_MAX),
+            )
+        assert rec is not None
+        cands = rec.get("candidates") or {}
+        assert {"dense", "sort"} <= set(cands)
+        assert all(v["gbps"] > 0 for v in cands.values())
+
+    def test_seed_from_bench_highcard_field(self):
+        from flox_tpu import autotune
+
+        import flox_tpu.cache as cache
+
+        cache.clear_all()
+        n = autotune._seed_from_bench_record({
+            "platform": "cpu",
+            "workload": {},
+            "highcard": {
+                "ngroups": 1 << 20, "nelems": 1 << 16,
+                "dense_gbps": 1.0, "sort_gbps": 3.0,
+            },
+        })
+        assert n == 2
+        with flox_tpu.set_options(autotune=True):
+            rec = autotune.lookup(
+                "highcard", dtype="float32", ngroups=1 << 20, nelems=1 << 16
+            )
+            assert rec is not None
+            chosen = autotune.decide(
+                "highcard", "dense", ("dense", "sort"),
+                dtype="float32", ngroups=1 << 20, nelems=1 << 16,
+            )
+        assert chosen == "sort"
+        cache.clear_all()
+
+    def test_nearest_band_bounds_the_group_axis(self):
+        # the highcard winner is governed by ngroups (the crossover axis):
+        # a record swept at the capped universe must not serve decisions
+        # for universes on the other side of the crossover
+        from flox_tpu import autotune
+
+        import flox_tpu.cache as cache
+
+        cache.clear_all()
+        with flox_tpu.set_options(autotune=True):
+            autotune.record(
+                "highcard", "sort", 5.0, dtype="float32",
+                ngroups=1 << 20, nelems=1 << 16, source="seed",
+            )
+            near = autotune.lookup(
+                "highcard", dtype="float32", ngroups=1 << 19, nelems=1 << 16
+            )
+            far = autotune.lookup(
+                "highcard", dtype="float32", ngroups=1 << 12, nelems=1 << 16
+            )
+        assert near is not None
+        assert far is None, "a 2^20-universe record served a 2^12 decision"
+        cache.clear_all()
+
+    def test_analytic_prior_directions(self):
+        with flox_tpu.set_options(costmodel=True, telemetry=True):
+            from flox_tpu.costmodel import analytic_prior
+
+            assert analytic_prior(
+                "highcard", "dense", ("dense", "sort"),
+                dtype="float64", ngroups=50_000_000, nelems=100_000,
+            ) == "sort"
+            assert analytic_prior(
+                "highcard", "dense", ("dense", "sort"),
+                dtype="float64", ngroups=64, nelems=10_000_000,
+            ) == "dense"
+
+    def test_present_cache_registered(self, codes):
+        import flox_tpu.cache as cache
+
+        cache.clear_all()
+        present_groups(codes, UNIVERSE)
+        assert cache.stats()["present_tables"] == 1
+        # memo hit: same content -> same table object, no second entry
+        present_groups(codes.copy(), UNIVERSE)
+        assert cache.stats()["present_tables"] == 1
+        cache.clear_all()
+        assert cache.stats()["present_tables"] == 0
+
+    def test_sort_program_label_in_cost_ledger(self, codes):
+        from flox_tpu import telemetry
+
+        vals = RNG.normal(size=N)
+        with flox_tpu.set_options(telemetry=True):
+            groupby_reduce(
+                vals, codes, func="nanmean",
+                expected_groups=np.arange(UNIVERSE), engine="sort",
+            )
+            rows = telemetry.cost_by_program()
+        assert any(k.startswith("sort[") for k in rows), list(rows)
+
+
+# ---------------------------------------------------------------------------
+# the radix-binning Pallas kernel (interpret mode off-TPU)
+# ---------------------------------------------------------------------------
+
+
+class TestRadixBin:
+    def test_past_dense_vmem_cap(self):
+        # group counts past pallas_num_groups_max (512) are exactly the
+        # radixbin regime
+        import jax.numpy as jnp
+
+        from flox_tpu.pallas_kernels import segment_sum_radixbin_pallas
+
+        rng = np.random.default_rng(5)
+        n, k, size = 2048, 24, 1800
+        data = rng.normal(size=(n, k)).astype(np.float32)
+        codes = np.sort(rng.integers(0, size, n)).astype(np.int32)
+        out = segment_sum_radixbin_pallas(
+            jnp.asarray(data), jnp.asarray(codes), size, interpret=True
+        )
+        oracle = jax.ops.segment_sum(
+            jnp.asarray(data.astype(np.float64)), jnp.asarray(codes),
+            num_segments=size,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(oracle).astype(np.float32), rtol=2e-4
+        )
+
+    def test_ieee_markers_and_missing(self):
+        import jax.numpy as jnp
+
+        from flox_tpu.pallas_kernels import segment_sum_radixbin_pallas
+
+        rng = np.random.default_rng(6)
+        n, size = 600, 700
+        data = rng.normal(size=(n, 8)).astype(np.float32)
+        data[4, 2] = np.nan
+        data[9, 0] = np.inf
+        codes = rng.integers(0, size, n).astype(np.int32)
+        codes[17] = -1  # missing drops out
+        out = np.asarray(segment_sum_radixbin_pallas(
+            jnp.asarray(data), jnp.asarray(codes), size, interpret=True
+        ))
+        assert np.isnan(out[codes[4], 2])
+        assert np.isposinf(out[codes[9], 0])
+
+    def test_policy_dispatch(self):
+        # segment_sum_impl="radixbin" routes _seg through the blocked grid
+        # off-TPU via interpret mode; results match scatter to f32 accuracy
+        codes = RNG.integers(0, 2000, 4096)
+        vals = RNG.normal(size=4096).astype(np.float32)
+        eg = np.arange(2000)
+        with flox_tpu.set_options(segment_sum_impl="radixbin"):
+            r1, _ = groupby_reduce(vals, codes, func="nansum", expected_groups=eg, engine="jax")
+        r2, _ = groupby_reduce(vals, codes, func="nansum", expected_groups=eg, engine="jax")
+        np.testing.assert_allclose(np.asarray(r1), np.asarray(r2), rtol=1e-4)
+
+    def test_policy_guard_falls_back(self):
+        # past radixbin_num_groups_max the policy degrades to scatter
+        from flox_tpu.kernels import _segment_sum_impl
+
+        class _Probe:
+            dtype = np.dtype("float32")
+            shape = (4096,)
+            ndim = 1
+
+        with flox_tpu.set_options(
+            segment_sum_impl="radixbin", radixbin_num_groups_max=1024
+        ):
+            assert _segment_sum_impl(_Probe(), 2048) == "scatter"
+            assert _segment_sum_impl(_Probe(), 512) == "radixbin"

@@ -575,7 +575,32 @@ class TestMeshStreamingBlocked:
             np.asarray(got_var), np.asarray(exp_var), rtol=1e-9, equal_nan=True
         )
 
-    def test_non_additive_above_ceiling_raises(self, mesh):
+    def test_non_additive_above_ceiling_routes_to_sort(self, mesh):
+        # a non-additive agg over the ceiling used to be a dead end (no
+        # owner-blocked form for max); the present-groups engine now absorbs
+        # it — the carry tracks the <= 2000 present groups, not the 40k
+        # universe — bit-identical to the unconstrained dense run
+        import flox_tpu
+        from flox_tpu import groupby_reduce
+
+        rng = np.random.default_rng(17)
+        n, size = 2000, 40_000
+        labels = rng.integers(0, size, n)
+        vals = rng.normal(size=(4, n))
+        want, _ = groupby_reduce(
+            vals, labels, func="max", expected_groups=np.arange(size),
+            engine="jax",
+        )
+        with flox_tpu.set_options(dense_intermediate_bytes_max=2 * 2**20):
+            got, _ = streaming_groupby_reduce(
+                vals, labels, func="max", expected_groups=np.arange(size),
+                batch_len=800, mesh=mesh,
+            )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_non_additive_above_ceiling_pinned_dense_raises(self, mesh):
+        # an explicitly pinned dense engine is never second-guessed: the
+        # old actionable error stands
         import flox_tpu
 
         rng = np.random.default_rng(17)
@@ -586,7 +611,7 @@ class TestMeshStreamingBlocked:
             with pytest.raises(ValueError, match="cannot be distributed by group ownership"):
                 streaming_groupby_reduce(
                     vals, labels, func="max", expected_groups=np.arange(size),
-                    batch_len=800, mesh=mesh,
+                    batch_len=800, mesh=mesh, engine="jax",
                 )
 
 
