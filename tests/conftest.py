@@ -36,3 +36,39 @@ def ndevices() -> int:
 def engine(request):
     """Run engine-parameterized tests per engine (reference conftest.py:22-32)."""
     return request.param
+
+
+#: modules whose module-level locks the schedule-stress leg watches for
+#: acquisition-order inversions (the serve/fleet concurrency surface)
+_STRESS_WATCH = (
+    "flox_tpu.autotune",
+    "flox_tpu.exposition",
+    "flox_tpu.pipeline",
+    "flox_tpu.profiling",
+    "flox_tpu.telemetry",
+    "flox_tpu.serve.aot",
+    "flox_tpu.serve.breaker",
+    "flox_tpu.serve.dispatcher",
+)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _schedule_stress():
+    """CI's schedule-stress leg: ``FLOX_TPU_STRESS_SCHEDULE=1`` re-runs the
+    suite with the thread switch interval at ~1 µs and the serve plane's
+    module-level locks wrapped in acquisition-order-asserting proxies
+    (``faults.stress_schedule``) — a reintroduced race or lock-order
+    inversion fails here instead of once a month in production."""
+    if not os.environ.get("FLOX_TPU_STRESS_SCHEDULE"):
+        yield
+        return
+    from flox_tpu import faults
+
+    # FLOX_TPU_STRESS_ORDER_GRAPH: path to floxlint's --lock-graph JSON;
+    # seeding with the static edges makes one runtime acquire against the
+    # established order enough to fail
+    with faults.stress_schedule(
+        watch=_STRESS_WATCH,
+        order_graph=os.environ.get("FLOX_TPU_STRESS_ORDER_GRAPH") or None,
+    ):
+        yield

@@ -1226,3 +1226,471 @@ def test_callgraph_edges(tmp_path):
     assert graph.callees("graphmod.a") == {"graphmod.b"}
     assert graph.reachable("graphmod.a") == {"graphmod.b", "graphmod.c"}
     assert graph.reachable("graphmod.a", max_depth=1) == {"graphmod.b"}
+
+
+# ---------------------------------------------------------------------------
+# floxlint v3: concurrency & effect analysis (FLX013-FLX016, ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def _pkg_findings(pkg, rule):
+    return [f for f in lint_paths([pkg]) if f.rule == rule]
+
+
+def _write_pkg(tmp_path, files):
+    pkg = tmp_path / "conpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, text in files.items():
+        (pkg / name).write_text(text)
+    return pkg
+
+
+@pytest.mark.parametrize(
+    "pkg,anchor",
+    [("flx013_pkg", "state.py"), ("flx014_pkg", "order.py"),
+     ("flx015_pkg", "loop.py"), ("flx016_pkg", "handlers.py")],
+)
+def test_concurrency_package_fixtures(pkg, anchor):
+    root = FIXTURES / pkg
+    expected = set()
+    for f in root.rglob("*.py"):
+        expected |= expected_findings(f)
+    assert expected  # each package seeds at least one violation
+    actual = {(f.rule, Path(f.path).name, f.line) for f in lint_paths([root])}
+    want = set()
+    for f in root.rglob("*.py"):
+        for rule, line in expected_findings(f):
+            want.add((rule, f.name, line))
+    assert actual == want
+
+
+def test_flx013_unlocked_set_ready_reintroduction_fails(tmp_path):
+    # the exposition.set_ready bug this PR fixed: the readiness flag
+    # written lock-free while the scrape-thread writers hold _STATE_LOCK
+    pkg = _write_pkg(tmp_path, {"expo.py": (
+        "import threading\n\n"
+        "_SERVER_STATE = {'ready': False}\n"
+        "_STATE_LOCK = threading.Lock()\n\n\n"
+        "def set_ready(flag):\n"
+        "    _SERVER_STATE['ready'] = flag\n\n\n"
+        "def stop():\n"
+        "    with _STATE_LOCK:\n"
+        "        _SERVER_STATE['ready'] = False\n\n\n"
+        "def start():\n"
+        "    with _STATE_LOCK:\n"
+        "        _SERVER_STATE['ready'] = True\n"
+        "    threading.Thread(target=set_ready, args=(True,), daemon=True).start()\n"
+    )})
+    findings = _pkg_findings(pkg, "FLX013")
+    assert len(findings) == 1
+    assert "_SERVER_STATE" in findings[0].message
+    assert "_STATE_LOCK" in findings[0].message
+    # taking the lock clears it (the shipped fix)
+    (pkg / "expo.py").write_text((pkg / "expo.py").read_text().replace(
+        "def set_ready(flag):\n    _SERVER_STATE['ready'] = flag",
+        "def set_ready(flag):\n    with _STATE_LOCK:\n"
+        "        _SERVER_STATE['ready'] = flag",
+    ))
+    assert not _pkg_findings(pkg, "FLX013")
+
+
+def test_flx013_minority_lock_is_not_the_discipline(tmp_path):
+    # the fusion/mapreduce precision case: one caller holding a recovery
+    # guard around a cache clear must not make the guard the cache's
+    # "discipline" and flag every other (loop-confined) writer
+    pkg = _write_pkg(tmp_path, {"cachemod.py": (
+        "import threading\n\n"
+        "_CACHE: dict = {}\n"
+        "_GUARD = threading.Lock()\n\n\n"
+        "def put(k, v):\n"
+        "    _CACHE[k] = v\n\n\n"
+        "def put2(k, v):\n"
+        "    _CACHE[k] = v\n\n\n"
+        "def evict(k):\n"
+        "    del _CACHE[k]\n\n\n"
+        "def recover():\n"
+        "    with _GUARD:\n"
+        "        _CACHE.clear()\n\n\n"
+        "def spawn():\n"
+        "    threading.Thread(target=put, args=(1, 2)).start()\n"
+    )})
+    assert not _pkg_findings(pkg, "FLX013")
+
+
+def test_flx013_tie_between_candidate_locks_skips(tmp_path):
+    pkg = _write_pkg(tmp_path, {"tied.py": (
+        "import threading\n\n"
+        "_D: dict = {}\n"
+        "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n\n\n"
+        "def wa():\n"
+        "    with _A:\n"
+        "        _D['a'] = 1\n\n\n"
+        "def wb():\n"
+        "    with _B:\n"
+        "        _D['b'] = 1\n\n\n"
+        "def free():\n"
+        "    _D['c'] = 1\n\n\n"
+        "def spawn():\n"
+        "    threading.Thread(target=free).start()\n"
+    )})
+    assert not _pkg_findings(pkg, "FLX013")
+
+
+def test_flx013_signal_reachable_write_fires(tmp_path):
+    pkg = _write_pkg(tmp_path, {"sig.py": (
+        "import signal\n"
+        "import threading\n\n"
+        "_S: dict = {}\n"
+        "_L = threading.Lock()\n\n\n"
+        "def _on_term(signum, frame):\n"
+        "    _S['dumped'] = True\n\n\n"
+        "def locked():\n"
+        "    with _L:\n"
+        "        _S['x'] = 1\n\n\n"
+        "def locked2():\n"
+        "    with _L:\n"
+        "        _S['y'] = 1\n\n\n"
+        "def install():\n"
+        "    signal.signal(signal.SIGTERM, _on_term)\n"
+    )})
+    findings = _pkg_findings(pkg, "FLX013")
+    assert len(findings) == 1
+    assert "signal" in findings[0].message
+
+
+def test_flx014_multi_item_with_inversion(tmp_path):
+    # `with a, b:` against `with b, a:` is the same inversion as nesting
+    pkg = _write_pkg(tmp_path, {"multi.py": (
+        "import threading\n\n"
+        "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n\n\n"
+        "def fwd():\n"
+        "    with _A, _B:\n"
+        "        pass\n\n\n"
+        "def rev():\n"
+        "    with _B, _A:\n"
+        "        pass\n"
+    )})
+    findings = _pkg_findings(pkg, "FLX014")
+    assert len(findings) == 1
+    assert "_A" in findings[0].message and "_B" in findings[0].message
+
+
+def test_flx014_async_with_inversion(tmp_path):
+    pkg = _write_pkg(tmp_path, {"amod.py": (
+        "import asyncio\n\n"
+        "_A = asyncio.Lock()\n"
+        "_B = asyncio.Lock()\n\n\n"
+        "async def fwd():\n"
+        "    async with _A:\n"
+        "        async with _B:\n"
+        "            pass\n\n\n"
+        "async def rev():\n"
+        "    async with _B:\n"
+        "        async with _A:\n"
+        "            pass\n"
+    )})
+    assert len(_pkg_findings(pkg, "FLX014")) == 1
+
+
+def test_flx014_parameter_lock_does_not_cross_fire(tmp_path):
+    # a helper acquiring its lock parameter is one lock identity per
+    # function, not an alias of every caller's lock — two callers holding
+    # different locks around the same helper is NOT an inversion
+    pkg = _write_pkg(tmp_path, {"param.py": (
+        "import threading\n\n"
+        "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n\n\n"
+        "def helper(lock):\n"
+        "    with lock:\n"
+        "        pass\n\n\n"
+        "def via_a():\n"
+        "    with _A:\n"
+        "        helper(_B)\n\n\n"
+        "def via_b():\n"
+        "    with _B:\n"
+        "        helper(_A)\n"
+    )})
+    assert not _pkg_findings(pkg, "FLX014")
+
+
+def test_flx015_spawn_boundaries_end_reachability(tmp_path):
+    # to_thread with a functools.partial target and run_in_executor both
+    # move the callee off the loop: no finding on either path
+    pkg = _write_pkg(tmp_path, {"offload.py": (
+        "import asyncio\n"
+        "import functools\n\n\n"
+        "def dump(path):\n"
+        "    with open(path, 'w') as fh:\n"
+        "        fh.write('x')\n\n\n"
+        "async def via_partial():\n"
+        "    await asyncio.to_thread(functools.partial(dump, '/tmp/p'))\n\n\n"
+        "async def via_executor():\n"
+        "    loop = asyncio.get_running_loop()\n"
+        "    await loop.run_in_executor(None, dump, '/tmp/q')\n"
+    )})
+    assert not _pkg_findings(pkg, "FLX015")
+
+
+def test_flx015_nested_coroutine_reported_once(tmp_path):
+    # the blocking site inside the inner coroutine is the inner root's
+    # finding; the outer awaiting it must not duplicate it
+    pkg = _write_pkg(tmp_path, {"nested.py": (
+        "import time\n\n\n"
+        "async def inner():\n"
+        "    time.sleep(0.1)\n\n\n"
+        "async def outer():\n"
+        "    await inner()\n"
+    )})
+    findings = _pkg_findings(pkg, "FLX015")
+    assert len(findings) == 1
+    assert "inner" in findings[0].message
+
+
+def test_flx015_async_flight_dump_reintroduction_fails(tmp_path):
+    # the dispatcher/drain bug this PR fixed: a file-writing dump called
+    # directly from a coroutine stalls every in-flight request behind disk
+    pkg = _write_pkg(tmp_path, {"srv.py": (
+        "import asyncio\n\n\n"
+        "def flight_dump(reason):\n"
+        "    with open('/tmp/dump', 'w') as fh:\n"
+        "        fh.write(reason)\n\n\n"
+        "async def drain():\n"
+        "    flight_dump('drain')\n"
+    )})
+    findings = _pkg_findings(pkg, "FLX015")
+    assert len(findings) == 1
+    assert "file-io" in findings[0].message
+    # offloading it (the shipped fix) clears the finding
+    (pkg / "srv.py").write_text((pkg / "srv.py").read_text().replace(
+        "    flight_dump('drain')",
+        "    await asyncio.to_thread(flight_dump, 'drain')",
+    ))
+    assert not _pkg_findings(pkg, "FLX015")
+
+
+def test_flx016_blocking_queue_in_handler_fires(tmp_path):
+    pkg = _write_pkg(tmp_path, {"h.py": (
+        "import queue\n"
+        "import signal\n\n"
+        "_Q: queue.Queue = queue.Queue()\n\n\n"
+        "def _on_usr1(signum, frame):\n"
+        "    _Q.get(timeout=1)\n\n\n"
+        "def install():\n"
+        "    signal.signal(signal.SIGUSR1, _on_usr1)\n"
+    )})
+    findings = _pkg_findings(pkg, "FLX016")
+    assert len(findings) == 1
+    assert "_on_usr1" in findings[0].message
+
+
+def test_flx016_rlock_and_thread_handoff_are_clean(tmp_path):
+    pkg = _write_pkg(tmp_path, {"ok.py": (
+        "import signal\n"
+        "import threading\n\n"
+        "_R = threading.RLock()\n"
+        "_S: dict = {}\n\n\n"
+        "def _flush():\n"
+        "    with _R:\n"
+        "        _S['x'] = 1\n\n\n"
+        "def _on_term(signum, frame):\n"
+        "    _flush()\n\n\n"
+        "def _on_usr2(signum, frame):\n"
+        "    threading.Thread(target=_flush, daemon=True).start()\n\n\n"
+        "def install():\n"
+        "    signal.signal(signal.SIGTERM, _on_term)\n"
+        "    signal.signal(getattr(signal, 'SIGUSR2', signal.SIGTERM), _on_usr2)\n"
+    )})
+    assert not _pkg_findings(pkg, "FLX016")
+
+
+# -- effect-summary unit tests ----------------------------------------------
+
+
+def _build_index(pkg):
+    from tools.floxlint.core import iter_python_files
+    from tools.floxlint.index import ProjectIndex
+
+    groups = {}
+    for f, root in iter_python_files([str(pkg)]):
+        groups.setdefault(root, []).append(f)
+    (root, files), = groups.items()
+    return ProjectIndex.build(files, root)
+
+
+def test_effects_lock_on_self_attribute(tmp_path):
+    from tools.floxlint import effects as fx
+
+    pkg = _write_pkg(tmp_path, {"cls.py": (
+        "import threading\n\n\n"
+        "class Registry:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()\n\n"
+        "    def put(self, k, v):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )})
+    effects = fx.compute_effects(_build_index(pkg))
+    acq = effects["conpkg.cls.Registry.put"].acquisitions
+    assert [a.lock for a in acq] == ["conpkg.cls.Registry._lock"]
+    assert acq[0].kind == fx.RLOCK
+
+
+def test_effects_multi_item_with_held_ordering(tmp_path):
+    from tools.floxlint import effects as fx
+
+    pkg = _write_pkg(tmp_path, {"held.py": (
+        "import threading\n\n"
+        "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n\n\n"
+        "def both():\n"
+        "    with _A, _B:\n"
+        "        pass\n\n\n"
+        "def pair():\n"
+        "    _A.acquire()\n"
+        "    _B.acquire()\n"
+        "    _B.release()\n"
+        "    _A.release()\n"
+    )})
+    effects = fx.compute_effects(_build_index(pkg))
+    both = effects["conpkg.held.both"].acquisitions
+    assert [(a.lock.rsplit(".", 1)[1], a.held_before) for a in both] == [
+        ("_A", ()), ("_B", ("conpkg.held._A",)),
+    ]
+    pair = effects["conpkg.held.pair"].acquisitions
+    assert [a.held_before for a in pair] == [(), ("conpkg.held._A",)]
+
+
+def test_effects_blocking_taxonomy(tmp_path):
+    from tools.floxlint import effects as fx
+
+    pkg = _write_pkg(tmp_path, {"blk.py": (
+        "import queue\n"
+        "import subprocess\n"
+        "import time\n\n"
+        "_Q: queue.Queue = queue.Queue()\n\n\n"
+        "def nap():\n"
+        "    time.sleep(1)\n\n\n"
+        "def run():\n"
+        "    subprocess.run(['true'])\n\n\n"
+        "def pull():\n"
+        "    return _Q.get()\n\n\n"
+        "def poll():\n"
+        "    return _Q.get_nowait()\n"
+    )})
+    effects = fx.compute_effects(_build_index(pkg))
+
+    def kinds(fn):
+        return [b.kind for b in effects[f"conpkg.blk.{fn}"].blocking]
+
+    assert kinds("nap") == [fx.SLEEP]
+    assert kinds("run") == [fx.SUBPROCESS]
+    assert kinds("pull") == [fx.QUEUE_OP]
+    assert kinds("poll") == []  # get_nowait never blocks
+
+
+# -- lock-order graph + acceptance ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def flox_tpu_lock_graph():
+    # one model build shared by the graph-acceptance tests — the full-tree
+    # analysis costs seconds and the assertions are read-only
+    from tools.floxlint.concurrency import lock_graph_for_paths
+
+    return lock_graph_for_paths([str(REPO / "flox_tpu")])
+
+
+def test_lock_order_graph_over_flox_tpu_is_cycle_free(flox_tpu_lock_graph):
+    # the acceptance criterion: the package's global acquisition order is
+    # consistent — FLX014 stays silent AND the artifact says 0 cycles
+    assert flox_tpu_lock_graph.nodes, "expected module-level locks in flox_tpu"
+    assert flox_tpu_lock_graph.cycles() == []
+
+
+def test_lock_graph_names_match_runtime_watcher_naming(flox_tpu_lock_graph):
+    # the stress harness wraps locks as "<module>.<attr>" — the static
+    # graph must use the same ids or seeding the watcher is meaningless
+    assert "flox_tpu.exposition._STATE_LOCK" in flox_tpu_lock_graph.nodes
+    assert "flox_tpu.telemetry._EXPORT_LOCK" in flox_tpu_lock_graph.nodes
+
+
+# -- CLI: --explain / --lock-graph ------------------------------------------
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULES))
+def test_cli_explain_every_rule(rule_id, capsys):
+    rc = floxlint_main(["--explain", rule_id])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert rule_id in out
+    assert RULES[rule_id].name in out
+
+
+def test_cli_explain_unknown_rule_is_usage_error(capsys):
+    rc = floxlint_main(["--explain", "FLX999"])
+    assert rc == 2
+    assert "FLX999" in capsys.readouterr().err
+
+
+def test_cli_lock_graph_json(tmp_path, capsys):
+    out = tmp_path / "locks.json"
+    rc = floxlint_main(["--lock-graph", str(out), str(REPO / "flox_tpu")])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["version"] == 1
+    assert doc["cycles"] == []
+    ids = {n["id"] for n in doc["nodes"]}
+    assert "flox_tpu.exposition._STATE_LOCK" in ids
+    assert "0 cycle(s)" in capsys.readouterr().err
+
+
+def test_cli_lock_graph_dot(tmp_path):
+    # format coverage only, so the small fixture package keeps it cheap
+    out = tmp_path / "locks.dot"
+    rc = floxlint_main(["--lock-graph", str(out), str(FIXTURES / "flx014_pkg")])
+    assert rc == 0
+    text = out.read_text()
+    assert text.startswith("digraph lock_order")
+    assert "flx014_pkg.order._A" in text
+
+
+def test_cli_lock_graph_stdout(capsys):
+    rc = floxlint_main(["--lock-graph", "-", str(FIXTURES / "flx014_pkg")])
+    captured = capsys.readouterr()
+    assert rc == 0
+    doc = json.loads(captured.out)
+    assert doc["version"] == 1
+    assert doc["cycles"]  # the fixture package seeds an inversion
+
+
+def test_cli_lock_graph_requires_paths(capsys):
+    rc = floxlint_main(["--lock-graph", "out.json"])
+    assert rc == 2
+    assert "needs paths" in capsys.readouterr().err
+
+
+# -- the shipped serve-plane fixes stay fixed --------------------------------
+
+
+def test_async_flight_dump_call_sites_are_offloaded():
+    # dispatcher watchdog/device-loss and the drain path write the flight
+    # record through asyncio.to_thread — a bare call from a coroutine
+    # reintroduces the loop stall (and FLX015 would flag it again)
+    import ast as _ast
+
+    for rel in ("flox_tpu/serve/dispatcher.py", "flox_tpu/serve/__main__.py"):
+        tree = _ast.parse((REPO / rel).read_text())
+        for node in _ast.walk(tree):
+            if not isinstance(node, _ast.AsyncFunctionDef):
+                continue
+            for call in _ast.walk(node):
+                if not isinstance(call, _ast.Call):
+                    continue
+                fn = call.func
+                assert not (
+                    isinstance(fn, _ast.Attribute)
+                    and fn.attr == "flight_dump"
+                ), f"bare flight_dump call in coroutine at {rel}:{call.lineno}"

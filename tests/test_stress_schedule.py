@@ -1,0 +1,232 @@
+"""Tests for ``faults.stress_schedule`` — the schedule-stress race harness
+(ISSUE 16): switch-interval handling, lock wrapping/unwrapping, the
+acquisition-order watcher's inversion/self-deadlock detection, static-graph
+seeding, and probes that the serve-plane races fixed in this PR stay fixed.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import types
+
+import pytest
+
+from flox_tpu import faults
+from flox_tpu.faults import LockOrderViolation, stress_schedule
+
+
+def _demo_module(name="stress_demo_mod"):
+    mod = types.ModuleType(name)
+    mod._A = threading.Lock()
+    mod._B = threading.Lock()
+    mod._R = threading.RLock()
+    sys.modules[name] = mod
+    return mod
+
+
+@pytest.fixture
+def demo():
+    mod = _demo_module()
+    yield mod
+    sys.modules.pop(mod.__name__, None)
+
+
+def test_switch_interval_set_and_restored():
+    prev = sys.getswitchinterval()
+    with stress_schedule(switch_interval=1e-6) as watcher:
+        assert watcher is None  # nothing watched
+        assert sys.getswitchinterval() == pytest.approx(1e-6)
+    assert sys.getswitchinterval() == pytest.approx(prev)
+
+
+def test_switch_interval_restored_on_error():
+    prev = sys.getswitchinterval()
+    with pytest.raises(RuntimeError):
+        with stress_schedule(switch_interval=1e-6):
+            raise RuntimeError("body failed")
+    assert sys.getswitchinterval() == pytest.approx(prev)
+
+
+def test_wraps_and_restores_module_locks(demo):
+    raw_a, raw_r = demo._A, demo._R
+    with stress_schedule(watch=(demo.__name__,)) as watcher:
+        assert watcher is not None
+        assert demo._A is not raw_a  # proxied
+        with demo._A:  # the proxy is a drop-in context manager
+            pass
+        assert not demo._A.locked()
+    assert demo._A is raw_a and demo._R is raw_r  # originals restored
+
+
+def test_lock_order_inversion_raises(demo):
+    with stress_schedule(watch=(demo.__name__,)):
+        with demo._A:
+            with demo._B:
+                pass
+        with pytest.raises(LockOrderViolation) as exc:
+            with demo._B:
+                with demo._A:
+                    pass
+        msg = str(exc.value)
+        assert "_A" in msg and "_B" in msg and "inversion" in msg
+
+
+def test_self_reentry_raises_instead_of_deadlocking(demo):
+    with stress_schedule(watch=(demo.__name__,)):
+        with pytest.raises(LockOrderViolation, match="self-deadlock"):
+            with demo._A:
+                with demo._A:
+                    pass
+
+
+def test_rlock_reentry_allowed(demo):
+    with stress_schedule(watch=(demo.__name__,)):
+        with demo._R:
+            with demo._R:
+                pass
+        assert True  # reached without a violation
+
+
+def test_release_pops_held_stack(demo):
+    # sequential (non-nested) acquisitions record no order edges; the
+    # cumulative graph still catches a later genuine inversion
+    with stress_schedule(watch=(demo.__name__,)) as watcher:
+        with demo._A:
+            pass
+        with demo._B:
+            with demo._A:
+                pass  # order is now B -> A
+        assert (f"{demo.__name__}._B", f"{demo.__name__}._A") in watcher.edges
+        with pytest.raises(LockOrderViolation):
+            with demo._A:
+                with demo._B:
+                    pass
+
+
+def test_seeded_graph_from_dict(demo):
+    # one runtime acquire against the statically-established order fails
+    seed = {"edges": [{"from": f"{demo.__name__}._A",
+                       "to": f"{demo.__name__}._B",
+                       "site": "static.py:1"}]}
+    with stress_schedule(watch=(demo.__name__,), order_graph=seed):
+        with pytest.raises(LockOrderViolation, match="static.py:1"):
+            with demo._B:
+                with demo._A:
+                    pass
+
+
+def test_seeded_graph_from_file(tmp_path, demo):
+    path = tmp_path / "locks.json"
+    path.write_text(json.dumps({"edges": [
+        {"from": f"{demo.__name__}._A", "to": f"{demo.__name__}._B",
+         "site": "static.py:1"},
+    ]}))
+    with stress_schedule(watch=(demo.__name__,), order_graph=str(path)):
+        with pytest.raises(LockOrderViolation):
+            with demo._B:
+                with demo._A:
+                    pass
+
+
+def test_nonblocking_acquire_failure_is_not_recorded(demo):
+    with stress_schedule(watch=(demo.__name__,)) as watcher:
+        raw = demo._A._inner
+        raw.acquire()  # another owner holds the underlying lock
+        try:
+            assert demo._A.acquire(blocking=False) is False
+        finally:
+            raw.release()
+        # a failed acquire must not leave _A on the held stack
+        with demo._A:
+            pass
+        assert watcher.edges == {}
+
+
+def test_cross_thread_inversion_caught(demo):
+    # thread 1 establishes A -> B; thread 2's B -> A attempt must raise in
+    # thread 2, not deadlock the suite
+    errors: list[BaseException] = []
+    with stress_schedule(watch=(demo.__name__,)):
+        def fwd():
+            with demo._A:
+                with demo._B:
+                    pass
+
+        def rev():
+            try:
+                with demo._B:
+                    with demo._A:
+                        pass
+            except LockOrderViolation as exc:
+                errors.append(exc)
+
+        t1 = threading.Thread(target=fwd)
+        t1.start(); t1.join()
+        t2 = threading.Thread(target=rev)
+        t2.start(); t2.join()
+    assert len(errors) == 1
+
+
+# -- the races this PR fixed stay fixed --------------------------------------
+
+
+class _ProbeLock:
+    def __init__(self):
+        self.events: list[str] = []
+
+    def __enter__(self):
+        self.events.append("acquire")
+        return self
+
+    def __exit__(self, *exc):
+        self.events.append("release")
+        return False
+
+
+def test_exposition_set_ready_takes_state_lock(monkeypatch):
+    from flox_tpu import exposition
+
+    probe = _ProbeLock()
+    monkeypatch.setattr(exposition, "_STATE_LOCK", probe)
+    exposition.set_ready(False, reason="probe")
+    assert probe.events == ["acquire", "release"]
+    assert exposition.ready() is False
+    assert exposition.ready_reason() == "probe"
+    exposition.set_ready(True)
+
+
+def test_autotune_register_atexit_takes_lock(monkeypatch):
+    from flox_tpu import autotune
+
+    probe = _ProbeLock()
+    monkeypatch.setattr(autotune, "_LOCK", probe)
+    monkeypatch.setitem(autotune._AUTOTUNE_STATE, "atexit", True)
+    autotune._register_atexit()  # already registered: early return, but locked
+    assert probe.events == ["acquire", "release"]
+
+
+def test_set_ready_races_clean_under_stress():
+    # the set_ready/stop write-write race fixed in this PR, driven hard:
+    # flipping threads under a ~1 µs switch interval with the proxied lock
+    # asserting order — consistent final state, no violation
+    from flox_tpu import exposition
+
+    with stress_schedule(watch=("flox_tpu.exposition",)):
+        def flip(n):
+            for i in range(200):
+                exposition.set_ready(i % 2 == 0, reason=f"t{n}")
+
+        threads = [threading.Thread(target=flip, args=(n,)) for n in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    exposition.set_ready(True)
+    assert exposition.ready() is True
+
+
+def test_stress_schedule_exports():
+    assert "stress_schedule" in faults.__all__
+    assert "LockOrderViolation" in faults.__all__

@@ -33,13 +33,32 @@ of file-at-a-time:
 * FLX011 — host-sync through helpers: interprocedural FLX001 — a traced
   function calling a local helper that ``.item()``s / ``np.*``s its traced
   argument.
+* FLX012 — serve-unforensic except: broad serve-plane handlers that swallow
+  without classifying or flight-recording.
+
+The v3 concurrency rules add a per-function effect analysis (``effects.py``:
+locks acquired with held-sets, blocking calls, shared-state writes) and an
+interprocedural concurrency model (``concurrency.py``: spawn sites,
+thread/signal reachability, held-at-entry meet, the global lock
+acquisition-order graph) on top of the same index:
+
+* FLX013 — unlocked shared write: module-level mutable state written on a
+  thread- or signal-reachable path without the lock its other writers hold.
+* FLX014 — lock-order inversion: a cycle in the global acquisition-order
+  graph (export it with ``--lock-graph out.json``/``.dot``).
+* FLX015 — blocking call on the event loop: sleep/file/socket/subprocess/
+  queue/device-sync calls reachable from a coroutine with no
+  ``to_thread``/executor boundary.
+* FLX016 — signal-unsafe handler: a signal handler reaching a non-reentrant
+  lock acquisition or a blocking wait.
 
 Run as ``python -m tools.floxlint flox_tpu/ tools/``. Output formats:
 ``human`` (default), ``json``, and ``sarif`` (SARIF 2.1.0 for GitHub code
 scanning). ``--baseline FILE`` suppresses known findings and fails on
 baseline drift (stale entries); ``--update-baseline`` writes the file.
 ``--fix`` applies the mechanical rewrites (FLX007 eager logging -> lazy
-%-args, FLX004 version-gate wrapping). Suppress a finding with a trailing
+%-args, FLX004 version-gate wrapping). ``--explain FLXnnn`` prints a rule's
+rationale, example, and fix from the registry. Suppress a finding with a trailing
 ``# floxlint: disable=FLX001`` comment (comma-separated rule ids or
 ``all``), the ``# noqa: FLX001`` alias, or a whole file with
 ``# floxlint: disable-file=FLX001``.

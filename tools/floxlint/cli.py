@@ -10,7 +10,7 @@ import sys
 from typing import Sequence
 
 from .core import LintError, lint_run
-from .registry import RULES, get_rules, rule_id_range
+from .registry import RULES, explain_rule, get_rules, rule_id_range
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -63,6 +63,24 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule table and exit"
     )
+    parser.add_argument(
+        "--explain",
+        metavar="FLXnnn",
+        help=(
+            "print one rule's documentation, example finding, and fix "
+            "pattern (from the registry, so it cannot drift) and exit"
+        ),
+    )
+    parser.add_argument(
+        "--lock-graph",
+        metavar="FILE",
+        help=(
+            "write the computed lock-acquisition-order graph over the given "
+            "paths to FILE (.dot for graphviz, anything else as JSON; '-' "
+            "for stdout) and exit — the review artifact PRs diff when they "
+            "add locks"
+        ),
+    )
     return parser
 
 
@@ -72,6 +90,15 @@ def main(argv: Sequence[str] | None = None) -> int:
         for rule_id, rule in sorted(RULES.items()):
             print(f"{rule_id}  {rule.name}\n       {rule.description}")
         return 0
+    if args.explain:
+        try:
+            print(explain_rule(args.explain), end="")
+        except KeyError as exc:
+            print(f"floxlint: {exc.args[0]}", file=sys.stderr)
+            return 2
+        return 0
+    if args.lock_graph:
+        return _emit_lock_graph(args.paths, args.lock_graph)
     if not args.paths:
         print("floxlint: no paths given (try: python -m tools.floxlint flox_tpu/)", file=sys.stderr)
         return 2
@@ -147,3 +174,39 @@ def main(argv: Sequence[str] | None = None) -> int:
 
         print(format_human(findings, files_checked=files_checked))
     return 1 if findings or stale else 0
+
+
+def _emit_lock_graph(paths: Sequence[str], out: str) -> int:
+    """``--lock-graph FILE``: compute the acquisition-order graph over the
+    given paths and write it as dot (``*.dot``) or JSON."""
+    import json
+
+    from .concurrency import lock_graph_for_paths
+
+    if not paths:
+        sys.stderr.write(
+            "floxlint: --lock-graph needs paths to analyze "
+            "(try: python -m tools.floxlint --lock-graph out.json flox_tpu/)\n"
+        )
+        return 2
+    try:
+        graph = lock_graph_for_paths(paths)
+    except LintError as exc:
+        sys.stderr.write(f"floxlint: {exc}\n")
+        return 2
+    payload = (
+        graph.to_dot() if out.endswith(".dot") else json.dumps(graph.to_json(), indent=2) + "\n"
+    )
+    if out == "-":
+        sys.stdout.write(payload)
+    else:
+        with open(out, "w") as fh:
+            fh.write(payload)
+    cycles = graph.cycles()
+    sys.stderr.write(
+        f"floxlint: lock-order graph: {len(graph.nodes)} lock(s), "
+        f"{len(graph.edges)} edge(s), {len(cycles)} cycle(s)"
+        + ("" if out == "-" else f" -> {out}")
+        + "\n"
+    )
+    return 0

@@ -1,0 +1,494 @@
+"""Interprocedural concurrency model for FLX013–FLX016.
+
+Composes the per-function effect summaries (:mod:`.effects`) over an
+extended call graph into the whole-program facts the concurrency rules
+need:
+
+* **entry points** — thread entries (``threading.Thread(target=…)`` /
+  ``Timer``, ``executor.submit``, ``asyncio.to_thread``,
+  ``loop.run_in_executor``) and signal handlers (``signal.signal``), with
+  the spawn *target* resolved through import aliases, ``self`` methods,
+  and ``functools.partial`` wrappers;
+* **extended call edges** — on top of the plain-function edges the base
+  :class:`~tools.floxlint.callgraph.CallGraph` resolves, this adds
+  ``self.method()`` receivers and locals bound to ``functools.partial``.
+  Spawn sites are deliberately *not* call edges: work handed to a thread
+  leaves the spawning context (an ``asyncio.to_thread`` boundary ends
+  FLX015's event-loop reachability, and a handler that only spawns a
+  daemon thread is signal-safe for FLX016);
+* **held-at-entry** — for each function, the lock set held on *every*
+  resolved call path into it (a meet-over-callers fixpoint), so a helper
+  whose callers all hold the registry lock counts as protected;
+* **thread reachability** — the closure of spawn targets under call (and
+  further spawn) edges;
+* the **lock-order graph** — an edge ``A -> B`` wherever B is acquired
+  while A is held, locally or through any chain of calls. Cycles are
+  FLX014 findings, and ``--lock-graph`` emits the graph as a JSON/dot
+  review artifact.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Iterable
+
+from . import effects as fx
+from .rules.common import dotted_name
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from .core import ProjectContext
+    from .index import FunctionInfo, ModuleInfo, ProjectIndex
+
+#: spawn kinds
+THREAD, EXECUTOR, TO_THREAD, TIMER, SIGNAL = (
+    "thread", "executor", "to_thread", "timer", "signal",
+)
+
+_MAX_DEPTH = 8  #: reachability bound for the rule traversals
+
+
+@dataclass(frozen=True)
+class SpawnSite:
+    caller: str  #: qualname of the spawning function
+    target: str  #: qualname of the entry-point function
+    kind: str  #: THREAD / EXECUTOR / TO_THREAD / TIMER / SIGNAL
+    lineno: int
+    col: int
+
+
+@dataclass(frozen=True)
+class CallContext:
+    caller: str
+    callee: str
+    held: tuple[str, ...]  #: locks held locally at the call site
+    lineno: int
+    col: int
+
+
+@dataclass
+class LockOrderGraph:
+    """Directed acquisition-order graph over canonical lock ids."""
+
+    #: lock id -> kind (effects.LOCK / RLOCK / ASYNC_LOCK)
+    nodes: dict[str, str] = field(default_factory=dict)
+    #: (src, dst) -> "path:line" provenance of the first edge witness
+    edges: dict[tuple[str, str], str] = field(default_factory=dict)
+
+    def add_edge(self, src: str, dst: str, site: str) -> None:
+        if src == dst and self.nodes.get(dst) == fx.RLOCK:
+            return  # re-entering an RLock is its design contract
+        self.edges.setdefault((src, dst), site)
+
+    def successors(self, lock: str) -> list[str]:
+        return [d for (s, d) in self.edges if s == lock]
+
+    def cycles(self) -> list[list[str]]:
+        """Every elementary inconsistency: self-loops plus one cycle per
+        strongly-connected component with more than one node."""
+        out: list[list[str]] = []
+        for (s, d) in sorted(self.edges):
+            if s == d:
+                out.append([s])
+        for scc in self._sccs():
+            if len(scc) > 1:
+                out.append(sorted(scc))
+        return out
+
+    def _sccs(self) -> list[list[str]]:
+        """Tarjan over the edge set (iterative — fixture graphs are tiny but
+        the real one spans the package)."""
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on_stack: set[str] = set()
+        stack: list[str] = []
+        counter = [0]
+        sccs: list[list[str]] = []
+        adj: dict[str, list[str]] = {}
+        for (s, d) in self.edges:
+            adj.setdefault(s, []).append(d)
+            adj.setdefault(d, [])
+
+        def strongconnect(v: str) -> None:
+            work = [(v, 0)]
+            while work:
+                node, pi = work.pop()
+                if pi == 0:
+                    index[node] = low[node] = counter[0]
+                    counter[0] += 1
+                    stack.append(node)
+                    on_stack.add(node)
+                recurse = False
+                for i in range(pi, len(adj[node])):
+                    w = adj[node][i]
+                    if w not in index:
+                        work.append((node, i + 1))
+                        work.append((w, 0))
+                        recurse = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if recurse:
+                    continue
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    sccs.append(scc)
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        return sccs
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "nodes": [
+                {"id": n, "kind": k} for n, k in sorted(self.nodes.items())
+            ],
+            "edges": [
+                {"from": s, "to": d, "site": site}
+                for (s, d), site in sorted(self.edges.items())
+            ],
+            "cycles": self.cycles(),
+        }
+
+    def to_dot(self) -> str:
+        lines = ["digraph lock_order {"]
+        for n, k in sorted(self.nodes.items()):
+            shape = "box" if k == fx.RLOCK else "ellipse"
+            lines.append(f'  "{n}" [shape={shape}, label="{n}\\n({k})"];')
+        for (s, d), site in sorted(self.edges.items()):
+            lines.append(f'  "{s}" -> "{d}" [label="{site}"];')
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+
+class ConcurrencyModel:
+    """All interprocedural concurrency facts for one project index."""
+
+    def __init__(self, index: "ProjectIndex") -> None:
+        self.index = index
+        self.effects = fx.compute_effects(index)
+        self.lock_table = fx.lock_defs(index)
+        #: caller -> resolved direct-call callees (extended resolution)
+        self.edges: dict[str, set[str]] = {}
+        self.call_contexts: list[CallContext] = []
+        self.spawns: list[SpawnSite] = []
+        self._build_edges_and_spawns()
+        self.thread_entries: set[str] = {
+            s.target for s in self.spawns if s.kind != SIGNAL
+        }
+        self.signal_entries: set[str] = {
+            s.target for s in self.spawns if s.kind == SIGNAL
+        }
+        self.spawn_kind: dict[str, str] = {}
+        for s in self.spawns:
+            self.spawn_kind.setdefault(s.target, s.kind)
+        self.thread_reachable: set[str] = self._reach(self.thread_entries)
+        self.signal_reachable: set[str] = self._reach(self.signal_entries)
+        self.held_at_entry: dict[str, frozenset[str]] = self._held_fixpoint()
+        self.lock_graph: LockOrderGraph = self._build_lock_graph()
+
+    # -- construction --------------------------------------------------------
+
+    def _build_edges_and_spawns(self) -> None:
+        for mod in self.index.modules.values():
+            for fi in mod.functions.values():
+                eff = self.effects[fi.qualname]
+                self.edges.setdefault(fi.qualname, set())
+                partials = self._local_partials(mod, fi)
+                for rec in eff.calls:
+                    callee = self._resolve_callable(
+                        mod, fi, rec.call.func, partials
+                    )
+                    if callee is not None:
+                        self.edges[fi.qualname].add(callee)
+                        self.call_contexts.append(
+                            CallContext(
+                                caller=fi.qualname,
+                                callee=callee,
+                                held=rec.held,
+                                lineno=rec.call.lineno,
+                                col=rec.call.col_offset,
+                            )
+                        )
+                    self._detect_spawn(mod, fi, rec.call, partials, eff)
+
+    def _local_partials(self, mod: "ModuleInfo", fi: "FunctionInfo") -> dict[str, str]:
+        """Local name -> qualname for ``g = functools.partial(f, …)``."""
+        out: dict[str, str] = {}
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+                continue
+            target = self._unwrap_partial(mod, fi, node.value, out)
+            if target is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = target
+        return out
+
+    def _unwrap_partial(
+        self,
+        mod: "ModuleInfo",
+        fi: "FunctionInfo",
+        call: ast.Call,
+        partials: dict[str, str],
+    ) -> str | None:
+        resolved = mod.imports.resolve(call.func)
+        if resolved not in ("functools.partial", "partial") or not call.args:
+            return None
+        return self._resolve_callable(mod, fi, call.args[0], partials)
+
+    def _resolve_callable(
+        self,
+        mod: "ModuleInfo",
+        fi: "FunctionInfo",
+        expr: ast.AST,
+        partials: dict[str, str],
+    ) -> str | None:
+        """Qualname of the project function ``expr`` denotes: a dotted name
+        (through aliases/re-exports), a ``self.method``, a local bound to a
+        ``functools.partial``, or an inline partial call."""
+        if isinstance(expr, ast.Call):
+            return self._unwrap_partial(mod, fi, expr, partials)
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        if head == "self" and rest and "." not in rest:
+            prefix = fi.qualname.rsplit(".", 1)[0]
+            while prefix and prefix != mod.name:
+                cand = f"{prefix}.{rest}"
+                if self.index.function(cand) is not None:
+                    return cand
+                prefix = prefix.rsplit(".", 1)[0] if "." in prefix else ""
+            return None
+        if not rest and head in partials:
+            return partials[head]
+        resolved = self.index.resolve_symbol(mod.name, name)
+        if resolved is not None and self.index.function(resolved) is not None:
+            return resolved
+        return None
+
+    def _detect_spawn(
+        self,
+        mod: "ModuleInfo",
+        fi: "FunctionInfo",
+        call: ast.Call,
+        partials: dict[str, str],
+        eff: fx.FunctionEffects,
+    ) -> None:
+        resolved = mod.imports.resolve(call.func)
+
+        def spawn(target_expr: ast.AST, kind: str) -> None:
+            target = self._resolve_callable(mod, fi, target_expr, partials)
+            if target is not None:
+                self.spawns.append(
+                    SpawnSite(
+                        caller=fi.qualname,
+                        target=target,
+                        kind=kind,
+                        lineno=call.lineno,
+                        col=call.col_offset,
+                    )
+                )
+
+        if resolved in ("threading.Thread", "Thread"):
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    spawn(kw.value, THREAD)
+            return
+        if resolved in ("threading.Timer", "Timer"):
+            if len(call.args) >= 2:
+                spawn(call.args[1], TIMER)
+            return
+        if resolved == "asyncio.to_thread":
+            if call.args:
+                spawn(call.args[0], TO_THREAD)
+            return
+        if resolved == "signal.signal":
+            if len(call.args) >= 2:
+                spawn(call.args[1], SIGNAL)
+            return
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            receiver = dotted_name(call.func.value) or ""
+            rhead = receiver.partition(".")[0]
+            rtype = eff.local_types.get(rhead)
+            looks_executor = (
+                rtype == "executor"
+                or "executor" in receiver.lower()
+                or "pool" in receiver.lower()
+            )
+            if attr == "submit" and looks_executor and call.args:
+                spawn(call.args[0], EXECUTOR)
+            elif attr == "run_in_executor" and len(call.args) >= 2:
+                spawn(call.args[1], EXECUTOR)
+
+    # -- reachability / held-at-entry ----------------------------------------
+
+    def _reach(self, roots: Iterable[str]) -> set[str]:
+        """Closure of ``roots`` under call edges AND further spawns (a thread
+        that spawns another thread taints that target too)."""
+        spawn_map: dict[str, set[str]] = {}
+        for s in self.spawns:
+            if s.kind != SIGNAL:
+                spawn_map.setdefault(s.caller, set()).add(s.target)
+        out: set[str] = set(roots)
+        queue: deque[str] = deque(out)
+        while queue:
+            fn = queue.popleft()
+            for nxt in self.edges.get(fn, ()) | spawn_map.get(fn, set()):
+                if nxt not in out:
+                    out.add(nxt)
+                    queue.append(nxt)
+        return out
+
+    def _held_fixpoint(self) -> dict[str, frozenset[str]]:
+        """held_at_entry(f) = ∩ over resolved call sites of
+        (held_at_entry(caller) ∪ locks held at the site). Entry points
+        (spawn/signal targets, async defs, uncalled functions) start — and
+        stay — at ∅; the meet converges monotonically from TOP."""
+        in_sites: dict[str, list[CallContext]] = {}
+        for cc in self.call_contexts:
+            in_sites.setdefault(cc.callee, []).append(cc)
+        roots = set(self.thread_entries) | set(self.signal_entries)
+        for q, eff in self.effects.items():
+            if eff.is_async or q not in in_sites:
+                roots.add(q)
+        TOP = None
+        held: dict[str, frozenset[str] | None] = {
+            q: (frozenset() if q in roots else TOP) for q in self.effects
+        }
+        for _ in range(len(self.effects) + 1):
+            changed = False
+            for q in self.effects:
+                if q in roots:
+                    continue
+                vals = [
+                    held[cc.caller] | frozenset(cc.held)
+                    for cc in in_sites.get(q, ())
+                    if held.get(cc.caller) is not TOP
+                ]
+                new = frozenset.intersection(*vals) if vals else TOP
+                if new != held[q]:
+                    held[q] = new
+                    changed = True
+            if not changed:
+                break
+        return {q: (v if v is not TOP else frozenset()) for q, v in held.items()}
+
+    # -- lock-order graph ----------------------------------------------------
+
+    def acquires_closure(self, qualname: str) -> set[str]:
+        """Locks acquired by ``qualname`` or anything reachable from it
+        through call edges (memoized, cycle-safe)."""
+        cache = self._closure_cache
+        if qualname in cache:
+            return cache[qualname]
+        out: set[str] = set()
+        cache[qualname] = out  # pre-seed: cycles contribute nothing extra
+        seen = {qualname}
+        queue: deque[tuple[str, int]] = deque([(qualname, 0)])
+        while queue:
+            fn, depth = queue.popleft()
+            eff = self.effects.get(fn)
+            if eff is not None:
+                out.update(a.lock for a in eff.acquisitions)
+            if depth >= _MAX_DEPTH:
+                continue
+            for nxt in self.edges.get(fn, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    queue.append((nxt, depth + 1))
+        return out
+
+    def _build_lock_graph(self) -> LockOrderGraph:
+        self._closure_cache: dict[str, set[str]] = {}
+        graph = LockOrderGraph()
+        for lock, ld in self.lock_table.items():
+            graph.nodes[lock] = ld.kind
+
+        def kind_of(lock: str) -> str:
+            return self.lock_table[lock].kind if lock in self.lock_table else fx.LOCK
+
+        def site_str(qualname: str, lineno: int) -> str:
+            fi = self.index.function(qualname)
+            path = str(fi.path) if fi is not None else qualname
+            return f"{path}:{lineno}"
+
+        # intra-function nesting: every held lock orders before the new one
+        for q, eff in self.effects.items():
+            for acq in eff.acquisitions:
+                graph.nodes.setdefault(acq.lock, acq.kind)
+                for h in acq.held_before:
+                    graph.nodes.setdefault(h, kind_of(h))
+                    graph.add_edge(h, acq.lock, site_str(q, acq.lineno))
+        # interprocedural: calling into code that acquires B while holding A
+        for cc in self.call_contexts:
+            if not cc.held:
+                continue
+            for lock in self.acquires_closure(cc.callee):
+                graph.nodes.setdefault(lock, kind_of(lock))
+                for h in cc.held:
+                    if h == lock and kind_of(lock) == fx.RLOCK:
+                        continue
+                    graph.nodes.setdefault(h, kind_of(h))
+                    graph.add_edge(h, lock, site_str(cc.caller, cc.lineno))
+        return graph
+
+    # -- traversal helpers for the rules -------------------------------------
+
+    def reachable_calls(self, root: str, max_depth: int = _MAX_DEPTH) -> set[str]:
+        """Functions reachable from ``root`` through call edges only —
+        spawn boundaries (to_thread / executor / Thread) end the walk."""
+        out: set[str] = set()
+        queue: deque[tuple[str, int]] = deque([(root, 0)])
+        while queue:
+            fn, depth = queue.popleft()
+            if depth >= max_depth:
+                continue
+            for nxt in self.edges.get(fn, ()):
+                if nxt not in out and nxt != root:
+                    out.add(nxt)
+                    queue.append((nxt, depth + 1))
+        return out
+
+
+def model_for(pctx: "ProjectContext") -> ConcurrencyModel:
+    """The (cached) concurrency model for one project context — FLX013–016
+    all share a single build per lint root."""
+    model = getattr(pctx, "_concurrency_model", None)
+    if model is None:
+        model = ConcurrencyModel(pctx.index)
+        pctx._concurrency_model = model
+    return model
+
+
+def lock_graph_for_paths(paths: Iterable[str]) -> LockOrderGraph:
+    """Standalone lock-order graph over a file set (the ``--lock-graph``
+    artifact path, shared with the runtime stress harness)."""
+    from .core import iter_python_files
+    from .index import ProjectIndex
+
+    groups: dict = {}
+    for f, root in iter_python_files(list(paths)):
+        groups.setdefault(root, []).append(f)
+    merged = LockOrderGraph()
+    for root, files in sorted(groups.items()):
+        index = ProjectIndex.build(files, root)
+        graph = ConcurrencyModel(index).lock_graph
+        merged.nodes.update(graph.nodes)
+        for (s, d), site in graph.edges.items():
+            merged.edges.setdefault((s, d), site)
+    return merged

@@ -1,0 +1,621 @@
+"""Per-function effect summaries for the concurrency rules (FLX013–FLX016).
+
+Where the project index answers "what does this name resolve to" and the
+call graph answers "who calls whom", this module answers "what does this
+function *do* to shared state": which module-level mutable objects it
+writes (reusing FLX008's container detection), which locks it acquires
+(``with``-statements — including multi-item and ``async with`` — plus
+``acquire``/``release`` call pairs, resolved through import aliases,
+``self`` attributes, local aliases, and lock-named parameters), and where
+it can block the calling thread (``time.sleep``, file/socket IO,
+subprocess, blocking queue get/put, ``jax.device_get`` /
+``block_until_ready``, thread joins, future results, event waits, lock
+acquisition).
+
+Everything here is pure AST — nothing is imported or executed — and
+intraprocedural: each :class:`FunctionEffects` describes one function body,
+with the lock set *held locally* recorded per write site, per acquisition,
+and per outgoing call. The interprocedural composition (held-at-entry
+propagation, thread reachability, the lock-order graph) lives in
+:mod:`tools.floxlint.concurrency`.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Iterable
+
+from .rules.common import ImportMap, dotted_name
+from .rules.flx008_cache_registry import _MUTATING_METHODS, _is_mutable_container
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from .index import FunctionInfo, ModuleInfo, ProjectIndex
+
+# -- lock kinds --------------------------------------------------------------
+
+LOCK = "lock"  #: non-reentrant threading.Lock (signal/self-deadlock hazard)
+RLOCK = "rlock"  #: reentrant
+ASYNC_LOCK = "async-lock"  #: asyncio.Lock — guards tasks, not threads
+
+_LOCK_CONSTRUCTORS = {
+    "threading.Lock": LOCK,
+    "threading.RLock": RLOCK,
+    "multiprocessing.Lock": LOCK,
+    "multiprocessing.RLock": RLOCK,
+    "asyncio.Lock": ASYNC_LOCK,
+}
+
+# -- blocking-call taxonomy --------------------------------------------------
+
+SLEEP = "sleep"
+FILE_IO = "file-io"
+SOCKET = "socket"
+SUBPROCESS = "subprocess"
+QUEUE_OP = "queue"
+DEVICE_SYNC = "device-sync"
+THREAD_JOIN = "thread-join"
+FUTURE_RESULT = "future-result"
+EVENT_WAIT = "event-wait"
+LOCK_ACQUIRE = "lock-acquire"
+
+#: canonical dotted names that block outright
+_BLOCKING_CALLS = {
+    "time.sleep": SLEEP,
+    "socket.create_connection": SOCKET,
+    "jax.device_get": DEVICE_SYNC,
+    "jax.block_until_ready": DEVICE_SYNC,
+    "concurrent.futures.wait": FUTURE_RESULT,
+    "os.replace": FILE_IO,
+    "os.fsync": FILE_IO,
+    "shutil.rmtree": FILE_IO,
+    "shutil.copy": FILE_IO,
+    "shutil.copytree": FILE_IO,
+}
+#: dotted prefixes that block as a family
+_BLOCKING_PREFIXES = ("subprocess.", "urllib.request.", "requests.", "http.client.")
+
+#: constructor dotted name -> receiver type for method-level blocking
+_TYPED_CONSTRUCTORS = {
+    "queue.Queue": "queue",
+    "queue.LifoQueue": "queue",
+    "queue.PriorityQueue": "queue",
+    "queue.SimpleQueue": "queue",
+    "asyncio.Queue": "asyncio-queue",  # await-based: NOT blocking
+    "threading.Thread": "thread",
+    "threading.Timer": "thread",
+    "threading.Event": "event",
+    "socket.socket": "socket",
+    "concurrent.futures.ThreadPoolExecutor": "executor",
+    "concurrent.futures.ProcessPoolExecutor": "executor",
+}
+#: (receiver type, method) -> blocking kind
+_TYPED_METHODS = {
+    ("queue", "get"): QUEUE_OP,
+    ("queue", "put"): QUEUE_OP,
+    ("queue", "join"): QUEUE_OP,
+    ("thread", "join"): THREAD_JOIN,
+    ("event", "wait"): EVENT_WAIT,
+    ("future", "result"): FUTURE_RESULT,
+    ("future", "exception"): FUTURE_RESULT,
+    ("socket", "connect"): SOCKET,
+    ("socket", "accept"): SOCKET,
+    ("socket", "recv"): SOCKET,
+    ("socket", "send"): SOCKET,
+    ("socket", "sendall"): SOCKET,
+}
+
+
+@dataclass(frozen=True)
+class Acquisition:
+    """One lock acquisition site (``with`` item or ``.acquire()`` call)."""
+
+    lock: str  #: canonical lock id ("mod._LOCK", "mod.Cls._lock", "param:…")
+    kind: str  #: LOCK / RLOCK / ASYNC_LOCK
+    held_before: tuple[str, ...]  #: locks already held at this point (in order)
+    lineno: int
+    col: int
+    blocking: bool  #: False for ``acquire(blocking=False)``
+
+
+@dataclass(frozen=True)
+class BlockingOp:
+    """One potentially-blocking call site."""
+
+    kind: str  #: one of the taxonomy constants above
+    detail: str  #: resolved callable / receiver description
+    lineno: int
+    col: int
+
+
+@dataclass(frozen=True)
+class WriteSite:
+    """One in-place mutation (or ``global`` rebind) of a shared object."""
+
+    obj: str  #: canonical id of the module-level object ("mod._STATE")
+    held: tuple[str, ...]  #: locks held locally at the write
+    lineno: int
+    col: int
+
+
+@dataclass
+class CallRecord:
+    """One outgoing call with the locally-held lock set (resolution to a
+    project function happens in :mod:`.concurrency`)."""
+
+    call: ast.Call
+    held: tuple[str, ...]
+
+
+@dataclass
+class FunctionEffects:
+    qualname: str
+    module: str
+    is_async: bool
+    writes: list[WriteSite] = field(default_factory=list)
+    reads: set[str] = field(default_factory=set)
+    acquisitions: list[Acquisition] = field(default_factory=list)
+    blocking: list[BlockingOp] = field(default_factory=list)
+    calls: list[CallRecord] = field(default_factory=list)
+    #: local name -> receiver type ("queue", "thread", …) for spawn detection
+    local_types: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class LockDef:
+    canonical: str  #: "mod._LOCK" or "mod.Cls._lock"
+    kind: str
+    module: str
+    lineno: int
+
+
+# -- project-wide universes --------------------------------------------------
+
+
+def shared_objects(index: "ProjectIndex") -> set[str]:
+    """Canonical ids of every module-level mutable container in the project
+    (any name — unlike FLX008 this is not restricted to cache-named
+    ALL_CAPS bindings: a lowercase module-level list is just as racy)."""
+    out: set[str] = set()
+    for mod in index.modules.values():
+        for node in mod.tree.body:
+            targets: list[ast.Name] = []
+            value: ast.AST | None = None
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets if isinstance(t, ast.Name)]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                targets = [node.target]
+                value = node.value
+            if value is None or not _is_mutable_container(value):
+                continue
+            for t in targets:
+                out.add(f"{mod.name}.{t.id}")
+    return out
+
+
+def lock_defs(index: "ProjectIndex") -> dict[str, LockDef]:
+    """Every lock definition in the project: module globals
+    (``_LOCK = threading.Lock()``), class-level attributes, and instance
+    attributes assigned in methods (``self._lock = threading.RLock()``)."""
+    out: dict[str, LockDef] = {}
+
+    def ctor_kind(mod: "ModuleInfo", value: ast.AST) -> str | None:
+        if not isinstance(value, ast.Call):
+            return None
+        resolved = mod.imports.resolve(value.func)
+        return _LOCK_CONSTRUCTORS.get(resolved) if resolved else None
+
+    for mod in index.modules.values():
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = ctor_kind(mod, node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            cid = f"{mod.name}.{t.id}"
+                            out[cid] = LockDef(cid, kind, mod.name, node.lineno)
+            elif isinstance(node, ast.ClassDef):
+                prefix = f"{mod.name}.{node.name}"
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        kind = ctor_kind(mod, sub.value)
+                        if not kind:
+                            continue
+                        for t in sub.targets:
+                            name = None
+                            if isinstance(t, ast.Name):
+                                name = t.id  # class-level attribute
+                            elif (
+                                isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"
+                            ):
+                                name = t.attr  # self._lock = … in a method
+                            if name:
+                                cid = f"{prefix}.{name}"
+                                out[cid] = LockDef(cid, kind, mod.name, sub.lineno)
+    return out
+
+
+def module_types(index: "ProjectIndex") -> dict[str, str]:
+    """Canonical id -> receiver type for module-level typed objects
+    (``_Q = queue.Queue()`` makes ``mod._Q`` a blocking queue)."""
+    out: dict[str, str] = {}
+    for mod in index.modules.values():
+        for node in mod.tree.body:
+            value = getattr(node, "value", None)
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)) or not isinstance(
+                value, ast.Call
+            ):
+                continue
+            resolved = mod.imports.resolve(value.func)
+            rtype = _TYPED_CONSTRUCTORS.get(resolved) if resolved else None
+            if rtype is None:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out[f"{mod.name}.{t.id}"] = rtype
+    return out
+
+
+# -- the intraprocedural walker ---------------------------------------------
+
+
+class _EffectWalker:
+    """One pass over one function body, tracking the ordered held-lock set
+    through ``with`` nesting (and ``acquire``/``release`` pairs, which hold
+    from the statement after the acquire to the matching release or the end
+    of the enclosing block — a deliberate over-approximation)."""
+
+    def __init__(
+        self,
+        mod: "ModuleInfo",
+        fi: "FunctionInfo",
+        index: "ProjectIndex",
+        shared: set[str],
+        locks: dict[str, LockDef],
+        mtypes: dict[str, str],
+    ) -> None:
+        self.mod = mod
+        self.fi = fi
+        self.index = index
+        self.shared = shared
+        self.locks = locks
+        self.mtypes = mtypes
+        self.imports = mod.imports
+        self.out = FunctionEffects(
+            qualname=fi.qualname,
+            module=mod.name,
+            is_async=isinstance(fi.node, ast.AsyncFunctionDef),
+        )
+        args = fi.node.args
+        self.params = {
+            a.arg
+            for a in args.posonlyargs + args.args + args.kwonlyargs
+        }
+        self.globals_declared: set[str] = set()
+        self.local_lock_aliases: dict[str, str] = {}
+        self._prepass()
+
+    # -- pre-pass: local types, lock aliases, global declarations ------------
+
+    def _prepass(self) -> None:
+        for node in _own_nodes(self.fi.node):
+            if isinstance(node, ast.Global):
+                self.globals_declared.update(node.names)
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                resolved = self.imports.resolve(node.value.func)
+                rtype = _TYPED_CONSTRUCTORS.get(resolved) if resolved else None
+                if rtype is None and isinstance(node.value.func, ast.Attribute):
+                    if node.value.func.attr == "submit":
+                        rtype = "future"  # fut = executor.submit(…)
+                if rtype:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.out.local_types[t.id] = rtype
+            elif isinstance(node, ast.Assign):
+                lock = self._resolve_lock(node.value)
+                if lock:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.local_lock_aliases[t.id] = lock
+
+    # -- lock / object resolution --------------------------------------------
+
+    def _resolve_lock(self, expr: ast.AST) -> str | None:
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        if head == "self" and rest and "." not in rest:
+            # climb the qualname: mod.Cls.fn -> try mod.Cls.<attr>
+            prefix = self.fi.qualname.rsplit(".", 1)[0]
+            while prefix and prefix != self.mod.name:
+                cand = f"{prefix}.{rest}"
+                if cand in self.locks:
+                    return cand
+                if "lock" in rest.lower():
+                    return cand  # lock-named self attribute, ctor unseen
+                prefix = prefix.rsplit(".", 1)[0] if "." in prefix else ""
+            return None
+        if not rest and head in self.local_lock_aliases:
+            return self.local_lock_aliases[head]
+        if not rest and head in self.params:
+            # a parameter only counts as a lock when its name says so
+            if "lock" in head.lower() or "mutex" in head.lower():
+                return f"param:{self.fi.qualname}:{head}"
+            return None
+        resolved = self.index.resolve_symbol(self.mod.name, name)
+        if resolved is not None and resolved in self.locks:
+            return resolved
+        return None
+
+    def _lock_kind(self, lock: str) -> str:
+        ld = self.locks.get(lock)
+        return ld.kind if ld is not None else LOCK  # unknown: assume plain
+
+    def _resolve_shared(self, expr: ast.AST) -> str | None:
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        resolved = self.index.resolve_symbol(self.mod.name, name)
+        if resolved is not None and resolved in self.shared:
+            return resolved
+        return None
+
+    def _receiver_type(self, expr: ast.AST) -> str | None:
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        if not rest and head in self.out.local_types:
+            return self.out.local_types[head]
+        resolved = self.index.resolve_symbol(self.mod.name, name)
+        if resolved is not None and resolved in self.mtypes:
+            return self.mtypes[resolved]
+        return None
+
+    # -- traversal ------------------------------------------------------------
+
+    def run(self) -> FunctionEffects:
+        self._visit_block(self.fi.node.body, ())
+        return self.out
+
+    def _visit_block(self, stmts: Iterable[ast.stmt], held: tuple[str, ...]) -> None:
+        held = tuple(held)
+        for s in stmts:
+            self._visit_stmt(s, held)
+            held = self._apply_sticky(s, held)
+
+    def _visit_stmt(self, s: ast.stmt, held: tuple[str, ...]) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested defs attribute to their own graph node
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            h = held
+            for item in s.items:
+                self._scan_expr(item.context_expr, h)
+                lock = self._resolve_lock(item.context_expr)
+                if lock:
+                    self.out.acquisitions.append(
+                        Acquisition(
+                            lock=lock,
+                            kind=self._lock_kind(lock),
+                            held_before=h,
+                            lineno=item.context_expr.lineno,
+                            col=item.context_expr.col_offset,
+                            blocking=True,
+                        )
+                    )
+                    h = h + (lock,)
+            self._visit_block(s.body, h)
+            return
+        self._record_writes(s, held)
+        for expr in _own_exprs(s):
+            self._scan_expr(expr, held)
+        for block in _child_blocks(s):
+            self._visit_block(block, held)
+
+    def _apply_sticky(self, s: ast.stmt, held: tuple[str, ...]) -> tuple[str, ...]:
+        """``L.acquire()`` holds L for the rest of the block; ``L.release()``
+        drops it."""
+        for node in ast.walk(s):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("acquire", "release")
+            ):
+                continue
+            lock = self._resolve_lock(node.func.value)
+            if lock is None:
+                continue
+            if node.func.attr == "acquire" and lock not in held:
+                held = held + (lock,)
+            elif node.func.attr == "release":
+                held = tuple(x for x in held if x != lock)
+        return held
+
+    # -- per-expression effects ----------------------------------------------
+
+    def _scan_expr(self, expr: ast.AST, held: tuple[str, ...]) -> None:
+        for node in _walk_expr(expr):
+            if isinstance(node, ast.Call):
+                self._classify_call(node, held)
+            elif isinstance(node, (ast.Name, ast.Attribute)):
+                obj = self._resolve_shared(node)
+                if obj is not None:
+                    self.out.reads.add(obj)
+
+    def _classify_call(self, call: ast.Call, held: tuple[str, ...]) -> None:
+        self.out.calls.append(CallRecord(call=call, held=held))
+        resolved = self.imports.resolve(call.func)
+        if resolved is not None:
+            kind = _BLOCKING_CALLS.get(resolved)
+            if kind is None and any(
+                resolved.startswith(p) for p in _BLOCKING_PREFIXES
+            ):
+                kind = SUBPROCESS if resolved.startswith("subprocess.") else SOCKET
+            if kind is not None:
+                self.out.blocking.append(
+                    BlockingOp(kind, resolved, call.lineno, call.col_offset)
+                )
+                return
+        if isinstance(call.func, ast.Name) and call.func.id == "open":
+            self.out.blocking.append(
+                BlockingOp(FILE_IO, "open", call.lineno, call.col_offset)
+            )
+            return
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in ("acquire", "release"):
+                lock = self._resolve_lock(call.func.value)
+                if lock is not None and attr == "acquire":
+                    blocking = not _kw_is_false(call, "blocking")
+                    self.out.acquisitions.append(
+                        Acquisition(
+                            lock=lock,
+                            kind=self._lock_kind(lock),
+                            held_before=held,
+                            lineno=call.lineno,
+                            col=call.col_offset,
+                            blocking=blocking,
+                        )
+                    )
+                    if blocking:
+                        self.out.blocking.append(
+                            BlockingOp(LOCK_ACQUIRE, lock, call.lineno, call.col_offset)
+                        )
+                return
+            if attr == "block_until_ready":
+                self.out.blocking.append(
+                    BlockingOp(DEVICE_SYNC, attr, call.lineno, call.col_offset)
+                )
+                return
+            rtype = self._receiver_type(call.func.value)
+            kind = _TYPED_METHODS.get((rtype, attr)) if rtype else None
+            if kind == QUEUE_OP and _kw_is_false(call, "block"):
+                kind = None  # q.get(block=False) raises instead of blocking
+            if kind is not None:
+                self.out.blocking.append(
+                    BlockingOp(
+                        kind,
+                        f"{dotted_name(call.func) or attr}",
+                        call.lineno,
+                        call.col_offset,
+                    )
+                )
+
+    def _record_writes(self, s: ast.stmt, held: tuple[str, ...]) -> None:
+        def site(obj: str, node: ast.AST) -> None:
+            self.out.writes.append(
+                WriteSite(obj=obj, held=held, lineno=node.lineno, col=node.col_offset)
+            )
+
+        targets: list[ast.AST] = []
+        if isinstance(s, ast.Assign):
+            targets = list(s.targets)
+        elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+            targets = [s.target]
+        elif isinstance(s, ast.Delete):
+            targets = list(s.targets)
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                obj = self._resolve_shared(t.value)
+                if obj is not None:
+                    site(obj, s)
+            elif isinstance(t, ast.Name) and t.id in self.globals_declared:
+                obj = self._resolve_shared(t)
+                if obj is not None:
+                    site(obj, s)  # global rebind of a shared container
+        # mutating method calls on a shared object anywhere in the statement
+        for node in _own_exprs(s):
+            for sub in _walk_expr(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _MUTATING_METHODS
+                ):
+                    obj = self._resolve_shared(sub.func.value)
+                    if obj is not None:
+                        site(obj, sub)
+
+
+# -- AST helpers -------------------------------------------------------------
+
+_STMT_BLOCK_FIELDS = ("body", "orelse", "finalbody")
+
+
+def _child_blocks(s: ast.stmt) -> Iterable[list[ast.stmt]]:
+    for name in _STMT_BLOCK_FIELDS:
+        block = getattr(s, name, None)
+        if isinstance(block, list) and block and isinstance(block[0], ast.stmt):
+            yield block
+    for handler in getattr(s, "handlers", ()) or ():
+        yield handler.body
+    for case in getattr(s, "cases", ()) or ():
+        yield case.body
+
+
+def _own_exprs(s: ast.stmt) -> Iterable[ast.expr]:
+    """Expression children of one statement, excluding nested statement
+    blocks (those are visited with their own held-set context)."""
+    for name, value in ast.iter_fields(s):
+        if name in _STMT_BLOCK_FIELDS or name in ("handlers", "cases"):
+            continue
+        if isinstance(value, ast.expr):
+            yield value
+        elif isinstance(value, list):
+            for v in value:
+                if isinstance(v, ast.expr):
+                    yield v
+
+
+def _walk_expr(expr: ast.AST) -> Iterable[ast.AST]:
+    """Walk an expression tree, pruning lambda bodies (their calls run at
+    call time, not here)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Lambda):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _own_nodes(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> Iterable[ast.AST]:
+    """All nodes in ``fn``'s own body, excluding nested function/class defs."""
+    stack: list[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _kw_is_false(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return kw.value.value is False
+    return False
+
+
+# -- public entry ------------------------------------------------------------
+
+
+def compute_effects(index: "ProjectIndex") -> dict[str, FunctionEffects]:
+    """Effect summaries for every function in the project, keyed by
+    canonical qualname."""
+    shared = shared_objects(index)
+    locks = lock_defs(index)
+    mtypes = module_types(index)
+    out: dict[str, FunctionEffects] = {}
+    for mod in index.modules.values():
+        for fi in mod.functions.values():
+            out[fi.qualname] = _EffectWalker(
+                mod, fi, index, shared, locks, mtypes
+            ).run()
+    return out

@@ -1,0 +1,33 @@
+"""FLX013 fixture: an executor-submitted writer missing the lock, and a
+protected helper whose callers all hold it (held-at-entry: clean)."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+_JOBS: dict = {}
+_JOBS_LOCK = threading.Lock()
+
+
+def _record(key: str, value: float) -> None:
+    _JOBS[key] = value  # expect: FLX013
+
+
+def record_locked(key: str, value: float) -> None:
+    with _JOBS_LOCK:
+        _JOBS[key] = value
+
+
+def _store_entry(key: str, value: float) -> None:
+    # every caller holds _JOBS_LOCK, so this write is protected (the
+    # held-at-entry meet proves it — no finding here)
+    _JOBS[key] = value
+
+
+def record_via_helper(key: str, value: float) -> None:
+    with _JOBS_LOCK:
+        _store_entry(key, value)
+
+
+def submit_all(executor: ThreadPoolExecutor, items) -> None:
+    for key, value in items:
+        executor.submit(_record, key, value)

@@ -1,0 +1,32 @@
+"""FLX013 fixture: a shared dict written from a worker thread without the
+lock its other writers hold (plus the clean shapes around it)."""
+
+import threading
+
+_STATE = {"ready": False}
+_TABLE: dict = {}  # single-writer: never flagged
+_STATE_LOCK = threading.Lock()
+
+
+def set_ready(flag: bool) -> None:
+    _STATE["ready"] = flag  # expect: FLX013
+
+
+def set_reason(reason: str) -> None:
+    with _STATE_LOCK:
+        _STATE["reason"] = reason
+
+
+def note(key: str, value: str) -> None:
+    _TABLE[key] = value
+
+
+def _worker() -> None:
+    set_ready(True)
+
+
+def start() -> None:
+    t = threading.Thread(target=_worker, daemon=True)
+    t.start()
+    with _STATE_LOCK:
+        _STATE["started"] = True

@@ -1,0 +1,45 @@
+"""FLX014 fixture: an A->B / B->A inversion across the call graph, a
+plain-Lock self-deadlock, and the clean RLock re-entry shape."""
+
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+_R = threading.RLock()
+_SELF = threading.Lock()
+
+
+def ab() -> None:
+    with _A:
+        with _B:  # expect: FLX014
+            pass
+
+
+def ba() -> None:
+    with _B:
+        _use_a()
+
+
+def _use_a() -> None:
+    with _A:
+        pass
+
+
+def self_deadlock() -> None:
+    with _SELF:
+        _inner()  # expect: FLX014
+
+
+def _inner() -> None:
+    with _SELF:
+        pass
+
+
+def reenter() -> None:
+    with _R:
+        _again()
+
+
+def _again() -> None:
+    with _R:  # clean: re-entering an RLock is its contract
+        pass

@@ -1,0 +1,6 @@
+"""FLX015 fixture helper: file IO that must only run off-loop."""
+
+
+def dump(payload: str) -> None:
+    with open("/tmp/flx015-fixture", "w") as fh:  # expect: FLX015
+        fh.write(payload)

@@ -1,0 +1,42 @@
+"""FLX015 fixture: blocking calls on the event loop — direct, one call
+down, and the clean ``to_thread`` / bounded-lock shapes."""
+
+import asyncio
+import queue
+import threading
+import time
+
+from . import io_helpers
+
+_Q: queue.Queue = queue.Queue()
+_AQ: asyncio.Queue = asyncio.Queue()
+_LOCK = threading.Lock()
+
+
+async def tick() -> None:
+    time.sleep(0.01)  # expect: FLX015
+    await asyncio.sleep(0)
+
+
+async def snapshot() -> None:
+    io_helpers.dump("x")  # the open() inside is the finding site
+
+
+async def pull() -> object:
+    return _Q.get()  # expect: FLX015
+
+
+async def offloaded() -> None:
+    # clean: the to_thread boundary hands dump's IO to a worker thread
+    await asyncio.to_thread(io_helpers.dump, "x")
+
+
+async def guarded() -> int:
+    # clean: bounded lock acquisition around a dict poke is idiomatic
+    with _LOCK:
+        return 1
+
+
+async def drained() -> object:
+    # clean: asyncio.Queue.get is awaited, not blocking
+    return await _AQ.get()

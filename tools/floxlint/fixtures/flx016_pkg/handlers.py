@@ -1,0 +1,44 @@
+"""FLX016 fixture: a signal handler reaching a non-reentrant lock, next to
+the sanctioned RLock and spawn-a-thread shapes."""
+
+import signal
+import threading
+
+_LOCK = threading.Lock()
+_RLOCK = threading.RLock()
+_FLUSHED: dict = {}
+_DRAINED: dict = {}
+
+
+def _on_term(signum, frame) -> None:
+    flush()
+
+
+def flush() -> None:
+    with _LOCK:  # expect: FLX016
+        _FLUSHED["at"] = True
+
+
+def _on_usr1(signum, frame) -> None:
+    drain()
+
+
+def drain() -> None:
+    with _RLOCK:  # clean: reentrant locks are the sanctioned handler shape
+        _DRAINED["at"] = True
+
+
+def _on_usr2(signum, frame) -> None:
+    # clean: handing off to a daemon thread is signal-safe by construction
+    threading.Thread(target=_background, daemon=True).start()
+
+
+def _background() -> None:
+    with _LOCK:
+        _FLUSHED["bg"] = True
+
+
+def install() -> None:
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(getattr(signal, "SIGUSR1", signal.SIGTERM), _on_usr1)
+    signal.signal(getattr(signal, "SIGUSR2", signal.SIGTERM), _on_usr2)

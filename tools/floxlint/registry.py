@@ -14,6 +14,10 @@ from .rules.flx009_donation import DonationAfterUseRule
 from .rules.flx010_options_drift import OptionsEnvDriftRule
 from .rules.flx011_helper_sync import HelperHostSyncRule
 from .rules.flx012_serve_except import ServeBroadExceptRule
+from .rules.flx013_unlocked_shared_write import UnlockedSharedWriteRule
+from .rules.flx014_lock_order import LockOrderInversionRule
+from .rules.flx015_async_blocking import AsyncBlockingRule
+from .rules.flx016_signal_unsafe import SignalUnsafeRule
 
 #: id -> rule instance, in id order
 RULES = {
@@ -31,8 +35,42 @@ RULES = {
         OptionsEnvDriftRule(),
         HelperHostSyncRule(),
         ServeBroadExceptRule(),
+        UnlockedSharedWriteRule(),
+        LockOrderInversionRule(),
+        AsyncBlockingRule(),
+        SignalUnsafeRule(),
     )
 }
+
+
+def explain_rule(rule_id: str) -> str:
+    """The ``--explain`` payload for one rule, assembled from the registry
+    itself (id, name, one-line description, the rule module's docstring,
+    and — where the rule carries them — an example finding and fix
+    pattern), so the explanation can never drift from the implementation."""
+    rule = RULES.get(rule_id.upper())
+    if rule is None:
+        raise KeyError(
+            f"unknown rule id: {rule_id} (known: {rule_id_range()})"
+        )
+    import inspect
+    import sys
+
+    lines = [f"{rule.id} — {rule.name}", "", rule.description, ""]
+    doc = inspect.getdoc(sys.modules[type(rule).__module__])
+    if doc:
+        lines += [doc.strip(), ""]
+    example = getattr(rule, "example", None)
+    if example:
+        lines += ["Example finding:", "", _indent(example), ""]
+    fix_hint = getattr(rule, "fix_hint", None)
+    if fix_hint:
+        lines += ["Fix pattern:", "", _indent(fix_hint), ""]
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def _indent(text: str) -> str:
+    return "\n".join(f"    {line}" for line in text.splitlines())
 
 
 def rule_id_range() -> str:

@@ -1,0 +1,122 @@
+"""FLX013 — unlocked shared-mutable-state write on a thread-reachable path.
+
+The serve/fleet plane is threaded: daemon samplers, scrape threads,
+``asyncio.to_thread`` workers, executor submits, signal handlers. Any
+module-level mutable object those paths write races with every other
+writer unless they agree on a lock. This rule makes the agreement
+checkable: for each module-level mutable container (FLX008's detection,
+without the cache-name restriction) it collects every write site with the
+lock set held there — locally (``with`` nesting, ``acquire``/``release``)
+*plus* the locks held on every resolved call path into the function (so a
+helper whose callers all hold the registry lock counts as protected). If
+the writers of an object have settled on one lock and a write site that is
+reachable from a thread entry point (``Thread(target=…)``, ``Timer``,
+``executor.submit``, ``asyncio.to_thread``, ``loop.run_in_executor``) or a
+signal handler skips it, that site is flagged.
+
+Precision choices: single-writer objects are exempt (no cross-thread
+disagreement to have), objects none of whose writers hold any lock are
+exempt (event-loop- or main-thread-confined state — the dispatcher
+registries — is a design, not an accident), a tie between two
+candidate locks skips the object rather than guessing, and the candidate
+lock must be held at a strict majority of write sites (a lock one caller
+happens to hold around a single write is that caller's context, not the
+object's discipline). The fix is either
+to take the lock or to confine the write to one thread and say so with a
+rationale'd ``# noqa: FLX013``.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import TYPE_CHECKING, Iterator
+
+from ..concurrency import model_for
+from ..core import Finding
+from .. import effects as fx
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..core import ProjectContext
+
+
+class UnlockedSharedWriteRule:
+    id = "FLX013"
+    name = "unlocked-shared-write"
+    description = (
+        "module-level mutable state written on a thread-reachable path "
+        "without the lock its other writers hold"
+    )
+    scope = "project"
+    example = (
+        "_STATE_LOCK = threading.Lock()\n"
+        "def set_ready(flag):\n"
+        "    _STATE['ready'] = flag          # written lock-free…\n"
+        "def stop():\n"
+        "    with _STATE_LOCK:\n"
+        "        _STATE['ready'] = False     # …while other writers lock\n"
+        "threading.Thread(target=set_ready, args=(True,)).start()"
+    )
+    fix_hint = (
+        "take the same lock the other writers hold (with _STATE_LOCK: …), or "
+        "confine all writes to one thread and mark the deliberate exception "
+        "with a rationale'd `# noqa: FLX013`"
+    )
+
+    def check_project(self, pctx: "ProjectContext") -> Iterator[Finding]:
+        model = model_for(pctx)
+        concurrent = model.thread_reachable | model.signal_reachable
+        # obj -> [(qualname, WriteSite, effective held set)]
+        by_obj: dict[str, list[tuple[str, fx.WriteSite, frozenset[str]]]] = {}
+        for q, eff in model.effects.items():
+            entry_held = model.held_at_entry.get(q, frozenset())
+            for w in eff.writes:
+                effective = frozenset(w.held) | entry_held
+                by_obj.setdefault(w.obj, []).append((q, w, effective))
+        for obj in sorted(by_obj):
+            sites = by_obj[obj]
+            writer_fns = {q for q, _, _ in sites}
+            if len(writer_fns) < 2:
+                continue  # single-writer objects cannot disagree
+            counts: Counter[str] = Counter(
+                lock for _, _, held in sites for lock in held
+            )
+            if not counts:
+                continue  # nobody locks: confined-by-design state
+            ranked = counts.most_common(2)
+            if len(ranked) > 1 and ranked[0][1] == ranked[1][1]:
+                continue  # ambiguous discipline — no lock to demand
+            protect = ranked[0][0]
+            if ranked[0][1] * 2 <= len(sites):
+                # the candidate lock is held at a minority of write sites:
+                # that is one caller's incidental context (a recovery guard
+                # held around a cache clear), not the object's discipline
+                continue
+            holders = sorted(
+                {q for q, _, held in sites if protect in held}
+            )
+            for q, w, held in sites:
+                if protect in held or q not in concurrent:
+                    continue
+                if holders == [q]:
+                    continue  # the only holder is this same function
+                fi = pctx.index.function(q)
+                if fi is None:
+                    continue
+                via = model.spawn_kind.get(q)
+                how = (
+                    f"reachable from a {via} entry point"
+                    if via
+                    else "reachable from a thread entry point"
+                )
+                yield Finding(
+                    path=str(fi.path),
+                    line=w.lineno,
+                    col=w.col,
+                    rule=self.id,
+                    message=(
+                        f"`{obj}` is written here without `{protect}`, which "
+                        f"its other writer(s) ({', '.join(holders)}) hold; "
+                        f"`{q}` is {how} — take the lock, or confine writes "
+                        "to one thread and suppress with a rationale"
+                    ),
+                )

@@ -1,0 +1,99 @@
+"""FLX014 — lock-order inversion across the call graph.
+
+Two locks acquired in opposite orders on two paths deadlock the first time
+the schedules interleave — and the RLock web across telemetry, exposition,
+serve, and fleet had never been order-checked before this rule. The model
+builds a global acquisition-order graph: an edge ``A -> B`` wherever B is
+acquired while A is held, either by lexical nesting (``with A: with B:``,
+``with A, B:``) or interprocedurally (holding A while calling into any
+function whose call closure acquires B). A cycle in that graph is a
+potential deadlock; a self-edge on a *plain* ``threading.Lock`` is a
+guaranteed one (the PR 8 signal-handler bug class — re-entering a
+non-reentrant lock). RLock self-edges are their design contract and are
+not recorded.
+
+The same graph ships two other ways: ``python -m tools.floxlint
+--lock-graph out.json`` emits it as a JSON/dot review artifact (so the
+router and dataset-registry PRs can diff lock discipline in review), and
+``flox_tpu.faults.stress_schedule(lock_order=True)`` enforces it at
+runtime with acquisition-order assertions under a hostile scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterator
+
+from ..concurrency import model_for
+from ..core import Finding
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..core import ProjectContext
+
+
+class LockOrderInversionRule:
+    id = "FLX014"
+    name = "lock-order-inversion"
+    description = (
+        "cycle in the global lock-acquisition-order graph (potential "
+        "deadlock), or a non-reentrant lock re-acquired on its own path"
+    )
+    scope = "project"
+    example = (
+        "def ab():\n"
+        "    with _A:\n"
+        "        with _B: ...     # orders A -> B\n"
+        "def ba():\n"
+        "    with _B:\n"
+        "        helper()         # helper() acquires _A: orders B -> A"
+    )
+    fix_hint = (
+        "pick one global order for the locks in the cycle and acquire them "
+        "in that order on every path (release and re-acquire if a path "
+        "needs them the other way); for a self-cycle on a plain Lock, make "
+        "it an RLock or drop the inner acquisition"
+    )
+
+    def check_project(self, pctx: "ProjectContext") -> Iterator[Finding]:
+        model = model_for(pctx)
+        graph = model.lock_graph
+        for cycle in graph.cycles():
+            edge_descr: list[str] = []
+            first_site: str | None = None
+            if len(cycle) == 1:
+                site = graph.edges.get((cycle[0], cycle[0]), "")
+                first_site = site
+                edge_descr.append(f"{cycle[0]} -> {cycle[0]} at {site}")
+                message = (
+                    f"non-reentrant lock `{cycle[0]}` can be re-acquired on "
+                    f"its own path ({site}) — a guaranteed self-deadlock; "
+                    "make it an RLock or drop the nested acquisition"
+                )
+            else:
+                ring = cycle + [cycle[0]]
+                for a, b in zip(ring, ring[1:]):
+                    site = graph.edges.get((a, b))
+                    if site is None:
+                        continue
+                    if first_site is None:
+                        first_site = site
+                    edge_descr.append(f"{a} -> {b} at {site}")
+                message = (
+                    "lock-order inversion: "
+                    + "; ".join(edge_descr)
+                    + " — these locks are taken in conflicting orders and "
+                    "can deadlock; pick one global order"
+                )
+            path, line = _split_site(first_site)
+            yield Finding(
+                path=path, line=line, col=0, rule=self.id, message=message
+            )
+
+
+def _split_site(site: str | None) -> tuple[str, int]:
+    if not site:
+        return "<unknown>", 1
+    path, _, line = site.rpartition(":")
+    try:
+        return path or site, int(line)
+    except ValueError:
+        return site, 1

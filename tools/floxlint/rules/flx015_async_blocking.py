@@ -1,0 +1,109 @@
+"""FLX015 — blocking call reachable from inside the asyncio event loop.
+
+The serve dispatcher is a single event loop: one coroutine that blocks —
+``time.sleep``, file or socket IO, subprocess, a blocking queue get/put, a
+``jax.device_get``, a thread join or ``future.result()`` — stalls *every*
+in-flight request behind it, which is exactly the wedge the watchdog
+exists to catch at runtime. Until now "coroutines only block via
+``to_thread``" was enforced by review; this rule enforces it statically.
+
+Roots are every ``async def`` in the project. From each root the model
+walks plain call edges only — an ``asyncio.to_thread`` / executor-submit
+boundary hands the work to a thread and ends event-loop reachability, so
+offloaded helpers are clean by construction. Each potentially-blocking
+site found on-loop is reported once, at the blocking call itself (that is
+where the ``await asyncio.to_thread(…)`` fix or the rationale'd ``# noqa``
+belongs).
+
+Deliberately *not* flagged: bounded lock acquisition (``with _LOCK:``
+around a dict update is idiomatic and microsecond-bounded — flagging it
+would bury the real wedges) and ``asyncio.Queue`` operations (awaited, not
+blocking).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterator
+
+from .. import effects as fx
+from ..concurrency import model_for
+from ..core import Finding
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..core import ProjectContext
+
+#: blocking kinds that wedge the loop (LOCK_ACQUIRE deliberately excluded)
+_FLAGGED = frozenset(
+    {
+        fx.SLEEP,
+        fx.FILE_IO,
+        fx.SOCKET,
+        fx.SUBPROCESS,
+        fx.QUEUE_OP,
+        fx.DEVICE_SYNC,
+        fx.THREAD_JOIN,
+        fx.FUTURE_RESULT,
+        fx.EVENT_WAIT,
+    }
+)
+
+
+class AsyncBlockingRule:
+    id = "FLX015"
+    name = "async-blocking-call"
+    description = (
+        "blocking call (sleep, file/socket IO, subprocess, queue, device "
+        "sync, join/result) reachable from an asyncio coroutine without a "
+        "to_thread/executor boundary"
+    )
+    scope = "project"
+    example = (
+        "async def _handle_device_loss(self, …):\n"
+        "    telemetry.flight_dump(reason='device-lost')  # open()+fsync on "
+        "the event loop"
+    )
+    fix_hint = (
+        "offload the blocking call: `await asyncio.to_thread(fn, …)` (or "
+        "loop.run_in_executor); if the block is deliberate and bounded, "
+        "say why with `# noqa: FLX015`"
+    )
+
+    def check_project(self, pctx: "ProjectContext") -> Iterator[Finding]:
+        model = model_for(pctx)
+        roots = sorted(q for q, eff in model.effects.items() if eff.is_async)
+        seen: set[tuple[str, int, int, str]] = set()
+        for root in roots:
+            on_loop = [root, *sorted(model.reachable_calls(root))]
+            for fn in on_loop:
+                eff = model.effects.get(fn)
+                if eff is None:
+                    continue
+                if eff.is_async and fn != root:
+                    continue  # nested coroutine: awaited, reported as a root
+                fi = pctx.index.function(fn)
+                if fi is None:
+                    continue
+                for op in eff.blocking:
+                    if op.kind not in _FLAGGED:
+                        continue
+                    key = (str(fi.path), op.lineno, op.col, op.kind)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    where = (
+                        "directly in the coroutine"
+                        if fn == root
+                        else f"in `{fn}`, reached without a thread boundary"
+                    )
+                    yield Finding(
+                        path=str(fi.path),
+                        line=op.lineno,
+                        col=op.col,
+                        rule=self.id,
+                        message=(
+                            f"blocking {op.kind} call (`{op.detail}`) runs on "
+                            f"the event loop: {where} from async "
+                            f"`{root}` — offload with `await "
+                            "asyncio.to_thread(…)`"
+                        ),
+                    )

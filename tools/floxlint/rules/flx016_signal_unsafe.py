@@ -1,0 +1,107 @@
+"""FLX016 — non-reentrant operation reachable from a signal handler.
+
+A Python signal handler runs *between bytecodes of whatever frame happened
+to be executing* on the main thread. If that interrupted frame holds a
+plain ``threading.Lock`` and the handler (or anything it calls) tries to
+acquire the same lock, the process deadlocks — the exact bug class PR 8
+fixed by hand when the SIGUSR2 flight-dump handler re-entered the metrics
+registry, and the reason the registry/records/export locks are RLocks
+today. Queue operations, thread joins, and ``future.result()`` carry the
+same hazard through their internal locks.
+
+Roots are every handler registered via ``signal.signal``. The walk follows
+plain call edges only: a handler that just spawns a daemon thread
+(``profiling.install_capture_signal``'s pattern) is signal-safe by
+construction, because the unsafe work happens on the new thread. The
+documented dump/flush set — file IO and *reentrant* lock acquisition — is
+deliberately exempt: that is precisely what the flight recorder's RLock
+design exists to permit from a handler.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterator
+
+from .. import effects as fx
+from ..concurrency import model_for
+from ..core import Finding
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..core import ProjectContext
+
+#: blocking kinds whose internal locks make them handler-unsafe
+_UNSAFE_BLOCKING = frozenset(
+    {fx.QUEUE_OP, fx.THREAD_JOIN, fx.FUTURE_RESULT, fx.SUBPROCESS, fx.EVENT_WAIT}
+)
+
+
+class SignalUnsafeRule:
+    id = "FLX016"
+    name = "signal-unsafe-operation"
+    description = (
+        "signal handler reaches a non-reentrant operation (plain-Lock "
+        "acquire, queue op, join/result) that can deadlock against the "
+        "interrupted frame"
+    )
+    scope = "project"
+    example = (
+        "def _handler(signum, frame):\n"
+        "    flush()                 # flush() does `with _LOCK:` — if the\n"
+        "                            # interrupted frame holds _LOCK: deadlock"
+    )
+    fix_hint = (
+        "make the lock an RLock (re-entering is then safe), or hand the "
+        "work to a daemon thread from the handler "
+        "(threading.Thread(target=…, daemon=True).start()) so nothing "
+        "non-reentrant runs in the interrupted frame"
+    )
+
+    def check_project(self, pctx: "ProjectContext") -> Iterator[Finding]:
+        model = model_for(pctx)
+        seen: set[tuple[str, int, int]] = set()
+        for root in sorted(model.signal_entries):
+            for fn in [root, *sorted(model.reachable_calls(root))]:
+                eff = model.effects.get(fn)
+                fi = pctx.index.function(fn)
+                if eff is None or fi is None:
+                    continue
+                for acq in eff.acquisitions:
+                    if acq.kind != fx.LOCK or not acq.blocking:
+                        continue
+                    key = (str(fi.path), acq.lineno, acq.col)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield Finding(
+                        path=str(fi.path),
+                        line=acq.lineno,
+                        col=acq.col,
+                        rule=self.id,
+                        message=(
+                            f"non-reentrant lock `{acq.lock}` is acquired on "
+                            f"a path reachable from signal handler `{root}` "
+                            "— if the interrupted frame holds it the process "
+                            "deadlocks; use an RLock or hand off to a daemon "
+                            "thread"
+                        ),
+                    )
+                for op in eff.blocking:
+                    if op.kind not in _UNSAFE_BLOCKING:
+                        continue
+                    key = (str(fi.path), op.lineno, op.col)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield Finding(
+                        path=str(fi.path),
+                        line=op.lineno,
+                        col=op.col,
+                        rule=self.id,
+                        message=(
+                            f"{op.kind} operation (`{op.detail}`) is "
+                            f"reachable from signal handler `{root}` — its "
+                            "internal lock can deadlock against the "
+                            "interrupted frame; hand the work to a daemon "
+                            "thread"
+                        ),
+                    )
