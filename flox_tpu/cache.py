@@ -120,9 +120,11 @@ def stats() -> dict:
     from .serve.aot import _MANIFEST_MEMO
     from .serve.breaker import breaker_stats
     from .serve.dispatcher import _BATCH_REGISTRY, _COALESCE_CACHE, _PENDING_REGISTRY
+    from .serve.registry import registry_stats
     from .streaming import _STEP_CACHE
     from .telemetry import (
         FLIGHT_RECORDER,
+        cost_by_dataset,
         cost_by_program,
         cost_by_tenant,
         hbm_by_program,
@@ -136,6 +138,9 @@ def stats() -> dict:
         # feeds — read through the locked accessors, never the raw table
         "cost_by_program": cost_by_program(),
         "cost_by_tenant": cost_by_tenant(),
+        # per-resident-dataset axis of the same ledger: fed only by serve
+        # dispatches that referenced a registry entry ("dataset": name)
+        "cost_by_dataset": cost_by_dataset(),
         # per-program-key peak HBM: the hbm_peak column of the ledger, kept
         # as its own view (the operator's answer to "which compiled program
         # is eating the chip")
@@ -172,6 +177,9 @@ def stats() -> dict:
         "serve_coalesce": len(_COALESCE_CACHE),
         "serve_batches": len(_BATCH_REGISTRY),
         "serve_aot_manifest": len(_MANIFEST_MEMO),
+        # resident dataset registry: entry/byte/pin counts, the HBM budget
+        # in force, and deliberate budget evictions (the runbook alarm)
+        "registry": registry_stats(),
         # per-program circuit breakers: entry counts per state plus the
         # open/half-open detail (which program labels are being fast-failed
         # and how long their cooldowns have left)
@@ -244,6 +252,12 @@ def clear_all() -> None:
     _COALESCE_CACHE.clear()
     _BATCH_REGISTRY.clear()
     _MANIFEST_MEMO.clear()
+    # resident dataset registry: registry.clear() drops _DATASET_REGISTRY
+    # and resets the eviction counter + gauges; in-flight dispatches keep
+    # their direct references, so a clear only unpublishes names
+    from .serve import registry as serve_registry
+
+    serve_registry.clear()
     # circuit-breaker state resets with the program caches it shadows: a
     # cleared process has no failure history, so no breaker stays open
     _BREAKER_REGISTRY.clear()

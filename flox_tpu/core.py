@@ -551,6 +551,18 @@ def _groupby_reduce_impl(
             )
     nby = len(by)
 
+    if nby == 1 and isinstance(by[0], fct.Prefactorized):
+        # registry fast path: factorization (codes, group tables, present
+        # table) happened once at put_dataset time — route around the
+        # factorize span and the codes H2D entirely
+        return _prefactorized_reduce(
+            array, by[0], func=func, expected_groups=expected_groups,
+            axis=axis, isbin=isbin, fill_value=fill_value, dtype=dtype,
+            min_count=min_count, method=method, engine=engine,
+            reindex=reindex, finalize_kwargs=finalize_kwargs, mesh=mesh,
+            axis_name=axis_name,
+        )
+
     from .sparse import is_sparse_array
 
     if is_sparse_array(array):
@@ -866,6 +878,194 @@ def _groupby_reduce_impl(
 
     groups = tuple(_index_values(g) for g in found_groups)
     return (result,) + groups
+
+
+def _prefactorized_reduce(
+    array: Any,
+    pf: "fct.Prefactorized",
+    *,
+    func: str | Aggregation,
+    expected_groups: Any,
+    axis: Any,
+    isbin: Any,
+    fill_value: Any,
+    dtype: Any,
+    min_count: int | None,
+    method: str | None,
+    engine: str | None,
+    reindex: Any,
+    finalize_kwargs: dict | None,
+    mesh: Any,
+    axis_name: str,
+) -> tuple:
+    """The registry (serve) fast path: ``by`` arrived as a
+    :class:`factorize.Prefactorized`, so codes, the expected-groups table,
+    and the sort engine's present table were computed — and device-staged —
+    at ``put_dataset`` time. This path never opens a ``factorize`` span,
+    and with a device-resident ``array`` it dispatches with zero
+    ``bytes.h2d`` (both codes and data pass ``utils.asarray_device``
+    untouched).
+
+    Options that would require re-deriving the factorization are rejected,
+    not dropped — re-put the dataset to change the grouping.
+    """
+    bad = [
+        name
+        for name, val in (
+            ("expected_groups", expected_groups),
+            ("axis", axis),
+            ("reindex", reindex),
+        )
+        if val is not None
+    ]
+    if isbin not in (False, (False,)):
+        bad.append("isbin")
+    if bad:
+        raise NotImplementedError(
+            f"Prefactorized `by` does not support {bad}: the factorization "
+            "is fixed at put time (re-put the dataset with different groups)"
+        )
+
+    array_is_jax = utils.is_jax_array(array)
+    engine_explicit = engine is not None
+    engine = _choose_engine(engine, array, array_is_jax)
+    arr = array if array_is_jax else np.asarray(array)
+
+    func_name = func if isinstance(func, str) else func.name
+    arr_dtype = np.dtype(arr.dtype)
+    if arr_dtype.kind in "OSU" or dtypes.is_datetime_like(arr_dtype):
+        raise NotImplementedError(
+            f"Prefactorized `by` supports numeric data; got dtype {arr_dtype} "
+            "(datetime/object inputs keep the inline groupby_reduce path)"
+        )
+    bndim = len(pf.by_shape)
+    if arr.ndim < bndim or tuple(arr.shape[arr.ndim - bndim:]) != tuple(pf.by_shape):
+        raise ValueError(
+            f"`array` with shape {arr.shape} does not align with the "
+            f"prefactorized `by` shape {pf.by_shape}"
+        )
+    if arr_dtype.kind == "b" and func_name in ("sum", "nansum", "prod", "nanprod", "count"):
+        arr = arr.astype(np.int64 if utils.x64_enabled() else np.int32)
+
+    # -- min_count semantics: identical to the inline path ----------------
+    if min_count is None:
+        min_count_ = 0
+        if fill_value is not None and func_name in ("nansum", "nanprod"):
+            min_count_ = 1
+    else:
+        min_count_ = min_count
+    agg = _initialize_aggregation(
+        func, dtype, arr.dtype, fill_value, min_count_, finalize_kwargs
+    )
+
+    lead_shape = arr.shape[: arr.ndim - bndim]
+    arr_flat = arr.reshape(lead_shape + (pf.n,))
+
+    if method is None and mesh is not None:
+        from .cohorts import chunks_from_shards, find_group_cohorts
+        from .parallel.mapreduce import _norm_axes
+
+        n_shards = int(np.prod([mesh.shape[a] for a in _norm_axes(axis_name, mesh)]))
+        method, _ = find_group_cohorts(
+            pf.codes, chunks_from_shards(pf.n, n_shards),
+            expected_groups=range(pf.size),
+        )
+        logger.debug("prefactorized: auto-selected method=%s", method)
+
+    if method is not None:
+        # -- sharded SPMD reduction: put-staged device codes feed the mesh
+        # program directly (cohorts keeps host codes — ownership detection
+        # is host-side)
+        from .parallel.mapreduce import sharded_groupby_reduce
+
+        mesh_present = None
+        size_run = pf.size
+        if engine == "sort" and len(pf.present) < pf.size:
+            mesh_present = pf.present
+            size_run = pf.ncap
+            _note_highcard(pf.size, pf.ncap, len(pf.present))
+            codes_run = pf.ccodes if method == "cohorts" or pf.ccodes_dev is None else pf.ccodes_dev
+        else:
+            codes_run = pf.codes if method == "cohorts" or pf.codes_dev is None else pf.codes_dev
+        with telemetry.span("combine", method=method, size=size_run):
+            result = sharded_groupby_reduce(
+                arr_flat, codes_run, agg, size=size_run, mesh=mesh,
+                axis_name=axis_name, method=method, nat=False,
+            )
+        with telemetry.span("finalize"):
+            result = _astype_final(result, agg, None)
+            if mesh_present is not None:
+                from .kernels import scatter_present_dense
+
+                result = _redevice_scattered(
+                    scatter_present_dense(np.asarray(result), mesh_present, pf.size),
+                    array_is_jax,
+                )
+    else:
+        # -- eager single-device reduction ---------------------------------
+        if engine in ("jax", "sort"):
+            engine = _route_highcard_prefactorized(
+                engine, pf, arr_flat, lead_shape, agg, explicit=engine_explicit
+            )
+        if engine == "sort":
+            _note_highcard(pf.size, pf.ncap, len(pf.present))
+            ccodes = pf.ccodes_dev if pf.ccodes_dev is not None else pf.ccodes
+            result_c = _reduce_blockwise(
+                arr_flat, ccodes, agg, size=pf.ncap, engine="jax",
+                prog_family="sort",
+            )
+            from .kernels import scatter_present_dense
+
+            result = _redevice_scattered(
+                scatter_present_dense(np.asarray(result_c), pf.present, pf.size),
+                array_is_jax,
+            )
+        else:
+            codes = pf.codes_dev if engine == "jax" and pf.codes_dev is not None else pf.codes
+            result = _reduce_blockwise(arr_flat, codes, agg, size=pf.size, engine=engine)
+
+    out_shape = lead_shape + pf.group_shape
+    new_dims = agg.new_dims()
+    if new_dims:
+        out_shape = new_dims + out_shape
+    result = result.reshape(out_shape)
+    return (result,) + tuple(_index_values(g) for g in pf.found_groups)
+
+
+def _route_highcard_prefactorized(engine, pf, arr_flat, lead_shape, agg, *,
+                                  explicit: bool) -> str:
+    """Dense-vs-sort routing off the put-time tables: the same decisions as
+    :func:`_route_highcard`, with zero per-request hashing — ``present`` /
+    ``ncap`` come off the :class:`factorize.Prefactorized` instead of the
+    content-fingerprinted ``present_groups`` memo."""
+    from .options import OPTIONS
+    from .parallel.mapreduce import dense_intermediate_bytes
+
+    lead_elems = int(np.prod(lead_shape)) if lead_shape else 1
+    ceiling = OPTIONS["dense_intermediate_bytes_max"]
+    est = dense_intermediate_bytes(lead_elems, pf.size, arr_flat.dtype, agg, ndev=1)
+    over = est > ceiling
+    if engine == "jax" and not over and (
+        explicit or pf.size < OPTIONS["sort_engine_min_groups"]
+    ):
+        return "jax"
+    if over:
+        est_sort = dense_intermediate_bytes(lead_elems, pf.ncap, arr_flat.dtype, agg, ndev=1)
+        if est_sort > ceiling or (engine == "jax" and explicit):
+            from .utils import fmt_bytes
+
+            raise ValueError(
+                f"{agg.name!r} over {pf.size} groups needs ~{fmt_bytes(est)} "
+                f"of dense (..., size) device intermediates, above the "
+                f"{fmt_bytes(ceiling)} dense_intermediate_bytes_max ceiling. "
+                "Options: pass mesh=; use engine='sort'; or raise "
+                "set_options(dense_intermediate_bytes_max=...)."
+            )
+        telemetry.count("highcard.ceiling_routes")
+        return "sort"
+    if engine == "sort":
+        return "sort"
+    return "sort" if pf.ncap * _HIGHCARD_DENSITY_DEN <= pf.size else "jax"
 
 
 def _sparsify_result(result, codes_flat, ngroups: int, agg: Aggregation):

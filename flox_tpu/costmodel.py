@@ -488,6 +488,10 @@ def program_report(top: int | None = None, program: str | None = None) -> dict:
         "platform": platform_name(),
         "overhead_ms": overhead,
         "drift_threshold": float(OPTIONS["costmodel_drift_threshold"]),
+        # per-dataset attribution: device time billed against resident
+        # registry entries ({"op": "put_dataset"} names), so the report
+        # answers "which pinned dataset is earning its HBM"
+        "datasets": telemetry.cost_by_dataset(),
     }
 
 

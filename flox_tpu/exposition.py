@@ -26,9 +26,10 @@ nothing but the stdlib:
   warmup manifest has been replayed, so a load balancer never routes
   traffic to a replica still paying compiles), ``/debug/costs`` (the
   per-program / per-tenant cost ledger as JSON — what ``python -m
-  flox_tpu.telemetry costs`` tabulates), and ``/debug/profile?seconds=N``
-  (starts an on-demand on-chip capture; 409 while one runs, 501 on
-  profiler-less backends). Starting the server seeds the saturation
+  flox_tpu.telemetry costs`` tabulates), ``/debug/datasets`` (the resident
+  dataset registry: pinned entries, HBM budget, evictions, per-dataset
+  cost ledger), and ``/debug/profile?seconds=N`` (starts an on-demand
+  on-chip capture; 409 while one runs, 501 on profiler-less backends). Starting the server seeds the saturation
   gauges to 0 and starts the opt-in saturation sampler
   (``OPTIONS["metrics_sample_interval"]``).
 
@@ -275,6 +276,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/debug/programs":
             body, status = self._programs(query)
             ctype = "application/json; charset=utf-8"
+        elif path == "/debug/datasets":
+            body, status = self._datasets(query)
+            ctype = "application/json; charset=utf-8"
         elif path == "/debug/profile":
             body, status = self._profile(query)
             ctype = "application/json; charset=utf-8"
@@ -344,6 +348,27 @@ class _Handler(BaseHTTPRequestHandler):
             return error
         program = params.get("program", [None])[0]
         payload = costmodel.program_report(top=top, program=program)
+        payload["replica"] = telemetry.replica_instance()
+        payload["host"] = telemetry.host_name()
+        return (json.dumps(payload, default=str) + "\n").encode(), 200
+
+    @staticmethod
+    def _datasets(query: str = "") -> tuple[bytes, int]:
+        """The resident-dataset registry as JSON: every pinned entry (bytes,
+        pins, hits, selector-view count), the HBM budget verdict, eviction
+        count, and the per-dataset cost ledger — the operator's answer to
+        "what is holding device memory and is it earning its keep".
+
+        ``?top=K`` keeps the K most-hit entries (malformed = 400, same
+        contract as the other ``/debug/*`` endpoints)."""
+        from . import telemetry
+        from .serve import registry
+
+        params = urllib.parse.parse_qs(query)
+        top, error = _parse_top(params)
+        if error is not None:
+            return error
+        payload = registry.debug_table(top=top)
         payload["replica"] = telemetry.replica_instance()
         payload["host"] = telemetry.host_name()
         return (json.dumps(payload, default=str) + "\n").encode(), 200

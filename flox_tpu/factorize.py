@@ -29,7 +29,15 @@ import pandas as pd
 from . import utils
 from .types import FactorProps
 
-__all__ = ["factorize_", "factorize_cached", "factorize_single", "factorize_device", "bin_device"]
+__all__ = [
+    "Prefactorized",
+    "bin_device",
+    "factorize_",
+    "factorize_cached",
+    "factorize_device",
+    "factorize_single",
+    "prefactorize",
+]
 
 
 def _view_if_datetime(values: np.ndarray) -> np.ndarray:
@@ -225,6 +233,185 @@ def bin_device(by, edges, closed: str = "right"):
         codes = jnp.searchsorted(edges, by, side="right") - 1
         valid = (by >= edges[0]) & (by < edges[-1])
     return jnp.where(valid, codes, -1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# prefactorized labels: the serving registry's factorize-once artifact
+# ---------------------------------------------------------------------------
+
+
+class Prefactorized:
+    """A put-time factorization artifact: codes, group tables, and device
+    stages, computed ONCE and reused across requests.
+
+    This is the serving-era realization of flox's "factorize once, reduce
+    many" (PAPER.md): the dataset registry builds one of these at
+    ``put_dataset`` time via :func:`prefactorize` and every later request
+    passes it AS the single ``by`` to ``groupby_reduce`` /
+    ``groupby_aggregate_many``. The core paths detect it and skip the
+    ``factorize`` telemetry span, the pandas factorize, *and* the codes
+    H2D — the dense codes (``codes_dev``) and the sort engine's compact
+    codes (``ccodes_dev``) were staged on device here, so they pass
+    ``utils.asarray_device`` untouched and unbilled (``bytes.h2d`` == 0 on
+    the hit path).
+
+    Host mirrors (``codes`` / ``ccodes``) are kept for the numpy engine,
+    mesh cohort detection, and device-loss restaging (:meth:`stage` is
+    idempotent and re-runs after ``device.reinitialize()``).
+    """
+
+    __slots__ = (
+        "codes", "codes_dev", "ccodes", "ccodes_dev", "present", "ncap",
+        "found_groups", "group_shape", "ngroups", "size", "n",
+        "by_shape", "by_dtype", "props", "fingerprint",
+    )
+
+    # -- numpy-duck attributes: the serve dispatcher treats `by` uniformly -
+    @property
+    def shape(self) -> tuple:
+        return self.by_shape
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self.by_dtype
+
+    @property
+    def ndim(self) -> int:
+        return len(self.by_shape)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.codes.nbytes)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"Prefactorized(shape={self.by_shape}, ngroups={self.ngroups}, "
+            f"size={self.size}, present={len(self.present)}, "
+            f"staged={self.codes_dev is not None})"
+        )
+
+    def device_nbytes(self) -> int:
+        """Bytes this artifact pins on device (the registry's HBM account)."""
+        total = 0
+        for a in (self.codes_dev, self.ccodes_dev):
+            total += int(getattr(a, "nbytes", 0) or 0)
+        return total
+
+    def stage(self) -> "Prefactorized":
+        """(Re-)stage the dense and compact codes on device. Idempotent by
+        value: runs at put time, and again from the device-loss recovery
+        hook — the host mirrors are the spill copies."""
+        self.codes_dev = utils.asarray_device(self.codes)
+        self.ccodes_dev = utils.asarray_device(self.ccodes)
+        return self
+
+    def _derive(self, codes: np.ndarray, codes_dev, by_shape: tuple) -> "Prefactorized":
+        """A selector view sharing this artifact's group tables: new codes,
+        same groups/size, sort tables recomputed for the selection (the
+        ``present_groups`` memo makes repeats content-keyed hits)."""
+        from .kernels import compact_codes, present_cap, present_groups
+
+        out = Prefactorized()
+        out.codes = codes
+        out.found_groups = self.found_groups
+        out.group_shape = self.group_shape
+        out.ngroups = self.ngroups
+        out.size = self.size
+        out.n = int(codes.size)
+        out.by_shape = tuple(by_shape)
+        out.by_dtype = self.by_dtype
+        out.props = self.props
+        out.fingerprint = None
+        out.present = present_groups(codes, self.size)
+        out.ncap = present_cap(len(out.present), self.size)
+        out.ccodes = compact_codes(codes, out.present)
+        out.codes_dev = codes_dev
+        # the view's compact codes are new host values: one small H2D at
+        # view-build time (views are memoized per selector by the registry)
+        out.ccodes_dev = utils.asarray_device(out.ccodes) if codes_dev is not None else None
+        return out
+
+    def slice_rows(self, start: int, stop: int) -> "Prefactorized":
+        """Row-range view over the flat span: host codes sliced, device
+        codes sliced ON device (zero H2D for the dense engine)."""
+        start, stop = int(start), int(stop)
+        if not (0 <= start < stop <= self.n):
+            raise ValueError(
+                f"row range [{start}, {stop}) out of bounds for span {self.n}"
+            )
+        sub = np.ascontiguousarray(self.codes[start:stop])
+        dev = self.codes_dev[start:stop] if self.codes_dev is not None else None
+        return self._derive(sub, dev, (int(sub.size),))
+
+    def select_mask(self, mask) -> "Prefactorized":
+        """Boolean-mask view over the flat span (device gather of the
+        staged codes; only the small index vector transfers)."""
+        mask = np.asarray(mask, dtype=bool).reshape(-1)
+        if int(mask.size) != self.n:
+            raise ValueError(f"mask length {mask.size} != dataset span {self.n}")
+        idx = np.flatnonzero(mask)
+        if idx.size == 0:
+            raise ValueError("mask selects no rows")
+        sub = np.ascontiguousarray(self.codes[idx])
+        dev = None
+        if self.codes_dev is not None:
+            import jax.numpy as jnp
+
+            dev = jnp.take(self.codes_dev, jnp.asarray(idx), axis=0)
+        return self._derive(sub, dev, (int(sub.size),))
+
+
+def prefactorize(
+    by,
+    expected_groups=None,
+    *,
+    sort: bool = True,
+    stage: bool = True,
+    fingerprint: str | None = None,
+) -> Prefactorized:
+    """Factorize ``by`` once, eagerly, with the sort engine's present
+    tables and (by default) device-staged codes — the registry put path.
+
+    Reduces over ALL of ``by``'s axes (the serving contract: a dataset's
+    labels are fully reduced; kept axes belong to ``array``'s lead dims).
+    """
+    b = utils.asarray_host(np.asarray(by))
+    if b.size == 0:
+        raise ValueError("cannot prefactorize empty labels")
+    expected_idx = None
+    if expected_groups is not None:
+        from .core import _convert_expected_groups_to_index, _normalize_expected
+
+        expected_idx = _convert_expected_groups_to_index(
+            _normalize_expected(expected_groups, 1), (False,), sort
+        )
+    codes, found_groups, grp_shape, ngroups, size, props = factorize_cached(
+        (b,), axes=tuple(range(b.ndim)), expected_groups=expected_idx, sort=sort
+    )
+    if ngroups == 0 or size == 0:
+        raise ValueError("No groups to reduce over (empty expected_groups?)")
+    from .kernels import compact_codes, present_cap, present_groups
+
+    codes_flat = np.ascontiguousarray(np.asarray(codes).reshape(-1), dtype=np.int64)
+    pf = Prefactorized()
+    pf.codes = codes_flat
+    pf.found_groups = tuple(found_groups)
+    pf.group_shape = tuple(grp_shape)
+    pf.ngroups = int(ngroups)
+    pf.size = int(size)
+    pf.n = int(codes_flat.size)
+    pf.by_shape = tuple(b.shape)
+    pf.by_dtype = np.dtype(b.dtype)
+    pf.props = props
+    pf.fingerprint = fingerprint
+    pf.present = present_groups(codes_flat, pf.size)
+    pf.ncap = present_cap(len(pf.present), pf.size)
+    pf.ccodes = compact_codes(codes_flat, pf.present)
+    pf.codes_dev = None
+    pf.ccodes_dev = None
+    if stage:
+        pf.stage()
+    return pf
 
 
 # ---------------------------------------------------------------------------

@@ -199,6 +199,17 @@ def _aggregate_many_impl(
         )
 
     nby = len(by)
+    if nby == 1 and isinstance(by[0], fct.Prefactorized):
+        # registry fast path: factorization happened at put_dataset time —
+        # no factorize span, and the put-staged device codes feed the fused
+        # program directly (zero codes H2D on the hit path)
+        return _aggregate_many_prefactorized(
+            array, by[0], funcs=funcs, expected_groups=expected_groups,
+            isbin=isbin, axis=axis, fill_value=fill_value, dtype=dtype,
+            min_count=min_count, engine=engine,
+            finalize_kwargs=finalize_kwargs, method=method, mesh=mesh,
+            axis_name=axis_name,
+        )
     bys = [utils.asarray_host(b) for b in by]
     bys = list(np.broadcast_arrays(*bys)) if nby > 1 else bys
     array_is_jax = utils.is_jax_array(array)
@@ -381,6 +392,191 @@ def _aggregate_many_impl(
     with telemetry.span("finalize"):
         out = finalize_many(fused, results, out_shape)
     return (out,) + tuple(_index_values(g) for g in found_groups)
+
+
+def _aggregate_many_prefactorized(
+    array: Any,
+    pf: "fct.Prefactorized",
+    *,
+    funcs: tuple,
+    expected_groups: Any,
+    isbin: Any,
+    axis: Any,
+    fill_value: Any,
+    dtype: Any,
+    min_count: int | None,
+    engine: str | None,
+    finalize_kwargs: dict | None,
+    method: str | None,
+    mesh: Any,
+    axis_name: str,
+) -> tuple:
+    """Fused multi-statistic over a :class:`factorize.Prefactorized` ``by``
+    — the registry fast path of :func:`groupby_aggregate_many`. Mirrors the
+    inline body from the engine choice onward, minus factorize and minus
+    the codes H2D (put-staged ``codes_dev`` feeds the fused program)."""
+    from .core import _choose_engine
+
+    bad = [
+        name
+        for name, val in (
+            ("expected_groups", expected_groups),
+            ("axis", axis),
+        )
+        if val is not None
+    ]
+    if isbin not in (False, (False,)):
+        bad.append("isbin")
+    if bad:
+        raise NotImplementedError(
+            f"Prefactorized `by` does not support {bad}: the factorization "
+            "is fixed at put time (re-put the dataset with different groups)"
+        )
+    array_is_jax = utils.is_jax_array(array)
+    engine = _choose_engine(engine, array, array_is_jax)
+    arr = array if array_is_jax else np.asarray(array)
+    arr_dtype = np.dtype(arr.dtype)
+    from . import dtypes as dtps
+
+    if arr_dtype.kind in "OSUmM" or dtps.is_datetime_like(arr_dtype):
+        raise NotImplementedError(
+            f"groupby_aggregate_many supports numeric data; got {arr_dtype}"
+        )
+    if arr_dtype.kind == "b":
+        addlike = {"sum", "nansum", "prod", "nanprod"}
+        boolsafe = {"all", "any", "count"}
+        if set(funcs) <= boolsafe:
+            pass
+        elif set(funcs) <= (addlike | boolsafe):
+            arr = arr.astype(np.int64 if utils.x64_enabled() else np.int32)
+        else:
+            raise NotImplementedError(
+                f"bool data fuses only {sorted(addlike | boolsafe)}; run "
+                f"{sorted(set(funcs) - addlike - boolsafe)} sequentially"
+            )
+    bndim = len(pf.by_shape)
+    if arr.ndim < bndim or tuple(arr.shape[arr.ndim - bndim:]) != tuple(pf.by_shape):
+        raise ValueError(
+            f"`array` with shape {arr.shape} does not align with the "
+            f"prefactorized `by` shape {pf.by_shape}"
+        )
+
+    size = pf.size
+    min_count_ = 0 if min_count is None else min_count
+    fused = plan_fused(funcs, dtype, arr.dtype, fill_value, min_count_, finalize_kwargs)
+
+    lead_shape = arr.shape[: arr.ndim - bndim]
+    arr_flat = arr.reshape(lead_shape + (pf.n,))
+    out_shape = lead_shape + pf.group_shape
+
+    if OPTIONS["autotune"] and engine == "jax":
+        from . import autotune
+
+        nelems = int(np.prod(arr_flat.shape)) if arr_flat.ndim else 0
+        choice = autotune.decide(
+            "fused", "fused", ("fused", "sequential"),
+            dtype=str(arr_flat.dtype), ngroups=size, nelems=nelems,
+        )
+        if choice == "sequential":
+            def per_func_kw(f):
+                def pick(v):
+                    return v.get(f) if isinstance(v, dict) else v
+
+                return {
+                    "fill_value": pick(fill_value), "dtype": pick(dtype),
+                    "finalize_kwargs": pick(finalize_kwargs),
+                    "min_count": min_count,
+                }
+
+            return _sequential_fallback(
+                array, (pf,), funcs, per_func_kw=per_func_kw,
+                common_kw={
+                    "engine": engine, "method": method, "mesh": mesh,
+                    "axis_name": axis_name,
+                },
+            )
+
+    if method is not None or mesh is not None:
+        from .parallel.mapreduce import sharded_groupby_reduce
+
+        codes_run = pf.codes if (method == "cohorts" or pf.codes_dev is None) else pf.codes_dev
+        with telemetry.span("combine", method=method or "map-reduce", size=size):
+            results = sharded_groupby_reduce(
+                arr_flat, codes_run, fused, size=size, mesh=mesh,
+                axis_name=axis_name, method=method or "map-reduce",
+            )
+        with telemetry.span("finalize"):
+            out = finalize_many(fused, results, out_shape)
+        return (out,) + tuple(_index_values(g) for g in pf.found_groups)
+
+    if engine == "numpy":
+        inters = fused_chunk_stats(
+            fused, pf.codes, arr_flat, size=size, engine="numpy", eager=True
+        )
+        with telemetry.span("finalize"):
+            out = finalize_many(fused, fused.finalize_fused(inters), out_shape)
+        return (out,) + tuple(_index_values(g) for g in pf.found_groups)
+
+    from .parallel.mapreduce import dense_intermediate_bytes
+
+    lead_elems = int(np.prod(lead_shape)) if lead_shape else 1
+    est = dense_intermediate_bytes(lead_elems, size, arr_flat.dtype, fused, ndev=1)
+    ceiling = OPTIONS["dense_intermediate_bytes_max"]
+    if est > ceiling:
+        raise ValueError(
+            f"{fused.name!r} over {size} groups needs ~{utils.fmt_bytes(est)} "
+            f"of dense (..., size) device intermediates, above the "
+            f"{utils.fmt_bytes(ceiling)} dense_intermediate_bytes_max ceiling."
+        )
+
+    key = _fused_key(fused, size)
+    program = _FUSED_PROGRAM_CACHE.get(key)
+    if program is None:
+        telemetry.count("cache.fused_program_misses")
+        import jax
+
+        def run(codes_d, array_d):
+            inters = fused_chunk_stats(
+                fused, codes_d, array_d, size=size, engine="jax", eager=True
+            )
+            return fused.finalize_fused(inters)
+
+        program = jax.jit(run)
+        _FUSED_PROGRAM_CACHE[key] = program
+    else:
+        telemetry.count("cache.fused_program_hits")
+
+    tm_on = telemetry.enabled()
+    if tm_on:
+        from time import perf_counter
+
+        compiles0 = telemetry.METRICS.get("jax.compiles")
+        compile_ms0 = telemetry.METRICS.get("jax.compile_ms")
+        t0 = perf_counter()
+    with telemetry.span("dispatch", engine="jax", nstats=len(funcs), size=size):
+        codes_d = utils.asarray_device(
+            pf.codes_dev if pf.codes_dev is not None else pf.codes
+        )
+        arr_d = utils.asarray_device(arr_flat)
+        results = program(codes_d, arr_d)
+    if tm_on:
+        dispatch_ms = (perf_counter() - t0) * 1e3
+        prog = fused_program_label(funcs)
+        telemetry.sample_hbm(program=prog)
+        from . import costmodel
+
+        costmodel.ensure_card(prog, program, (codes_d, arr_d))
+        telemetry.observe_cost(
+            prog,
+            device_ms=dispatch_ms,
+            nbytes=int(getattr(arr_flat, "nbytes", 0))
+            + int(getattr(pf.codes, "nbytes", 0)),
+            compiles=int(telemetry.METRICS.get("jax.compiles") - compiles0),
+            compile_ms=telemetry.METRICS.get("jax.compile_ms") - compile_ms0,
+        )
+    with telemetry.span("finalize"):
+        out = finalize_many(fused, results, out_shape)
+    return (out,) + tuple(_index_values(g) for g in pf.found_groups)
 
 
 def _index_values(idx):

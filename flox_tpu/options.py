@@ -326,6 +326,25 @@ OPTIONS: dict[str, Any] = {
     # seconds an open breaker fast-fails before admitting one half-open
     # probe request (success closes the breaker, failure re-opens it)
     "serve_breaker_cooldown": _env_float("FLOX_TPU_SERVE_BREAKER_COOLDOWN", 30.0),
+    # Resident dataset registry (flox_tpu/serve/registry.py): fraction of
+    # the device's reported HBM capacity (device.memory_stats()
+    # bytes_limit — the PR 13 hbm.bytes_limit gauge source) the registry
+    # may pin. Past it, unpinned entries are LRU-evicted at put time.
+    "registry_budget_fraction": _env_float(
+        "FLOX_TPU_REGISTRY_BUDGET_FRACTION", 0.5, 0.0, 1.0, lo_open=True
+    ),
+    # absolute device-byte budget used where the backend reports NO memory
+    # limit (CPU test rigs): same LRU eviction against this ceiling.
+    # 0 disables budget enforcement entirely.
+    "registry_budget_bytes": _env_int(
+        "FLOX_TPU_REGISTRY_BUDGET_BYTES", 1 << 30, 0
+    ),
+    # dataset arrays at or above this many bytes are mesh-sharded over the
+    # trailing axis at put time (feeding the parallel plane's per-shard
+    # codes directly); below it they stay single-chip. 0 = never shard.
+    "registry_shard_threshold_bytes": _env_int(
+        "FLOX_TPU_REGISTRY_SHARD_THRESHOLD_BYTES", 1 << 30, 0
+    ),
     # AOT persistence root (flox_tpu/serve/aot.py): the JAX persistent
     # compilation cache directory + the warmup manifest next to it. A
     # fresh replica pointed at a warm dir serves its first request with
@@ -478,6 +497,12 @@ _VALIDATORS = {
     "serve_watchdog_timeout": lambda x: _is_finite_num(x) and x >= 0,
     "serve_breaker_threshold": lambda x: _is_int(x) and 0 <= x <= 10_000,
     "serve_breaker_cooldown": lambda x: _is_finite_num(x) and x >= 0,
+    # registry knobs: same at-set-time discipline — a fraction outside
+    # (0, 1] or a negative byte budget raises here, not inside a put's
+    # eviction sweep
+    "registry_budget_fraction": lambda x: _is_finite_num(x) and 0 < x <= 1,
+    "registry_budget_bytes": lambda x: _is_int(x) and x >= 0,
+    "registry_shard_threshold_bytes": lambda x: _is_int(x) and x >= 0,
     "serve_aot_dir": lambda x: x is None or (
         isinstance(x, (str, os.PathLike)) and bool(str(x))
     ),

@@ -483,7 +483,13 @@ def sharded_groupby_reduce(
         cohort_perm = ownership_permutation(mapping, size, ndev)
 
     arr = utils.asarray_device(array)
-    codes_dev = jnp.asarray(np.asarray(codes), dtype=jnp.int32)
+    if utils.is_jax_array(codes):
+        # pre-staged device codes (a registry put / factorize.Prefactorized
+        # feeds its per-shard codes straight in): skip the host round-trip —
+        # the put already paid the one H2D
+        codes_dev = codes if codes.dtype == jnp.int32 else codes.astype(jnp.int32)
+    else:
+        codes_dev = jnp.asarray(np.asarray(codes), dtype=jnp.int32)
     n = codes_dev.shape[0]
     pad = _pad_to(n, ndev)
     if pad:

@@ -16,6 +16,12 @@ requests instead:
 * :mod:`.breaker` — per-program circuit breakers: a program key whose
   dispatches keep failing fatally fast-fails at submit with a typed
   :class:`CircuitOpenError` until a half-open probe closes it.
+* :mod:`.registry` — resident datasets: ``{"op": "put_dataset"}`` pins
+  named arrays on device, factorized ONCE at put time; requests that
+  reference them (``"dataset": name`` + optional ``rows``/``mask``
+  selector) skip JSON payloads, factorize, and H2D entirely. HBM-budgeted,
+  LRU-evicted (never mid-dispatch — refcount pins), re-pinned from host
+  spills by device-loss recovery.
 * ``python -m flox_tpu.serve`` — a JSON-lines request loop over the
   dispatcher, for testing and smoke deployment (see :mod:`.__main__`).
 
@@ -45,7 +51,7 @@ one merged view (plus a live ops console).
 
 from __future__ import annotations
 
-from . import aot, breaker
+from . import aot, breaker, registry
 from .dispatcher import (
     AggregationRequest,
     CircuitOpenError,
@@ -59,6 +65,7 @@ from .dispatcher import (
     WatchdogTimeoutError,
     payload_digest,
 )
+from .registry import UnknownDatasetError
 
 __all__ = [
     "AggregationRequest",
@@ -70,8 +77,10 @@ __all__ = [
     "LoadShedError",
     "ServeError",
     "ServeResult",
+    "UnknownDatasetError",
     "WatchdogTimeoutError",
     "aot",
     "breaker",
     "payload_digest",
+    "registry",
 ]

@@ -71,7 +71,7 @@ _REQUEST_FIELDS = frozenset(
     {
         "func", "array", "by", "expected_groups", "fill_value", "dtype",
         "min_count", "engine", "finalize_kwargs", "options", "deadline",
-        "tenant", "traceparent",
+        "tenant", "traceparent", "dataset", "rows", "mask",
     }
 )
 
@@ -120,6 +120,13 @@ async def _serve_request(dispatcher: Dispatcher, line_no: int, msg: dict) -> Non
         unknown = set(msg) - _REQUEST_FIELDS - {"id"}
         if unknown:
             raise ValueError(f"unknown request fields: {sorted(unknown)}")
+        if msg.get("dataset") is not None and (
+            msg.get("by") is not None or msg.get("expected_groups") is not None
+        ):
+            raise ValueError(
+                "a 'dataset' request must not also carry 'by'/"
+                "'expected_groups' — they were fixed at put_dataset time"
+            )
         request = AggregationRequest(
             request_id=rid, **{k: v for k, v in msg.items() if k != "id"}
         )
@@ -373,6 +380,46 @@ async def _amain(args: argparse.Namespace) -> int:
                 from ..telemetry import METRICS
 
                 _emit({"warmed": warmed, "compiles": METRICS.get("jax.compiles")})
+            elif op == "put_dataset":
+                # factorize + stage happen here, ONCE: off the loop (a
+                # multi-GB put must not stall every in-flight request's
+                # admission), then every later {"dataset": name} request
+                # skips parse, factorize, and H2D entirely
+                from . import registry
+
+                try:
+                    info = await asyncio.to_thread(
+                        registry.put,
+                        msg.get("name"),
+                        array=msg.get("array"),
+                        by=msg.get("by"),
+                        expected_groups=msg.get("expected_groups"),
+                        sort=bool(msg.get("sort", True)),
+                    )
+                # noqa: FLX006 — not a retry loop: the put is one client
+                # request, and a bad payload (or a put racing device loss)
+                # must be answered, never kill the replica
+                except Exception as exc:  # noqa: FLX006,BLE001
+                    from .. import telemetry
+
+                    telemetry.record_serve_error(exc, what="put_dataset")
+                    _emit({"op": "put_dataset", "ok": False,
+                           "name": msg.get("name"), "error": type(exc).__name__,
+                           "code": "protocol", "message": str(exc)})
+                else:
+                    _emit({"op": "put_dataset", "ok": True, **info})
+            elif op == "del_dataset":
+                from . import registry
+
+                deleted = registry.delete(msg.get("name"))
+                _emit({"op": "del_dataset", "ok": True,
+                       "name": msg.get("name"), "deleted": bool(deleted)})
+            elif op == "list_datasets":
+                from . import registry
+
+                _emit({"op": "list_datasets", "ok": True,
+                       "datasets": registry.list_datasets(),
+                       "stats": registry.registry_stats()})
             elif op == "drain":
                 if pending:
                     await asyncio.gather(*pending, return_exceptions=True)

@@ -160,6 +160,7 @@ def record_reduce(
     ngroups: int,
     agg_kwargs: dict,
     options: dict,
+    dataset: str | None = None,
 ) -> bool:
     """Record one served program's request spec into the warmup manifest.
 
@@ -169,6 +170,11 @@ def record_reduce(
     kwargs), or when it is already in the manifest. A *new* spec persists
     the manifest immediately (merge-on-save), so a replica killed mid-run
     still leaves every program it served warmable.
+
+    ``dataset`` stamps registry-referenced dispatches for the operator
+    reading the manifest; it is EXCLUDED from the spec digest — program
+    identity is shapes/dtypes/ngroups, never residency, so the inline
+    warmup replay warms the very program a registry hit runs.
     """
     multi = isinstance(func, (tuple, list)) and all(
         isinstance(f, str) for f in func
@@ -191,6 +197,10 @@ def record_reduce(
     except TypeError:
         return False
     digest = spec_digest(spec)
+    if dataset is not None:
+        # informational only (excluded from the digest above): the replay
+        # path ignores it, dedup stays residency-blind
+        spec = {**spec, "dataset": str(dataset)}
     with _LOCK:
         if digest in _MANIFEST_MEMO:
             return False

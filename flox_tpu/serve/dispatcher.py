@@ -163,8 +163,8 @@ class AggregationRequest:
     arrays (anything ``np.asarray`` accepts)."""
 
     func: Any
-    array: Any
-    by: Any
+    array: Any = None
+    by: Any = None
     expected_groups: Any = None
     fill_value: Any = None
     dtype: Any = None
@@ -191,6 +191,20 @@ class AggregationRequest:
     #: /metrics. Attribution only — a tenant tag never changes the program
     #: key, so tagged and untagged requests still coalesce/batch together.
     tenant: str | None = None
+    #: optional resident-dataset reference (``serve.registry``): the
+    #: request's ``array``/``by`` come from the named put_dataset entry
+    #: (data optional — a labels-only entry still accepts inline ``array``
+    #: over resident codes). The entry's content fingerprint replaces
+    #: payload hashing in the program key, so hits skip JSON payloads,
+    #: factorize, H2D, AND digesting. Unknown names answer a typed
+    #: :class:`~flox_tpu.serve.registry.UnknownDatasetError`.
+    dataset: str | None = None
+    #: optional ``[start, stop)`` row-range selector over the dataset's
+    #: flattened label axis (device-side slice — no H2D)
+    rows: Any = None
+    #: optional boolean-mask selector over the same axis (device-side
+    #: gather); mutually exclusive with ``rows``
+    mask: Any = None
 
 
 @dataclass
@@ -241,11 +255,15 @@ class _Batch:
     """An open micro-batch: leaves sharing one program key, dispatched as
     one device call after the batching window closes."""
 
-    __slots__ = ("pkey", "leaves", "open", "func", "by", "agg_kwargs", "overrides")
+    __slots__ = (
+        "pkey", "leaves", "open", "func", "by", "agg_kwargs", "overrides",
+        "dsentry", "dslabel",
+    )
 
     def __init__(
-        self, pkey: tuple, func: Any, by: np.ndarray,
+        self, pkey: tuple, func: Any, by: Any,
         agg_kwargs: dict, overrides: dict,
+        dsentry: Any = None, dslabel: str | None = None,
     ) -> None:
         self.pkey = pkey
         self.leaves: list[_Leaf] = []
@@ -254,6 +272,10 @@ class _Batch:
         self.by = by
         self.agg_kwargs = agg_kwargs
         self.overrides = overrides
+        #: the pinned registry entry this batch dispatches against (the
+        #: pin is released when the batch settles), and its billing label
+        self.dsentry = dsentry
+        self.dslabel = dslabel
 
 
 #: admission/pending table: every admitted request (queued OR executing),
@@ -501,8 +523,58 @@ class Dispatcher:
             # JSON clients send statistic sets as lists; the program key
             # and the fused planner both want the hashable tuple form
             request.func = tuple(request.func)
-        arr = np.asarray(request.array)
-        by = np.asarray(request.by)
+        dsentry = None
+        dslabel: str | None = None
+        if request.dataset is not None:
+            # resident-dataset reference: resolve + refcount-pin the entry
+            # (the pin rides the batch and is released when its dispatch
+            # settles, so eviction/del_dataset never races an in-flight
+            # dispatch), and reuse the put-time content fingerprint as the
+            # coalescing identity — zero payload hashing on the hit path
+            from . import registry
+
+            if request.by is not None or request.expected_groups is not None:
+                raise ValueError(
+                    "a dataset-referencing request must not also inline "
+                    "'by'/'expected_groups' — they were fixed at put time"
+                )
+            dsentry = registry.resolve(request.dataset)
+            registry.pin(dsentry)
+            try:
+                data_view, pf_view, selkey = registry.view(
+                    dsentry, rows=request.rows, mask=request.mask
+                )
+                by_digest = f"ds:{dsentry.fingerprint}:{selkey}"
+                if request.array is not None:
+                    # labels-resident mode: per-request data over the
+                    # entry's precomputed codes
+                    arr = np.asarray(request.array)
+                    arr_digest = await _digest_payload(arr)
+                elif data_view is None:
+                    raise ValueError(
+                        f"dataset {request.dataset!r} holds no data array; "
+                        "inline 'array' with the request"
+                    )
+                else:
+                    arr = data_view
+                    arr_digest = by_digest
+            except BaseException:
+                registry.unpin(dsentry)
+                raise
+            by = pf_view
+            dslabel = request.dataset
+        else:
+            if request.rows is not None or request.mask is not None:
+                raise ValueError(
+                    "'rows'/'mask' selectors require a 'dataset' reference"
+                )
+            if request.array is None or request.by is None:
+                raise ValueError(
+                    "inline requests require both 'array' and 'by' "
+                    "(or reference a resident 'dataset')"
+                )
+            arr = np.asarray(request.array)
+            by = np.asarray(request.by)
         # fold the submitter's AMBIENT scoped() overlay under the request's
         # own options (request wins): ambient knobs like default_engine
         # change results without appearing in trace_fingerprint(), so they
@@ -519,20 +591,35 @@ class Dispatcher:
             "engine": request.engine,
             "finalize_kwargs": request.finalize_kwargs,
         }
-        # large payloads hash in a worker thread — a multi-hundred-MB
-        # blake2b on the event-loop thread would stall every other
-        # request's admission, window timer, and deadline check
-        by_digest = await _digest_payload(by)
-        arr_digest = await _digest_payload(arr)
-        # the fingerprint half of the key must see the request's pinned
-        # knobs — evaluate under its scope (validates the overlay too, so a
-        # bad option name/value fails HERE, not inside a worker thread)
-        with options.scoped(**overrides):
-            pkey = _program_key(request.func, arr, by_digest, agg_kwargs, overrides)
-        # circuit-breaker gate: a program key whose recent dispatches all
-        # failed fatally fast-fails HERE (typed CircuitOpenError with the
-        # cooldown remaining) — no queue slot, no batch, no device time
-        breaker.check(pkey, _func_label(request.func))
+        try:
+            if dsentry is None:
+                # large payloads hash in a worker thread — a multi-hundred-MB
+                # blake2b on the event-loop thread would stall every other
+                # request's admission, window timer, and deadline check.
+                # Memoized per request OBJECT: a resubmitted request (library
+                # retry loops) never rehashes an unchanged payload.
+                digests = getattr(request, "_payload_digests", None)
+                if digests is None:
+                    by_digest = await _digest_payload(by)
+                    arr_digest = await _digest_payload(arr)
+                    request._payload_digests = (by_digest, arr_digest)
+                else:
+                    by_digest, arr_digest = digests
+            # the fingerprint half of the key must see the request's pinned
+            # knobs — evaluate under its scope (validates the overlay too, so a
+            # bad option name/value fails HERE, not inside a worker thread)
+            with options.scoped(**overrides):
+                pkey = _program_key(request.func, arr, by_digest, agg_kwargs, overrides)
+            # circuit-breaker gate: a program key whose recent dispatches all
+            # failed fatally fast-fails HERE (typed CircuitOpenError with the
+            # cooldown remaining) — no queue slot, no batch, no device time
+            breaker.check(pkey, _func_label(request.func))
+        except BaseException:
+            if dsentry is not None:
+                from . import registry
+
+                registry.unpin(dsentry)
+            raise
         payload_key = (pkey, arr_digest)
         deadline = request.deadline
         if deadline is None:
@@ -544,10 +631,19 @@ class Dispatcher:
         if coalesced:
             METRICS.inc("serve.coalesced")
             leaf.waiters += 1
+            if dsentry is not None:
+                # the leaf's own batch already pins the entry; this
+                # request only waits on the shared future
+                from . import registry
+
+                registry.unpin(dsentry)
         else:
             leaf = _Leaf(arr, payload_key)
             _COALESCE_CACHE[payload_key] = leaf
-            self._enqueue(leaf, request, arr, by, agg_kwargs, overrides, pkey)
+            self._enqueue(
+                leaf, request, arr, by, agg_kwargs, overrides, pkey,
+                dsentry=dsentry, dslabel=dslabel,
+            )
 
         try:
             # shield: one waiter's timeout must not cancel the shared leaf
@@ -627,7 +723,12 @@ class Dispatcher:
 
     # -- batching -----------------------------------------------------------
 
-    def _batchable(self, request: AggregationRequest, arr: np.ndarray) -> bool:
+    def _batchable(self, request: AggregationRequest, arr: Any) -> bool:
+        if request.dataset is not None:
+            # registry-referenced payloads are device-resident and shared:
+            # stacking them would force a D2H + restage of the very buffers
+            # the registry exists to keep pinned (coalescing still applies)
+            return False
         if _is_multi(request.func):
             # fused statistic sets contain only batchable reductions
             # (FUSABLE_FUNCS excludes the axis-growing order statistics),
@@ -647,11 +748,13 @@ class Dispatcher:
         self,
         leaf: _Leaf,
         request: AggregationRequest,
-        arr: np.ndarray,
-        by: np.ndarray,
+        arr: Any,
+        by: Any,
         agg_kwargs: dict,
         overrides: dict,
         pkey: tuple,
+        dsentry: Any = None,
+        dslabel: str | None = None,
     ) -> None:
         batchable = self._batchable(request, arr)
         if batchable:
@@ -665,7 +768,10 @@ class Dispatcher:
                 batch.leaves.append(leaf)
                 METRICS.inc("serve.microbatched")
                 return
-        batch = _Batch(pkey, request.func, by, agg_kwargs, overrides)
+        batch = _Batch(
+            pkey, request.func, by, agg_kwargs, overrides,
+            dsentry=dsentry, dslabel=dslabel,
+        )
         batch.leaves.append(leaf)
         if batchable:
             _BATCH_REGISTRY[pkey] = batch
@@ -675,6 +781,18 @@ class Dispatcher:
         task.add_done_callback(self._tasks.discard)
 
     async def _run_batch(self, batch: _Batch, window: float) -> None:
+        try:
+            await self._run_batch_inner(batch, window)
+        finally:
+            if batch.dsentry is not None:
+                # the batch settled (delivered, failed, or abandoned):
+                # release the registry pin so eviction / del_dataset can
+                # reclaim the entry
+                from . import registry
+
+                registry.unpin(batch.dsentry)
+
+    async def _run_batch_inner(self, batch: _Batch, window: float) -> None:
         # even window=0 yields the loop once, so same-tick submits coalesce
         await asyncio.sleep(window)
         batch.open = False
@@ -1006,11 +1124,17 @@ class Dispatcher:
             telemetry.sample_hbm(program=prog)
             # the program's cost-ledger row: one dispatch (however many
             # coalesced/batched waiters it served), its device wall, the
-            # bytes it staged, and the compiles it provoked
+            # bytes it staged, and the compiles it provoked. nbytes reads
+            # .nbytes straight off the dispatched array — np.asarray on a
+            # device-resident payload would D2H-copy it just to count it.
+            # A registry-referenced dispatch also bills the per-dataset
+            # ledger axis (cache.stats()["cost_by_dataset"]).
             telemetry.observe_cost(
                 prog,
+                dataset=batch.dslabel,
                 device_ms=device_ms,
-                nbytes=int(np.asarray(dispatched).nbytes) + int(batch.by.nbytes),
+                nbytes=int(getattr(dispatched, "nbytes", 0))
+                + int(getattr(batch.by, "nbytes", 0)),
                 compiles=int(telemetry.METRICS.get("jax.compiles") - compiles0),
                 compile_ms=telemetry.METRICS.get("jax.compile_ms") - compile_ms0,
             )
@@ -1019,15 +1143,21 @@ class Dispatcher:
         )
         for leaf in live:
             leaf.device_ms = device_ms
+        # dtype via getattr, never np.asarray: a device-resident payload
+        # must not round-trip through host memory for a string. Registry
+        # dispatches record their RESOLVED post-selector shapes — the
+        # inline warmup replay then compiles the identical XLA program
+        # (program identity is shapes/dtypes/ngroups, never residency).
         aot.record_reduce(
             func=batch.func,
             shape=tuple(np.shape(dispatched)),
-            dtype=str(np.asarray(dispatched).dtype),
+            dtype=str(dispatched.dtype),
             by_shape=tuple(batch.by.shape),
             by_dtype=str(batch.by.dtype),
             ngroups=int(groups.shape[0]) if groups.ndim else 1,
             agg_kwargs=kwargs,
             options=batch.overrides,
+            dataset=batch.dslabel,
         )
         return rows, groups
 
@@ -1061,6 +1191,13 @@ def _recover_device() -> None:
         from . import aot
 
         warmed = aot.warmup()
+        # re-pin every registered dataset from its host-side spill copies
+        # BEFORE readiness flips: a recovered replica that answered 200
+        # while its resident datasets still pointed at dead-device buffers
+        # would fail exactly the traffic the router sends it first
+        from . import registry
+
+        restaged = registry.restage_all()
         # flip ready back ONLY if the 503 is still ours: a graceful drain
         # that began mid-recovery set reason "draining", and that 503 must
         # hold until the process exits — a recovered-but-draining replica
@@ -1070,7 +1207,8 @@ def _recover_device() -> None:
             exposition.set_ready(True)
         METRICS.inc("serve.recoveries")
         telemetry.event(
-            "device-recovery-done", reinitialized=torn_down, warmed=warmed
+            "device-recovery-done", reinitialized=torn_down, warmed=warmed,
+            restaged=restaged,
         )
     except Exception as exc:  # noqa: BLE001 — an unrecoverable replica stays
         # unready (503) rather than crashing the loop; the record is the

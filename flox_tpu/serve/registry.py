@@ -1,0 +1,530 @@
+"""Resident dataset registry: factorize once, serve from HBM.
+
+flox's core insight is "factorize → reduce" with factorization done once
+per grouping — but a JSON-lines request that inlines its payload re-ships,
+re-parses, re-factorizes, and re-stages (H2D) the same arrays on every
+request, so hot-data request cost is dominated by everything *except* the
+reduction. This module is the serving-era fix: ``{"op": "put_dataset"}``
+pins named arrays on device ONCE, and aggregation requests reference them
+by name (``"dataset": "<name>"`` plus an optional ``rows``/``mask``
+selector) instead of carrying data.
+
+The put pays every per-dataset cost up front:
+
+* **factorize once** — labels are factorized at put time into a
+  :class:`~flox_tpu.factorize.Prefactorized` (codes, expected-groups
+  table, and the sort engine's present/compact tables, all keyed on the
+  entry's content fingerprint). A registry-hit request enters the core
+  reduction with ZERO factorize work — no ``factorize`` span appears in
+  its trace.
+* **stage once** — data and codes live on device; the dispatch passes the
+  resident buffers straight through ``utils.asarray_device`` (jax arrays
+  pass through untouched), so ``bytes.h2d`` does not move on the hit path.
+  Arrays at or above ``registry_shard_threshold_bytes`` are mesh-sharded
+  over the trailing axis at put time, feeding the parallel plane's
+  per-shard codes directly.
+* **fingerprint once** — the entry's content fingerprint replaces payload
+  hashing in the dispatcher's coalescing identity (``ds:<fp>:<selector>``),
+  so hot-path hashing cost on hits is zero and the PR 7 coalescing /
+  AOT-warmup contracts keep holding (the program key includes the dataset
+  fingerprint).
+
+Capacity is HBM-accounted: entries are bounded by
+``registry_budget_fraction`` of the device's ``bytes_limit`` (PR 13 HBM
+gauge) — or by the absolute ``registry_budget_bytes`` on backends that
+report no limit (CPU) — and evicted least-recently-used. Entries pinned by
+in-flight dispatches (refcounted by the dispatcher) are never evicted
+mid-dispatch; ``del_dataset`` under in-flight traffic is safe the same way
+(the dispatch holds direct references, so the delete only unpublishes the
+name). Host-side spill copies make device-loss recovery whole: the
+recovery cycle re-stages every registered dataset before ``/readyz`` flips
+back, so a recovered replica still answers its registry-referenced
+traffic.
+
+The registry table is registered in ``cache.clear_all`` / ``cache.stats``
+(floxlint FLX008); ``registry.*`` counters/gauges ride the always-on
+metrics registry like the rest of the serve plane, and per-dataset cost
+attribution rides the telemetry cost ledger's ``dataset`` axis
+(``cache.stats()["cost_by_dataset"]``, ``/debug/datasets``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+# options as a module attribute, never from-bound: tests reload
+# flox_tpu.options, and a from-import would read the pre-reload dict
+from .. import options, telemetry, utils
+from ..cache import LRUCache
+from ..factorize import Prefactorized, prefactorize
+from ..telemetry import METRICS
+from .dispatcher import ServeError
+
+__all__ = [
+    "DatasetEntry",
+    "UnknownDatasetError",
+    "budget_bytes",
+    "clear",
+    "debug_table",
+    "delete",
+    "list_datasets",
+    "pin",
+    "put",
+    "registry_stats",
+    "resolve",
+    "restage_all",
+    "unpin",
+    "view",
+]
+
+
+class UnknownDatasetError(ServeError):
+    """The request referenced a ``dataset`` name the registry does not
+    hold — never put, already deleted, or evicted under HBM pressure
+    (check the ``registry.evictions`` counter). A typed protocol error,
+    not an ``execution`` failure: the client's fix is ``put_dataset``
+    (or routing to the replica that holds the name)."""
+
+    code = "unknown_dataset"
+
+
+#: selector views memoized per entry — bounded: selectors are request-
+#: shaped, and an adversarial client cycling masks must not grow an
+#: entry's footprint without bound
+_MAX_VIEWS_PER_ENTRY = 8
+
+
+class DatasetEntry:
+    """One resident dataset: device buffers + precomputed group tables +
+    host-side spill copies (the device-loss re-pin source)."""
+
+    __slots__ = (
+        "name", "fingerprint", "data", "data_host", "by_host", "pf",
+        "nbytes", "pins", "hits", "sharded", "views", "created",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        fingerprint: str,
+        *,
+        data: Any,
+        data_host: np.ndarray | None,
+        by_host: np.ndarray,
+        pf: Prefactorized,
+        sharded: bool,
+    ) -> None:
+        self.name = name
+        self.fingerprint = fingerprint
+        self.data = data
+        self.data_host = data_host
+        self.by_host = by_host
+        self.pf = pf
+        self.nbytes = int(
+            (getattr(data, "nbytes", 0) or 0) + pf.device_nbytes()
+        )
+        self.pins = 0
+        self.hits = 0
+        self.sharded = sharded
+        self.views: dict[str, tuple] = {}
+        self.created = time.time()
+
+    def info(self) -> dict:
+        """The entry's JSON-safe description (list/debug/stats payloads)."""
+        return {
+            "name": self.name,
+            "fingerprint": self.fingerprint,
+            "nbytes": int(self.nbytes),
+            "pins": int(self.pins),
+            "hits": int(self.hits),
+            "sharded": bool(self.sharded),
+            "has_data": self.data is not None,
+            "by_shape": list(self.by_host.shape),
+            "by_dtype": str(self.by_host.dtype),
+            "ngroups": int(self.pf.ngroups),
+            "size": int(self.pf.size),
+            "present": int(len(self.pf.present)),
+            "views": len(self.views),
+        }
+
+
+#: the resident dataset table: name -> DatasetEntry, LRU-ordered so budget
+#: eviction drops the stalest name first. maxsize is a backstop, never the
+#: capacity mechanism — the HBM budget (budget_bytes) is. Registered in
+#: cache.clear_all / cache.stats (floxlint FLX008).
+_DATASET_REGISTRY: LRUCache = LRUCache(maxsize=4096)
+
+#: budget evictions (deliberate frees, distinct from the LRU's capacity
+#: counter): the runbook alarm feed behind registry.evictions
+_EVICTIONS = [0]
+
+_LOCK = threading.RLock()
+
+
+# ---------------------------------------------------------------------------
+# capacity
+# ---------------------------------------------------------------------------
+
+
+#: last computed budget — ``registry_stats()`` (the ``cache.stats()``
+#: panel) reports this snapshot instead of polling the device: stats on a
+#: disabled/idle plane must not touch the backend (the PR 13 HBM sampler
+#: owns live polling; puts/evictions and /debug/datasets refresh it)
+_BUDGET_SNAPSHOT = [0]
+
+
+def budget_bytes() -> int:
+    """The registry's device-byte budget.
+
+    ``registry_budget_fraction`` of the device's reported HBM capacity
+    (the PR 13 ``hbm.bytes_limit`` source) when the backend reports one;
+    the absolute ``registry_budget_bytes`` on backends that report no
+    limit (CPU test rigs). 0 means unenforced."""
+    from .. import device
+
+    stats = device.memory_stats()
+    limit = int((stats or {}).get("bytes_limit") or 0)
+    if limit > 0:
+        budget = int(limit * float(options.OPTIONS["registry_budget_fraction"]))
+    else:
+        budget = int(options.OPTIONS["registry_budget_bytes"])
+    _BUDGET_SNAPSHOT[0] = budget
+    return budget
+
+
+def _total_bytes() -> int:
+    return sum(e.nbytes for e in _DATASET_REGISTRY.values())
+
+
+def _publish_gauges() -> None:
+    entries = _DATASET_REGISTRY.values()
+    METRICS.set_gauge("registry.datasets", float(len(entries)))
+    METRICS.set_gauge(
+        "registry.bytes", float(sum(e.nbytes for e in entries))
+    )
+    METRICS.set_gauge(
+        "registry.pinned_bytes",
+        float(sum(e.nbytes for e in entries if e.pins > 0)),
+    )
+
+
+def _evict_to_budget(exclude: DatasetEntry | None = None) -> list[str]:
+    """Drop stalest entries until the device-byte total fits the budget.
+
+    Pinned entries (in-flight dispatches hold them) and ``exclude`` (the
+    put that triggered the sweep) are skipped — a workload whose PINNED
+    set alone exceeds the budget runs over it rather than failing
+    dispatches mid-flight; the overshoot is visible on ``registry.bytes``
+    vs the budget. Caller holds ``_LOCK``."""
+    budget = budget_bytes()
+    if budget <= 0:
+        return []
+    evicted: list[str] = []
+    total = _total_bytes()
+    # items() is stalest-first on the LRU — walk in eviction order
+    for name, entry in _DATASET_REGISTRY.items():
+        if total <= budget:
+            break
+        if entry.pins > 0 or entry is exclude:
+            continue
+        _DATASET_REGISTRY.pop(name, None)
+        total -= entry.nbytes
+        evicted.append(name)
+        _EVICTIONS[0] += 1
+        METRICS.inc("registry.evictions")
+        telemetry.event(
+            "registry-evicted", dataset=name, nbytes=entry.nbytes,
+            budget=budget,
+        )
+    return evicted
+
+
+# ---------------------------------------------------------------------------
+# staging
+# ---------------------------------------------------------------------------
+
+
+def _stage_data(data_host: np.ndarray) -> tuple[Any, bool]:
+    """Put one host array on device; mesh-shard over the trailing axis
+    when it crosses the single-chip threshold (and the mesh/divisibility
+    allow it). Returns ``(device_array, sharded)``; any sharding failure
+    degrades to the plain single-device put."""
+    thresh = int(options.OPTIONS["registry_shard_threshold_bytes"])
+    if thresh and data_host.nbytes >= thresh and data_host.ndim >= 1:
+        try:
+            import jax
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mapreduce import _cached_mesh_default
+
+            mesh = _cached_mesh_default()
+            ndev = int(np.prod(list(mesh.shape.values())))
+            if ndev > 1 and data_host.shape[-1] % ndev == 0:
+                spec = P(*([None] * (data_host.ndim - 1) + [tuple(mesh.shape)]))
+                out = jax.device_put(data_host, NamedSharding(mesh, spec))
+                telemetry.METRICS.inc("bytes.h2d", int(data_host.nbytes))
+                return out, True
+        except Exception as exc:  # noqa: BLE001 — sharding is an optimization
+            telemetry.record_serve_error(exc, what="registry.stage-sharded")
+    return utils.asarray_device(data_host), False
+
+
+def _fingerprint_update(h: Any, arr: np.ndarray | None) -> None:
+    if arr is None:
+        h.update(b"<none>")
+        return
+    a = np.asarray(arr)
+    h.update(str(a.dtype).encode())
+    h.update(repr(a.shape).encode())
+    if a.dtype.kind == "O":
+        h.update(repr(a.tolist()).encode())
+    else:
+        h.update(np.ascontiguousarray(a).tobytes())
+
+
+def _content_fingerprint(
+    by: np.ndarray, data: np.ndarray | None, expected: Any
+) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    _fingerprint_update(h, by)
+    _fingerprint_update(h, None if expected is None else np.asarray(expected))
+    _fingerprint_update(h, data)
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# protocol surface
+# ---------------------------------------------------------------------------
+
+
+def put(
+    name: Any,
+    array: Any = None,
+    by: Any = None,
+    *,
+    expected_groups: Any = None,
+    sort: bool = True,
+) -> dict:
+    """Pin one named dataset on device, factorized and staged.
+
+    ``by`` (the label arrays) is required — it is what factorize-once
+    applies to; ``array`` is optional (a labels-only entry serves requests
+    that still inline per-request data over resident codes). Re-putting a
+    name replaces the entry. Returns the entry's info dict plus what the
+    budget sweep evicted to make room.
+    """
+    if not isinstance(name, str) or not name:
+        raise ValueError("put_dataset requires a non-empty string 'name'")
+    if by is None:
+        raise ValueError(
+            "put_dataset requires 'by' label arrays — factorize-once is "
+            "the point of a resident dataset"
+        )
+    t0 = time.perf_counter()
+    by_host = utils.asarray_host(np.asarray(by))
+    data_host = np.asarray(array) if array is not None else None
+    if data_host is not None and data_host.shape[-by_host.ndim:] != by_host.shape:
+        raise ValueError(
+            f"dataset array trailing dims {data_host.shape!r} do not align "
+            f"with by shape {by_host.shape!r}"
+        )
+    fingerprint = _content_fingerprint(by_host, data_host, expected_groups)
+    with telemetry.span("registry.put", dataset=name):
+        pf = prefactorize(
+            by_host, expected_groups, sort=sort, fingerprint=fingerprint
+        )
+        data_dev: Any = None
+        sharded = False
+        if data_host is not None:
+            data_dev, sharded = _stage_data(data_host)
+    entry = DatasetEntry(
+        name, fingerprint,
+        data=data_dev, data_host=data_host, by_host=by_host, pf=pf,
+        sharded=sharded,
+    )
+    with _LOCK:
+        _DATASET_REGISTRY[name] = entry
+        evicted = _evict_to_budget(exclude=entry)
+        _publish_gauges()
+    METRICS.inc("registry.puts")
+    info = entry.info()
+    info["evicted"] = evicted
+    info["put_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+    return info
+
+
+def resolve(name: str) -> DatasetEntry:
+    """The live entry for ``name`` (LRU-renewing), or a typed
+    :class:`UnknownDatasetError`."""
+    entry = _DATASET_REGISTRY.get(name)
+    if entry is None:
+        METRICS.inc("registry.misses")
+        raise UnknownDatasetError(
+            f"unknown dataset {name!r}: not put, deleted, or evicted under "
+            "HBM pressure (registry.evictions) — put_dataset it again"
+        )
+    entry.hits += 1
+    METRICS.inc("registry.hits")
+    return entry
+
+
+def pin(entry: DatasetEntry) -> None:
+    """Refcount ``entry`` as in-flight: a pinned entry is never evicted
+    mid-dispatch (``del_dataset`` only unpublishes the name; the dispatch
+    holds direct references)."""
+    with _LOCK:
+        entry.pins += 1
+        _publish_gauges()
+
+
+def unpin(entry: DatasetEntry) -> None:
+    with _LOCK:
+        entry.pins = max(0, entry.pins - 1)
+        _publish_gauges()
+
+
+def view(
+    entry: DatasetEntry, rows: Any = None, mask: Any = None
+) -> tuple[Any, Prefactorized, str]:
+    """The (data, prefactorized, selector-key) triple a request resolves to.
+
+    ``rows`` is a ``[start, stop)`` pair, ``mask`` a boolean vector over
+    the flattened label axis; both select device-side (a slice view for
+    rows, a gather for masks) so no H2D moves. Selector views share the
+    entry's group tables and are memoized per entry (bounded), so a
+    repeated selector costs one dict hit."""
+    if rows is None and mask is None:
+        return entry.data, entry.pf, ""
+    if rows is not None and mask is not None:
+        raise ValueError("pass 'rows' or 'mask', not both")
+    pf = entry.pf
+    if rows is not None:
+        lo, hi = int(rows[0]), int(rows[1])
+        key = f"rows:{lo}:{hi}"
+    else:
+        mask = np.asarray(mask, dtype=bool).reshape(-1)
+        if mask.shape[0] != pf.n:
+            raise ValueError(
+                f"mask length {mask.shape[0]} != dataset rows {pf.n}"
+            )
+        key = "mask:" + hashlib.blake2b(
+            mask.tobytes(), digest_size=8
+        ).hexdigest()
+    cached = entry.views.get(key)
+    if cached is not None:
+        METRICS.inc("registry.view_hits")
+        return cached[0], cached[1], key
+    if rows is not None:
+        pf_view = pf.slice_rows(lo, hi)
+    else:
+        pf_view = pf.select_mask(mask)
+    data_view: Any = None
+    if entry.data is not None:
+        lead = entry.data.shape[: entry.data.ndim - len(pf.by_shape)]
+        flat = entry.data.reshape(lead + (pf.n,))
+        if rows is not None:
+            data_view = flat[..., lo:hi]
+        else:
+            import jax.numpy as jnp
+
+            idx = jnp.asarray(np.flatnonzero(mask))
+            data_view = jnp.take(flat, idx, axis=-1)
+    if len(entry.views) >= _MAX_VIEWS_PER_ENTRY:
+        entry.views.pop(next(iter(entry.views)))
+    entry.views[key] = (data_view, pf_view)
+    return data_view, pf_view, key
+
+
+def delete(name: str) -> bool:
+    """Unpublish ``name``. In-flight dispatches referencing the entry
+    finish normally (they hold direct references + a pin); only NEW
+    requests see :class:`UnknownDatasetError`. Returns whether the name
+    existed."""
+    with _LOCK:
+        entry = _DATASET_REGISTRY.pop(name, None)
+        _publish_gauges()
+    if entry is None:
+        return False
+    METRICS.inc("registry.deletes")
+    return True
+
+
+def list_datasets() -> list[dict]:
+    """Every resident entry's info dict (LRU order, stalest first)."""
+    return [entry.info() for entry in _DATASET_REGISTRY.values()]
+
+
+def debug_table(top: int | None = None) -> dict:
+    """The ``/debug/datasets`` payload: per-entry rows (hottest first) +
+    capacity summary + the per-dataset cost-ledger join."""
+    rows = sorted(list_datasets(), key=lambda r: -r["hits"])
+    if top:
+        rows = rows[:top]
+    return {
+        "datasets": rows,
+        "bytes": _total_bytes(),
+        "budget_bytes": budget_bytes(),
+        "evictions": _EVICTIONS[0],
+        "cost_by_dataset": telemetry.cost_by_dataset(),
+    }
+
+
+def registry_stats() -> dict:
+    """The registry's ``cache.stats()`` panel.
+
+    Reports the budget SNAPSHOT, not a live device poll — ``cache.stats()``
+    must stay backend-untouched on an idle plane (use ``/debug/datasets``
+    for the live figure)."""
+    entries = _DATASET_REGISTRY.values()
+    return {
+        "datasets": len(entries),
+        "bytes": sum(e.nbytes for e in entries),
+        "pinned": sum(1 for e in entries if e.pins > 0),
+        "pinned_bytes": sum(e.nbytes for e in entries if e.pins > 0),
+        "budget_bytes": _BUDGET_SNAPSHOT[0],
+        "evictions": _EVICTIONS[0],
+    }
+
+
+def restage_all() -> int:
+    """Re-pin every registered dataset from its host-side spill copies —
+    the device-loss recovery hook, run after backend reinit and AOT warmup
+    but BEFORE ``/readyz`` flips back, so a recovered replica answers its
+    registry-referenced traffic immediately. Returns entries restaged."""
+    restaged = 0
+    with _LOCK:
+        for entry in _DATASET_REGISTRY.values():
+            entry.pf.stage()
+            if entry.data_host is not None:
+                entry.data, entry.sharded = _stage_data(entry.data_host)
+            # selector views hold dead-device buffers: rebuild on demand
+            entry.views.clear()
+            entry.nbytes = int(
+                (getattr(entry.data, "nbytes", 0) or 0)
+                + entry.pf.device_nbytes()
+            )
+            restaged += 1
+        _publish_gauges()
+    if restaged:
+        METRICS.inc("registry.restaged", restaged)
+        telemetry.event("registry-restaged", datasets=restaged)
+    return restaged
+
+
+def clear() -> None:
+    """Drop every resident dataset (``cache.clear_all`` calls this; the
+    body references ``_DATASET_REGISTRY`` directly for floxlint FLX008).
+    In-flight dispatches keep their direct references — a clear only
+    unpublishes names."""
+    _DATASET_REGISTRY.clear()
+    _EVICTIONS[0] = 0
+    _BUDGET_SNAPSHOT[0] = 0
+    METRICS.set_gauge("registry.datasets", 0.0)
+    METRICS.set_gauge("registry.bytes", 0.0)
+    METRICS.set_gauge("registry.pinned_bytes", 0.0)

@@ -96,6 +96,7 @@ __all__ = [
     "anchor_event",
     "annotated",
     "card_compile_accounting",
+    "cost_by_dataset",
     "cost_by_program",
     "cost_by_tenant",
     "count",
@@ -1027,13 +1028,15 @@ def observe_cost(
     program: str | None = None,
     *,
     tenant: str | None = None,
+    dataset: str | None = None,
     dispatches: int = 1,
     device_ms: float = 0.0,
     nbytes: int | float = 0,
     compiles: int = 0,
     compile_ms: float = 0.0,
 ) -> None:
-    """Attribute one dispatch's cost to its program key (and tenant).
+    """Attribute one dispatch's cost to its program key (and tenant, and
+    — for registry-referenced serve dispatches — resident dataset).
 
     Called from the same sites that sample HBM — the eager kernel bundle,
     the mesh program dispatch, the streaming pass end, the serve execute,
@@ -1050,7 +1053,9 @@ def observe_cost(
     trace_id = _TRACE.get()
     program_entry: dict | None = None
     with _RECORDS_LOCK:
-        for axis, label in (("program", program), ("tenant", tenant)):
+        for axis, label in (
+            ("program", program), ("tenant", tenant), ("dataset", dataset),
+        ):
             if label is None:
                 continue
             entry = _cost_entry(axis, str(label))
@@ -1098,6 +1103,13 @@ def cost_by_tenant() -> dict[str, dict]:
     """The per-tenant cost ledger (a locked copy; populated only by serve
     requests that carry a ``tenant`` tag)."""
     return _ledger_axis("tenant")
+
+
+def cost_by_dataset() -> dict[str, dict]:
+    """The per-resident-dataset cost ledger (a locked copy; populated only
+    by serve dispatches that referenced a registry entry) — the operator's
+    answer to "which pinned dataset is earning its HBM"."""
+    return _ledger_axis("dataset")
 
 
 #: distinct tenant labels admitted so far — the cardinality bound for the

@@ -1,0 +1,505 @@
+"""Resident dataset registry tests (flox_tpu/serve/registry.py).
+
+The contracts under test:
+
+* **bit-identity** — a registry-referenced request returns arrays
+  bit-identical to the same data submitted inline, across eager + mesh
+  execution, dense + sort engines, row-range and boolean-mask selectors,
+  and fused multi-statistic sets;
+* **fast path** — a registry hit skips factorize (no ``factorize`` span)
+  and H2D staging (``bytes.h2d`` delta == 0), and never rehashes the
+  payload (the entry's put-time fingerprint IS the coalescing identity);
+* **HBM budget / LRU** — past ``registry_budget_bytes`` the stalest
+  unpinned entry is evicted (counted on ``registry.evictions``); a pinned
+  (in-flight) entry is never evicted mid-dispatch;
+* **fault domain** — an unknown ``dataset=`` answers a typed
+  :class:`UnknownDatasetError` (code ``unknown_dataset``, not
+  ``execution``); ``del_dataset`` with an in-flight request is safe
+  (refcount pin keeps the buffers alive until the dispatch settles);
+  device-loss recovery re-pins every registered dataset from its host
+  spill copy (``restage_all``);
+* **protocol** — ``put_dataset`` / ``del_dataset`` / ``list_datasets``
+  round-trip over the ``python -m flox_tpu.serve`` JSON-lines loop;
+* **state registration** — the registry empties under
+  ``cache.clear_all()`` and surfaces in ``cache.stats()["registry"]``
+  (floxlint FLX008 covers the static half).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import flox_tpu
+from flox_tpu import cache, telemetry
+from flox_tpu.core import groupby_reduce
+from flox_tpu.factorize import Prefactorized, prefactorize
+from flox_tpu.fusion import groupby_aggregate_many
+from flox_tpu.parallel import make_mesh
+from flox_tpu.serve import AggregationRequest, Dispatcher, UnknownDatasetError, aot
+from flox_tpu.serve import registry
+from flox_tpu.telemetry import METRICS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Serving state, counters, and the dataset registry reset per test;
+    AOT persistence off (the AOT test opts in); autotune pinned off so a
+    mid-test decision flip cannot break bit-identity assertions."""
+    with flox_tpu.set_options(serve_aot_dir=None, autotune=False):
+        cache.clear_all()
+        yield
+        cache.clear_all()
+        aot.deconfigure()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _payload(n=256, ngroups=7, seed=0, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    values = rng.normal(size=n).astype(dtype)
+    labels = rng.integers(0, ngroups, size=n)
+    return values, labels
+
+
+async def _one(d: Dispatcher, **kw):
+    res = await d.submit(AggregationRequest(**kw))
+    return res
+
+
+def _submit(**kw):
+    async def main():
+        d = Dispatcher()
+        try:
+            return await _one(d, **kw)
+        finally:
+            await d.close()
+
+    return run(main())
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("engine", [None, "sort"])
+    @pytest.mark.parametrize("func", ["sum", "nanmean", "max"])
+    def test_registry_matches_inline(self, func, engine):
+        values, labels = _payload()
+        registry.put("ds", array=values, by=labels)
+        inline = _submit(func=func, array=values, by=labels, engine=engine)
+        hit = _submit(func=func, dataset="ds", engine=engine)
+        np.testing.assert_array_equal(
+            np.asarray(hit.result), np.asarray(inline.result)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(hit.groups), np.asarray(inline.groups)
+        )
+
+    def test_row_range_selector(self):
+        """A selector view keeps the put-time group universe (no
+        re-factorize — that IS the fast path, and a stable ngroups keeps
+        the compiled program shared across selectors), so the inline
+        equivalence pins ``expected_groups`` to it."""
+        values, labels = _payload(n=512)
+        registry.put("ds", array=values, by=labels)
+        universe = np.unique(labels)
+        hit = _submit(func="sum", dataset="ds", rows=[64, 400])
+        expect, egroups = groupby_reduce(
+            values[64:400], labels[64:400], func="sum", expected_groups=universe
+        )
+        np.testing.assert_array_equal(np.asarray(hit.result), np.asarray(expect))
+        np.testing.assert_array_equal(np.asarray(hit.groups), np.asarray(egroups))
+
+    def test_boolean_mask_selector(self):
+        values, labels = _payload(n=512)
+        registry.put("ds", array=values, by=labels)
+        mask = (np.arange(512) % 3) == 0
+        hit = _submit(func="nanmean", dataset="ds", mask=mask.tolist())
+        expect, _ = groupby_reduce(
+            values[mask], labels[mask], func="nanmean",
+            expected_groups=np.unique(labels),
+        )
+        np.testing.assert_array_equal(np.asarray(hit.result), np.asarray(expect))
+
+    def test_fused_multi_stat(self):
+        values, labels = _payload()
+        registry.put("ds", array=values, by=labels)
+        funcs = ("sum", "mean", "max")
+        hit = _submit(func=list(funcs), dataset="ds")
+        expect, _ = groupby_aggregate_many(values, labels, funcs=funcs)
+        for f in funcs:
+            np.testing.assert_array_equal(
+                np.asarray(hit.result[f]), np.asarray(expect[f])
+            )
+
+    def test_mesh_prefactorized_matches_raw(self):
+        """The mesh leg of the matrix: prefactorized labels (the registry's
+        factorize-once artifact) through the SPMD map-reduce path equal the
+        raw-label call bit-for-bit."""
+        values, labels = _payload(n=264)
+        mesh = make_mesh()
+        raw, _ = groupby_reduce(
+            values, labels, func="sum", method="map-reduce", mesh=mesh
+        )
+        pf = prefactorize(labels)
+        assert isinstance(pf, Prefactorized)
+        via_pf, _ = groupby_reduce(
+            values, pf, func="sum", method="map-reduce", mesh=mesh
+        )
+        np.testing.assert_array_equal(np.asarray(via_pf), np.asarray(raw))
+
+    def test_labels_resident_inline_array(self):
+        """A labels-only entry (no data array) still serves: the request
+        inlines its own array over the resident precomputed codes."""
+        values, labels = _payload()
+        registry.put("labels-only", by=labels)
+        hit = _submit(func="mean", dataset="labels-only", array=values)
+        expect, _ = groupby_reduce(values, labels, func="mean")
+        np.testing.assert_array_equal(np.asarray(hit.result), np.asarray(expect))
+
+    def test_data_required_when_entry_has_none(self):
+        _, labels = _payload()
+        registry.put("labels-only", by=labels)
+        with pytest.raises(ValueError, match="holds no data array"):
+            _submit(func="mean", dataset="labels-only")
+
+
+class TestFastPath:
+    def test_hit_skips_factorize_and_h2d(self):
+        values, labels = _payload(n=1024)
+        with flox_tpu.set_options(telemetry=True):
+            registry.put("ds", array=values, by=labels)
+
+            async def main():
+                d = Dispatcher()
+                try:
+                    await _one(d, func="sum", dataset="ds")  # compile + warm
+                    telemetry.drain()
+                    h2d0 = METRICS.get("bytes.h2d")
+                    hits0 = METRICS.get("registry.hits")
+                    await _one(d, func="sum", dataset="ds")
+                    return (
+                        [r["name"] for r in telemetry.drain() if r.get("type") == "span"],
+                        METRICS.get("bytes.h2d") - h2d0,
+                        METRICS.get("registry.hits") - hits0,
+                    )
+                finally:
+                    await d.close()
+
+            span_names, h2d_delta, hits_delta = run(main())
+        assert "factorize" not in span_names
+        assert h2d_delta == 0
+        assert hits_delta == 1
+
+    def test_hit_path_never_hashes_payload(self, monkeypatch):
+        """A full-resident hit reuses the entry's stored fingerprint as
+        both program-key and coalescing identity — zero digest calls."""
+        from flox_tpu.serve import dispatcher as dmod
+
+        values, labels = _payload()
+        registry.put("ds", array=values, by=labels)
+        calls = []
+        real = dmod._digest_payload
+
+        async def counting(arr):
+            calls.append(arr.nbytes)
+            return await real(arr)
+
+        monkeypatch.setattr(dmod, "_digest_payload", counting)
+        res = _submit(func="sum", dataset="ds")
+        assert calls == []
+        expect, _ = groupby_reduce(values, labels, func="sum")
+        np.testing.assert_array_equal(np.asarray(res.result), np.asarray(expect))
+
+    def test_inline_digest_memoized_per_request_object(self, monkeypatch):
+        """A resubmitted request object (library retry loops) never rehashes
+        an unchanged payload."""
+        from flox_tpu.serve import dispatcher as dmod
+
+        values, labels = _payload()
+        req = AggregationRequest(func="sum", array=values, by=labels)
+
+        async def main():
+            d = Dispatcher()
+            try:
+                await d.submit(req)
+                assert getattr(req, "_payload_digests", None) is not None
+
+                async def boom(arr):  # pragma: no cover - must not run
+                    raise AssertionError("payload rehashed on resubmit")
+
+                monkeypatch.setattr(dmod, "_digest_payload", boom)
+                return await d.submit(req)
+            finally:
+                await d.close()
+
+        res = run(main())
+        expect, _ = groupby_reduce(values, labels, func="sum")
+        np.testing.assert_array_equal(np.asarray(res.result), np.asarray(expect))
+
+    def test_registry_hits_coalesce(self):
+        """K concurrent identical dataset references share ONE dispatch —
+        the PR 7 coalescing contract holds on the fingerprint-keyed path."""
+        values, labels = _payload()
+        registry.put("ds", array=values, by=labels)
+        K = 6
+
+        async def main():
+            d = Dispatcher()
+            await _one(d, func="sum", dataset="ds")  # compile outside count
+            before = METRICS.get("serve.dispatches")
+            results = await asyncio.gather(
+                *[_one(d, func="sum", dataset="ds") for _ in range(K)]
+            )
+            await d.close()
+            return results, METRICS.get("serve.dispatches") - before
+
+        results, dispatches = run(main())
+        assert dispatches == 1
+        first = np.asarray(results[0].result)
+        for r in results[1:]:
+            np.testing.assert_array_equal(np.asarray(r.result), first)
+        # every coalesced waiter released its pin; the batch released its own
+        assert registry.resolve("ds").pins == 0
+
+    def test_aot_manifest_records_dataset_and_warms(self, tmp_path):
+        """A registry dispatch lands in the AOT manifest (stamped with the
+        dataset name, outside the spec digest) and warmup replays it —
+        program identity is shapes/dtypes/ngroups, never residency."""
+        values, labels = _payload()
+        with flox_tpu.set_options(serve_aot_dir=str(tmp_path)):
+            registry.put("ds", array=values, by=labels)
+            _submit(func="sum", dataset="ds")
+            mpath = aot.save_manifest()
+            specs = json.loads(mpath.read_text())["programs"].values()
+            assert any(s.get("dataset") == "ds" for s in specs)
+            assert aot.warmup() >= 1
+
+
+class TestBudgetAndEviction:
+    def test_lru_evicts_stalest_past_budget(self):
+        values, labels = _payload(n=4096, dtype=np.float32)
+        one_entry = registry.put("a", array=values, by=labels)["nbytes"]
+        with flox_tpu.set_options(registry_budget_bytes=int(one_entry * 1.5)):
+            ev0 = METRICS.get("registry.evictions")
+            info = registry.put("b", array=values + 1, by=labels)
+            assert info["evicted"] == ["a"]
+            assert METRICS.get("registry.evictions") - ev0 == 1
+            with pytest.raises(UnknownDatasetError):
+                registry.resolve("a")
+            assert registry.resolve("b").name == "b"
+
+    def test_pinned_entry_survives_eviction(self):
+        values, labels = _payload(n=4096, dtype=np.float32)
+        one_entry = registry.put("a", array=values, by=labels)["nbytes"]
+        entry_a = registry.resolve("a")
+        registry.pin(entry_a)
+        try:
+            with flox_tpu.set_options(registry_budget_bytes=int(one_entry * 1.5)):
+                info = registry.put("b", array=values + 1, by=labels)
+                # the only evictable candidate is pinned: nothing evicted,
+                # total stays over budget rather than killing in-flight work
+                assert info["evicted"] == []
+            assert registry.resolve("a").name == "a"
+        finally:
+            registry.unpin(entry_a)
+        # unpinned, the next over-budget put takes it ("b" was just renewed)
+        with flox_tpu.set_options(registry_budget_bytes=int(one_entry * 1.5)):
+            registry.resolve("b")  # renew b so a is stalest
+            info = registry.put("c", array=values + 2, by=labels)
+            assert "a" in info["evicted"]
+
+    def test_budget_zero_is_unenforced(self):
+        values, labels = _payload()
+        with flox_tpu.set_options(registry_budget_bytes=0):
+            registry.put("a", array=values, by=labels)
+            info = registry.put("b", array=values + 1, by=labels)
+        assert info["evicted"] == []
+        assert len(registry.list_datasets()) == 2
+
+    def test_registry_knob_validation(self):
+        with pytest.raises(ValueError):
+            flox_tpu.set_options(registry_budget_fraction=0.0)
+        with pytest.raises(ValueError):
+            flox_tpu.set_options(registry_budget_bytes=-1)
+        with pytest.raises(ValueError):
+            flox_tpu.set_options(registry_shard_threshold_bytes=-5)
+
+
+class TestFaultDomain:
+    def test_unknown_dataset_typed_error(self):
+        misses0 = METRICS.get("registry.misses")
+        with pytest.raises(UnknownDatasetError) as exc:
+            _submit(func="sum", dataset="never-put")
+        assert exc.value.code == "unknown_dataset"
+        assert METRICS.get("registry.misses") - misses0 == 1
+
+    def test_delete_with_inflight_request_is_safe(self):
+        """del_dataset between submit and completion: the batch's refcount
+        pin keeps the entry's buffers alive, the in-flight request answers
+        correctly, and later references get the typed error."""
+        values, labels = _payload(n=2048)
+        registry.put("ds", array=values, by=labels)
+        expect, _ = groupby_reduce(values, labels, func="sum")
+
+        async def main():
+            d = Dispatcher()
+            try:
+                task = asyncio.ensure_future(_one(d, func="sum", dataset="ds"))
+                # let the submit resolve + pin + enqueue, then yank the entry
+                for _ in range(3):
+                    await asyncio.sleep(0)
+                assert registry.delete("ds") is True
+                res = await task
+                return res
+            finally:
+                await d.close()
+
+        res = run(main())
+        np.testing.assert_array_equal(np.asarray(res.result), np.asarray(expect))
+        with pytest.raises(UnknownDatasetError):
+            _submit(func="sum", dataset="ds")
+
+    def test_selector_validation(self):
+        values, labels = _payload()
+        registry.put("ds", array=values, by=labels)
+        with pytest.raises(ValueError, match="not both"):
+            _submit(func="sum", dataset="ds", rows=[0, 8],
+                    mask=[True] * len(values))
+        with pytest.raises(ValueError, match="require a 'dataset'"):
+            _submit(func="sum", array=values, by=labels, rows=[0, 8])
+        with pytest.raises(ValueError, match="fixed at put time"):
+            _submit(func="sum", dataset="ds", by=labels)
+
+    def test_restage_all_after_device_loss(self):
+        """Device-loss recovery re-pins registered datasets from host spill
+        copies: after a backend teardown, restage_all() rebuilds device
+        residency and results stay bit-identical."""
+        from flox_tpu import device
+
+        values, labels = _payload()
+        registry.put("ds", array=values, by=labels)
+        before = _submit(func="sum", dataset="ds")
+        device.reinitialize()
+        assert registry.restage_all() == 1
+        after = _submit(func="sum", dataset="ds")
+        np.testing.assert_array_equal(
+            np.asarray(after.result), np.asarray(before.result)
+        )
+
+
+class TestStateRegistration:
+    def test_stats_and_clear_all(self):
+        values, labels = _payload()
+        registry.put("ds", array=values, by=labels)
+        # the cost ledger (like every observe_cost site) records only while
+        # telemetry is on
+        with flox_tpu.set_options(telemetry=True):
+            _submit(func="sum", dataset="ds")
+        st = cache.stats()
+        assert st["registry"]["datasets"] == 1
+        assert st["registry"]["bytes"] > 0
+        # per-dataset cost attribution rides the same ledger as per-program
+        assert "ds" in st["cost_by_dataset"]
+        assert st["cost_by_dataset"]["ds"]["dispatches"] >= 1
+        cache.clear_all()
+        assert registry.list_datasets() == []
+        assert cache.stats()["registry"]["datasets"] == 0
+        assert METRICS.get("registry.datasets") == 0
+
+    def test_debug_table_shape(self):
+        values, labels = _payload()
+        registry.put("ds", array=values, by=labels)
+        table = registry.debug_table()
+        assert table["bytes"] > 0
+        assert table["datasets"][0]["name"] == "ds"
+        assert table["datasets"][0]["nbytes"] > 0
+        assert "budget_bytes" in table and "evictions" in table
+
+    def test_put_validation(self):
+        with pytest.raises(ValueError, match="requires 'by'"):
+            registry.put("ds", array=np.ones(8))
+        with pytest.raises(ValueError, match="do not align"):
+            registry.put("ds", array=np.ones(8), by=np.zeros(9, dtype=np.int64))
+        with pytest.raises(ValueError):
+            registry.put("", by=np.zeros(8, dtype=np.int64))
+
+
+class TestProtocol:
+    def test_put_del_list_roundtrip_cli(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", FLOX_TPU_TELEMETRY="1")
+        env.pop("FLOX_TPU_TELEMETRY_EXPORT_PATH", None)
+        values = [1.0, 2.0, 4.0, 8.0, 16.0, 32.0]
+        labels = [0, 0, 1, 1, 2, 2]
+        lines = "\n".join([
+            json.dumps({"op": "put_dataset", "name": "t",
+                        "array": values, "by": labels}),
+            json.dumps({"op": "list_datasets"}),
+            json.dumps({"id": "hit", "func": "sum", "dataset": "t"}),
+            json.dumps({"id": "rows", "func": "sum", "dataset": "t",
+                        "rows": [0, 4]}),
+            json.dumps({"id": "inline", "func": "sum",
+                        "array": values, "by": labels}),
+            json.dumps({"id": "missing", "func": "sum", "dataset": "nope"}),
+            json.dumps({"id": "bad", "func": "sum", "dataset": "t",
+                        "by": labels}),
+            json.dumps({"op": "del_dataset", "name": "t"}),
+            json.dumps({"id": "gone", "func": "sum", "dataset": "t"}),
+            json.dumps({"op": "drain"}),
+        ])
+        proc = subprocess.run(
+            [sys.executable, "-m", "flox_tpu.serve"],
+            input=lines, cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=240,
+        )
+        assert proc.returncode == 0, proc.stderr
+        recs = {}
+        for raw in proc.stdout.splitlines():
+            rec = json.loads(raw)
+            recs[rec.get("id") or rec.get("op")] = rec
+        put = recs["put_dataset"]
+        assert put["ok"] and put["name"] == "t" and put["nbytes"] > 0
+        assert put["ngroups"] == 3
+        listed = recs["list_datasets"]
+        assert listed["ok"] and listed["datasets"][0]["name"] == "t"
+        assert listed["stats"]["datasets"] == 1
+        assert recs["hit"]["ok"] and recs["inline"]["ok"]
+        assert recs["hit"]["result"] == recs["inline"]["result"]
+        # the selector keeps the put-time group universe: group 2 is absent
+        # from rows [0, 4) and lands on the sum identity
+        assert recs["rows"]["ok"] and recs["rows"]["result"] == [3.0, 12.0, 0.0]
+        assert recs["missing"]["ok"] is False
+        assert recs["missing"]["code"] == "unknown_dataset"
+        # inlining 'by' alongside a dataset reference is a protocol error
+        assert recs["bad"]["ok"] is False and recs["bad"]["code"] == "protocol"
+        assert recs["del_dataset"]["ok"] and recs["del_dataset"]["deleted"]
+        assert recs["gone"]["code"] == "unknown_dataset"
+
+    def test_put_dataset_error_is_answered_not_fatal(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        lines = "\n".join([
+            json.dumps({"op": "put_dataset", "name": "t", "array": [1.0]}),
+            json.dumps({"id": "r", "func": "sum",
+                        "array": [1.0, 2.0], "by": [0, 1]}),
+            json.dumps({"op": "drain"}),
+        ])
+        proc = subprocess.run(
+            [sys.executable, "-m", "flox_tpu.serve"],
+            input=lines, cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=240,
+        )
+        assert proc.returncode == 0, proc.stderr
+        recs = [json.loads(l) for l in proc.stdout.splitlines()]
+        put = next(r for r in recs if r.get("op") == "put_dataset")
+        assert put["ok"] is False and "by" in put["message"]
+        # the loop survived the bad put: the next request still answers
+        good = next(r for r in recs if r.get("id") == "r")
+        assert good["ok"] and good["result"] == [1.0, 2.0]
