@@ -121,6 +121,7 @@ def stats() -> dict:
     from .serve.breaker import breaker_stats
     from .serve.dispatcher import _BATCH_REGISTRY, _COALESCE_CACHE, _PENDING_REGISTRY
     from .serve.registry import registry_stats
+    from .serve.stores import stores_stats
     from .streaming import _STEP_CACHE
     from .telemetry import (
         FLIGHT_RECORDER,
@@ -180,6 +181,9 @@ def stats() -> dict:
         # resident dataset registry: entry/byte/pin counts, the HBM budget
         # in force, and deliberate budget evictions (the runbook alarm)
         "registry": registry_stats(),
+        # durable aggregation stores: open-store count, per-store
+        # generations, host-carry bytes, device-cache occupancy
+        "stores": stores_stats(),
         # per-program circuit breakers: entry counts per state plus the
         # open/half-open detail (which program labels are being fast-failed
         # and how long their cooldowns have left)
@@ -258,6 +262,12 @@ def clear_all() -> None:
     from .serve import registry as serve_registry
 
     serve_registry.clear()
+    # durable store table: stores.clear() drops _STORE_TABLE and resets its
+    # gauges; on-disk WAL/segment state is durable and untouched — a later
+    # reference reopens (= recovers) it
+    from .serve import stores as serve_stores
+
+    serve_stores.clear()
     # circuit-breaker state resets with the program caches it shadows: a
     # cleared process has no failure history, so no breaker stays open
     _BREAKER_REGISTRY.clear()

@@ -604,6 +604,21 @@ def analytic_prior(
             peaks["bw_gbps"] * 1e9
         ) * 1e3
         choice = "sort" if sort_ms < dense_ms else "dense"
+    elif family == "store_query" and {"store", "recompute"} <= cands:
+        # serving a finalized read from the durable store scatters the
+        # present-groups carry (bounded by the label universe) and
+        # finalizes; recomputing re-reduces the FULL history bytes. Both
+        # are bandwidth passes; the store wins as soon as history
+        # meaningfully exceeds the carry — nelems here is the total
+        # history element count, ngroups the store's label universe.
+        n_acc = 3
+        store_ms = (n_acc * max(1, ngroups) * itemsize) / (
+            peaks["bw_gbps"] * 1e9
+        ) * 1e3
+        recompute_ms = (data_bytes + n_acc * max(1, ngroups) * itemsize) / (
+            peaks["bw_gbps"] * 1e9
+        ) * 1e3
+        choice = "store" if store_ms < recompute_ms else "recompute"
     elif family == "segment_sum" and "matmul" in cands and "scatter" in cands:
         # one-hot GEMM: 2·N·G flops at peak compute vs scatter's serialized
         # updates, modeled as a deeply de-rated bandwidth pass (scatters

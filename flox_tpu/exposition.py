@@ -279,6 +279,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/debug/datasets":
             body, status = self._datasets(query)
             ctype = "application/json; charset=utf-8"
+        elif path == "/debug/stores":
+            body, status = self._stores(query)
+            ctype = "application/json; charset=utf-8"
         elif path == "/debug/profile":
             body, status = self._profile(query)
             ctype = "application/json; charset=utf-8"
@@ -369,6 +372,28 @@ class _Handler(BaseHTTPRequestHandler):
         if error is not None:
             return error
         payload = registry.debug_table(top=top)
+        payload["replica"] = telemetry.replica_instance()
+        payload["host"] = telemetry.host_name()
+        return (json.dumps(payload, default=str) + "\n").encode(), 200
+
+    @staticmethod
+    def _stores(query: str = "") -> tuple[bytes, int]:
+        """The durable aggregation stores as JSON: every open store's
+        generation, ingested-slab count, present-group count, segment count
+        and state bytes, plus the per-store cost-ledger join — the
+        operator's answer to "what incremental state does this replica
+        carry and how far has it advanced".
+
+        ``?top=K`` keeps the K highest-generation stores (malformed = 400,
+        same contract as the other ``/debug/*`` endpoints)."""
+        from . import telemetry
+        from .serve import stores
+
+        params = urllib.parse.parse_qs(query)
+        top, error = _parse_top(params)
+        if error is not None:
+            return error
+        payload = stores.debug_table(top=top)
         payload["replica"] = telemetry.replica_instance()
         payload["host"] = telemetry.host_name()
         return (json.dumps(payload, default=str) + "\n").encode(), 200

@@ -67,6 +67,10 @@ __all__ = [
     "serve_inject",
     "serve_poke",
     "serve_active",
+    "StoreWriteKilled",
+    "store_inject",
+    "store_poke",
+    "store_active",
     "misshaping_loader",
     "stress_schedule",
     "LockOrderViolation",
@@ -420,6 +424,115 @@ def serve_inject(
         yield plan
     finally:
         _SERVE_PLAN = prev
+
+
+# ---------------------------------------------------------------------------
+# store-level injection: the chaos substrate for the durable store's
+# kill-at-every-fault-point recovery matrix (flox_tpu/store.py)
+
+
+class StoreWriteKilled(RuntimeError):
+    """Simulated ``kill -9`` landing inside a durable store write: the
+    process "dies" mid-append/mid-compaction, leaving whatever bytes the
+    injected action put on disk. Never caught by the store itself — the
+    test reopens the directory and asserts recovery."""
+
+    def __init__(self, where: str = "") -> None:
+        super().__init__(f"store write killed (simulated crash) {where}".rstrip())
+
+
+@dataclass
+class _StorePlan:
+    """One installed store-fault plan, with an injection log for asserting
+    determinism. Consulted by the store's durable-write funnel via
+    :func:`store_poke` once per durable event (a journal fsync, a segment
+    landing, a compaction-swap delete), in write order."""
+
+    #: 1-based durable-write ordinals that die BEFORE any bytes land
+    kill_at: frozenset = frozenset()
+    #: ordinals whose write lands HALF its bytes at the final path, then dies
+    torn_at: frozenset = frozenset()
+    #: ordinals whose write lands fully but with one bit flipped (silent —
+    #: the on-disk rot a checksum verify must catch at the next open)
+    flip_at: frozenset = frozenset()
+    #: restrict counting to one event kind ("journal"|"segment"|"swap");
+    #: None counts every durable event
+    op: str | None = None
+    writes: int = 0
+    #: (action | None, kind, basename, ordinal) per counted event, in order
+    log: list = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+
+_STORE_PLAN: _StorePlan | None = None
+
+
+def store_active() -> bool:
+    return _STORE_PLAN is not None
+
+
+def store_poke(kind: str, path: str) -> str | None:
+    """Store durable-write injection hook: the store's write funnel calls
+    this immediately before each durable event and acts on the answer —
+    ``None`` (write normally), ``"kill"`` (raise before any bytes),
+    ``"torn"`` (land half the bytes at the final path, then raise), or
+    ``"flip"`` (land all bytes with one bit flipped, silently). The raise
+    itself is the funnel's job so the torn/flip byte mangling happens at
+    the real write site; :class:`StoreWriteKilled` is what it raises."""
+    import os
+
+    plan = _STORE_PLAN
+    if plan is None:
+        return None
+    with plan._lock:
+        if plan.op is not None and plan.op != kind:
+            return None
+        plan.writes += 1
+        n = plan.writes
+        action = None
+        if n in plan.kill_at:
+            action = "kill"
+        elif n in plan.torn_at:
+            action = "torn"
+        elif n in plan.flip_at:
+            action = "flip"
+        plan.log.append((action, kind, os.path.basename(str(path)), n))
+        return action
+
+
+@contextlib.contextmanager
+def store_inject(
+    *,
+    kill_at: tuple[int, ...] | list[int] = (),
+    torn_at: tuple[int, ...] | list[int] = (),
+    flip_at: tuple[int, ...] | list[int] = (),
+    op: str | None = None,
+) -> Iterator[_StorePlan]:
+    """Install a deterministic store-fault plan for the scope.
+
+    Ordinals are 1-based positions in the store's durable-write sequence
+    (journal appends, segment landings, compaction-swap deletes — the
+    exact fault points the recovery matrix must kill at), counted across
+    the scope; ``op`` narrows the counting to one event kind. ``kill_at``
+    dies before any bytes land; ``torn_at`` lands a half-written file at
+    the FINAL path (the rename-happened-but-bytes-did-not-flush crash);
+    ``flip_at`` lands a silent single-bit flip (detected only by the
+    checksum verify at the next open). Yields the plan; its ``log``
+    records every counted event for determinism assertions.
+    """
+    global _STORE_PLAN
+    plan = _StorePlan(
+        kill_at=frozenset(int(n) for n in kill_at),
+        torn_at=frozenset(int(n) for n in torn_at),
+        flip_at=frozenset(int(n) for n in flip_at),
+        op=op,
+    )
+    prev = _STORE_PLAN
+    _STORE_PLAN = plan
+    try:
+        yield plan
+    finally:
+        _STORE_PLAN = prev
 
 
 def misshaping_loader(

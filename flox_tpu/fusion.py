@@ -61,6 +61,14 @@ def fused_program_label(funcs) -> str:
     return "fused[" + "+".join(funcs) + "]"
 
 
+def store_program_label(kind: str, funcs) -> str:
+    """The cost-ledger program label of a durable-store operation
+    (``store.append[fused[sum+count]]``): the op kind wrapping the fused
+    statistic set the store carries, so per-store ledger rows join the
+    same program axis as inline fused dispatches."""
+    return f"store.{kind}[{fused_program_label(funcs)}]"
+
+
 def _fused_key(fused: FusedAggregation, size: int) -> tuple:
     from .options import trace_fingerprint
     from .parallel.mapreduce import _agg_cache_key

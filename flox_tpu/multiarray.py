@@ -203,3 +203,43 @@ class PresentGroups:
         else:
             raise ValueError(f"unsupported combine op {combine!r}")
         return PresentGroups(union, out, self.size)
+
+
+def merge_present_var(a, b):
+    """Chan-merge two var-triple layers on the union of their present sets.
+
+    ``a`` and ``b`` are ``(m2, total, count)`` triples of
+    :class:`PresentGroups` — each side's three leaves share ONE present
+    table (they came out of one ``var_chunk``). Groups absent from a side
+    contribute the empty triple ``(0, 0, 0)``, which is exactly the Chan
+    identity (``streaming._pair_merge``'s var branch with ``na == 0``
+    reduces to the other side), so the union merge is the numpy restatement
+    of the mesh/streaming var combine on a sparse domain — the var-family
+    counterpart of :meth:`PresentGroups.merge`, built for stores whose
+    present sets grow between ingests.
+    """
+    m2a, ta, na = a
+    m2b, tb, nb = b
+    if ta.size != tb.size:
+        raise ValueError(f"universe mismatch: {ta.size} != {tb.size}")
+    union = np.union1d(ta.present, tb.present)
+    n_u = len(union)
+    cap = n_u + 1 if n_u < ta.size else n_u
+    ft = np.result_type(np.asarray(m2a.values).dtype, np.asarray(m2b.values).dtype)
+
+    def _expand(pg: PresentGroups, dtype):
+        v = np.asarray(pg.values)
+        out = np.zeros(v.shape[:-1] + (cap,), dtype=dtype)
+        out[..., np.searchsorted(union, pg.present)] = v[..., : pg.n_present]
+        return out
+
+    em2a, eta, ena = (_expand(x, ft) for x in (m2a, ta, na))
+    em2b, etb, enb = (_expand(x, ft) for x in (m2b, tb, nb))
+    nab = ena + enb
+    tab = eta + etb
+    with np.errstate(invalid="ignore", divide="ignore"):
+        mua = eta / np.where(ena > 0, ena, 1)
+        mub = etb / np.where(enb > 0, enb, 1)
+        muab = tab / np.where(nab > 0, nab, 1)
+        m2 = em2a + em2b + ena * (mua - muab) ** 2 + enb * (mub - muab) ** 2
+    return tuple(PresentGroups(union, arr, ta.size) for arr in (m2, tab, nab))

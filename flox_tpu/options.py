@@ -246,6 +246,17 @@ OPTIONS: dict[str, Any] = {
     # identity) or a literal .npz path — the cross-process resume path. None
     # keeps snapshots in the in-process registry only.
     "stream_checkpoint_path": os.environ.get("FLOX_TPU_STREAM_CHECKPOINT_PATH") or None,
+    # Durable incremental aggregation stores (flox_tpu/store.py). store_root:
+    # the directory the serve-layer store ops create/open stores under (one
+    # subdirectory per store name); None disables the serve store surface.
+    "store_root": os.environ.get("FLOX_TPU_STORE_ROOT") or None,
+    # auto-compact when a store holds more than this many live delta
+    # segments after an append; 0 keeps compaction manual (the compact op)
+    "store_compact_threshold": _env_int("FLOX_TPU_STORE_COMPACT_THRESHOLD", 0, 0),
+    # "off" skips the per-write fsyncs (file + directory) on journal and
+    # segment landings — for tests and throwaway stores only: without them
+    # a power loss can reorder the WAL protocol's commit points
+    "store_fsync": _env_choice("FLOX_TPU_STORE_FSYNC", "on", ("on", "off")),
     # Telemetry (flox_tpu/telemetry.py): master switch for the hierarchical
     # span tracer, the metrics registry, and the jax compile/retrace
     # listener. Off (the default) is a true no-op — no span objects are
@@ -469,6 +480,13 @@ _VALIDATORS = {
     "stream_checkpoint_path": lambda x: x is None or (
         isinstance(x, (str, os.PathLike)) and bool(str(x))
     ),
+    # store knobs: same at-set-time discipline — a bad root path or a
+    # negative compaction threshold raises here, not at the first append
+    "store_root": lambda x: x is None or (
+        isinstance(x, (str, os.PathLike)) and bool(str(x))
+    ),
+    "store_compact_threshold": lambda x: _is_int(x) and x >= 0,
+    "store_fsync": lambda x: x in ("on", "off"),
     # telemetry knobs are validated AT SET TIME like the stream knobs: a
     # bad level or a non-path export target raises here, not mid-trace
     "telemetry": lambda x: isinstance(x, bool),

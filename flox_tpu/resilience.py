@@ -666,31 +666,59 @@ class StreamCheckpointer:
 def _dump_snapshot(path: str, snap: Snapshot) -> None:
     import jax
 
+    from .store import write_checksummed_npz
+
     leaves, treedef = jax.tree_util.tree_flatten(snap.payload)
     arrays = {f"leaf{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
     meta = pickle.dumps((snap.key, snap.phase, snap.slabs_done, treedef))
     parent = os.path.dirname(path)
     if parent:
         os.makedirs(parent, exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, __meta__=np.frombuffer(meta, dtype=np.uint8), **arrays)
-    os.replace(tmp, path)  # atomic: a kill mid-write never corrupts a snapshot
+    # the store's checksummed segment format (per-array blake2b digests,
+    # format-versioned header, tmp+fsync+rename), so a torn or bit-flipped
+    # spill is DETECTED at restore instead of loading silently wrong state
+    write_checksummed_npz(
+        path,
+        {"__meta__": np.frombuffer(meta, dtype=np.uint8), **arrays},
+        {"kind": "stream-checkpoint"},
+        kind="checkpoint",
+    )
 
 
 def _load_snapshot(path: str, key: tuple) -> Snapshot | None:
     """Read a spilled snapshot; None when missing, corrupt, or for a
-    different stream identity. The meta block (including the jax treedef)
-    is a pickle WE wrote — the spill path is operator-controlled state, not
-    untrusted input."""
+    different stream identity — a damaged spill warns (and counts on
+    ``stream.checkpoint_corrupt``) before restarting the stream fresh. The
+    meta block (including the jax treedef) is a pickle WE wrote — the spill
+    path is operator-controlled state, not untrusted input."""
     import jax
 
+    from .store import StoreCorruptionError, read_checksummed_npz
+
     try:
-        with np.load(path, allow_pickle=False) as z:
-            skey, phase, done, treedef = pickle.loads(z["__meta__"].tobytes())
-            if skey != key:
-                return None
-            leaves = [z[f"leaf{i}"] for i in range(treedef.num_leaves)]
+        z, _ = read_checksummed_npz(path)
+    except FileNotFoundError:
+        return None
+    except StoreCorruptionError as exc:
+        # a checkpoint that fails its checksums (torn write, bit rot, or a
+        # pre-checksum legacy spill) must mean "fresh run", loudly
+        import warnings
+
+        from . import telemetry
+
+        warnings.warn(
+            f"stream checkpoint {os.path.basename(path)} is corrupt or "
+            f"unreadable; restarting the stream fresh ({exc})",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        telemetry.METRICS.inc("stream.checkpoint_corrupt")
+        return None
+    try:
+        skey, phase, done, treedef = pickle.loads(z["__meta__"].tobytes())
+        if skey != key:
+            return None
+        leaves = [z[f"leaf{i}"] for i in range(treedef.num_leaves)]
         payload = jax.tree_util.tree_unflatten(treedef, leaves)
     except Exception:
         # the contract is "a corrupt or mismatched spill is ignored, never

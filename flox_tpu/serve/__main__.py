@@ -420,6 +420,52 @@ async def _amain(args: argparse.Namespace) -> int:
                 _emit({"op": "list_datasets", "ok": True,
                        "datasets": registry.list_datasets(),
                        "stats": registry.registry_stats()})
+            elif op in ("append", "query", "compact", "list_stores"):
+                # durable incremental aggregation stores (flox_tpu/store.py):
+                # every store op touches the WAL/segments on disk, so each
+                # runs off the loop like put_dataset. Failures answer with
+                # the typed codes (unknown_store / store_corruption); an
+                # exactly-once replay is an OK answer with
+                # ack == "slab_already_ingested", never an error.
+                from . import stores
+
+                try:
+                    if op == "append":
+                        out = await asyncio.to_thread(
+                            stores.append,
+                            msg.get("store"),
+                            msg.get("codes"),
+                            msg.get("array"),
+                            slab_id=msg.get("slab_id"),
+                            create=msg.get("create"),
+                        )
+                    elif op == "query":
+                        res = await asyncio.to_thread(
+                            stores.query, msg.get("store"), msg.get("funcs")
+                        )
+                        out = {
+                            "store": msg.get("store"),
+                            "result": {k: np.asarray(v).tolist() for k, v in res.items()},
+                        }
+                    elif op == "compact":
+                        out = await asyncio.to_thread(stores.compact, msg.get("store"))
+                    else:
+                        out = {"stores": await asyncio.to_thread(stores.list_stores)}
+                except ServeError as exc:
+                    _emit({"op": op, "store": msg.get("store"),
+                           **_error_response(msg.get("id", f"line-{line_no}"), exc)})
+                # noqa: FLX006 — not a retry loop: one store op is one client
+                # request, and a bad payload must be answered, never kill
+                # the replica
+                except Exception as exc:  # noqa: FLX006,BLE001
+                    from .. import telemetry
+
+                    telemetry.record_serve_error(exc, what=f"store op {op}")
+                    _emit({"op": op, "ok": False, "store": msg.get("store"),
+                           "error": type(exc).__name__, "code": "protocol",
+                           "message": str(exc)})
+                else:
+                    _emit({"op": op, "ok": True, **out})
             elif op == "drain":
                 if pending:
                     await asyncio.gather(*pending, return_exceptions=True)

@@ -1198,6 +1198,13 @@ def _recover_device() -> None:
         from . import registry
 
         restaged = registry.restage_all()
+        # reopen every durable aggregation store from disk with the same
+        # before-readiness ordering: reopening runs crash recovery, drops
+        # dead-device result caches, and rebuilds the host carry from the
+        # checksummed segments
+        from . import stores as store_registry
+
+        stores_restaged = store_registry.restage_all()
         # flip ready back ONLY if the 503 is still ours: a graceful drain
         # that began mid-recovery set reason "draining", and that 503 must
         # hold until the process exits — a recovered-but-draining replica
@@ -1208,7 +1215,7 @@ def _recover_device() -> None:
         METRICS.inc("serve.recoveries")
         telemetry.event(
             "device-recovery-done", reinitialized=torn_down, warmed=warmed,
-            restaged=restaged,
+            restaged=restaged, stores_restaged=stores_restaged,
         )
     except Exception as exc:  # noqa: BLE001 — an unrecoverable replica stays
         # unready (503) rather than crashing the loop; the record is the

@@ -271,6 +271,56 @@ def test_unregistered_cost_ledger_fails_flx008(tmp_path):
     assert not [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
 
 
+def test_unregistered_store_table_fails_flx008(tmp_path):
+    # ISSUE 18 satellite: the durable-store table (name -> open store entry,
+    # in a serve subpackage like the real flox_tpu/serve/stores.py) accretes
+    # one entry per opened store — reintroducing it (or a successor)
+    # WITHOUT the matching cache.clear_all registration must be flagged
+    pkg = tmp_path / "minipkg"
+    (pkg / "serve").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "serve" / "__init__.py").write_text("")
+    (pkg / "serve" / "stores.py").write_text(
+        '"""Mini store registry with an unregistered table."""\n\n'
+        "_STORE_TABLE: dict = {}\n\n\n"
+        "def resolve(name, store):\n"
+        "    return _STORE_TABLE.setdefault(name, store)\n"
+    )
+    (pkg / "cache.py").write_text(
+        '"""clear_all that forgets the store table."""\n\n\n'
+        "def clear_all():\n"
+        "    pass\n"
+    )
+    findings = [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+    assert len(findings) == 1
+    assert "_STORE_TABLE" in findings[0].message
+    assert findings[0].path.endswith("stores.py")
+    # registering it makes the package clean again — same spelling the real
+    # flox_tpu.cache.clear_all uses (delegating to the module's clear())
+    (pkg / "cache.py").write_text(
+        '"""clear_all that registers the store table."""\n\n\n'
+        "def clear_all():\n"
+        "    from .serve.stores import _STORE_TABLE\n\n"
+        "    _STORE_TABLE.clear()\n"
+    )
+    assert not [f for f in lint_paths([pkg]) if f.rule == "FLX008"]
+
+
+def test_real_store_table_is_registered(tmp_path):
+    # the runtime complement: the REAL store table must empty under the
+    # real clear_all (named here so a refactor cannot lose it silently)
+    import flox_tpu.cache as flox_cache
+    import flox_tpu.store as store_mod
+    from flox_tpu.serve.stores import _STORE_TABLE, StoreEntry
+
+    s = store_mod.IncrementalAggregationStore.create(
+        str(tmp_path / "t"), funcs=("sum",), size=2
+    )
+    _STORE_TABLE["t"] = StoreEntry("t", s)
+    flox_cache.clear_all()
+    assert _STORE_TABLE == {}
+
+
 def test_real_cost_ledger_is_registered():
     # the runtime complement: the REAL ledger must be reachable from the
     # real clear_all (named here so a refactor cannot lose it silently)

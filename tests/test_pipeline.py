@@ -261,15 +261,37 @@ def test_clear_all_empties_every_named_cache():
                 ("." * node.level) + (node.module or ""), package="flox_tpu"
             )
             for alias in node.names:
-                named.append((mod, alias.asname or alias.name))
+                # alias.name is the attribute actually bound (an asname
+                # only renames it in clear_all's scope); a submodule
+                # import may not have set the parent attribute yet
+                named.append((mod, alias.name))
     assert len(named) >= 7, "clear_all no longer names the known caches?"
+
+    def _resolve(mod, name):
+        try:
+            return getattr(mod, name)
+        except AttributeError:
+            return importlib.import_module(f"{mod.__name__}.{name}")
 
     from flox_tpu.cache import LRUCache
 
+    def _module_tables(m):
+        # a subsystem module delegated to via its own clear() (resident
+        # dataset registry, durable store table): its state lives in
+        # module-level _UPPER_SNAKE dict tables (the FLX008 shape)
+        return [v for k, v in vars(m).items()
+                if isinstance(v, dict) and k.isupper()]
+
     # populate what can be populated artificially, then clear
     for mod, name in named:
-        obj = getattr(mod, name)
-        if isinstance(obj, (dict, LRUCache)):
+        obj = _resolve(mod, name)
+        if inspect.ismodule(obj):
+            assert callable(getattr(obj, "clear", None)), (
+                f"clear_all imports module {obj.__name__} without a clear()"
+            )
+            for tbl in _module_tables(obj):
+                tbl[("__clear_all_probe__", name)] = object()
+        elif isinstance(obj, (dict, LRUCache)):
             obj[("__clear_all_probe__", name)] = object()
         elif isinstance(obj, list):
             for i in range(len(obj)):
@@ -285,8 +307,14 @@ def test_clear_all_empties_every_named_cache():
 
     checked = 0
     for mod, name in named:
-        obj = getattr(mod, name)
-        if isinstance(obj, dict):
+        obj = _resolve(mod, name)
+        if inspect.ismodule(obj):
+            for tbl in _module_tables(obj):
+                assert tbl == {}, (
+                    f"a table in {obj.__name__} not emptied by clear_all"
+                )
+            checked += 1
+        elif isinstance(obj, dict):
             assert obj == {}, f"{mod.__name__}.{name} not emptied by clear_all"
             checked += 1
         elif isinstance(obj, LRUCache):  # the compiled-program LRUs (ISSUE 7)

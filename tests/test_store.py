@@ -1,0 +1,887 @@
+"""Durable incremental aggregation store (flox_tpu/store.py + serve/stores.py).
+
+The contracts under test:
+
+* **exactly-once** — a slab's fingerprint + generation are journaled
+  before state mutates; replaying an already-ingested slab acks
+  ``slab_already_ingested`` and changes nothing, including across a crash
+  and reopen;
+* **crash recovery** — a kill / torn write / bit flip at EVERY injected
+  fault point (journal write, segment write, compaction swap) followed by
+  reopen + re-append yields query results bit-identical to an
+  uninterrupted run (``faults.store_inject`` drives the matrix);
+* **corruption fault domain** — an unverifiable TAIL append rolls back
+  (warn + quarantine + ``recovered``); unrecoverable MID-HISTORY damage
+  raises :class:`StoreCorruptionError` naming the segment, after
+  quarantining it as ``*.corrupt``;
+* **compaction** — the merged segment lands and the journal flips before
+  any replaced segment deletes; a kill anywhere leaves either the old
+  stack or the new base fully live;
+* **inline equivalence** — ``query`` matches ``groupby_aggregate_many``
+  over the concatenated history across eager/mesh × dense/sort engines
+  (exact for the additive/extrema family on integer-valued data, tight
+  allclose for the variance family, whose pairwise merge order differs);
+* **checkpoint hardening** — ``StreamCheckpointer`` spills ride the same
+  checksummed format; a truncated or bit-flipped spill warns and restarts
+  fresh instead of loading silently wrong state;
+* **serve surface** — typed protocol errors (``unknown_store``,
+  ``store_corruption``), ``restage_all`` device-loss recovery,
+  ``cache.clear_all`` / ``cache.stats`` registration, ``/debug/stores``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import flox_tpu
+from flox_tpu import cache, faults, telemetry
+from flox_tpu import store as store_mod
+from flox_tpu.fusion import groupby_aggregate_many
+from flox_tpu.multiarray import PresentGroups, merge_present_var
+from flox_tpu.store import (
+    IncrementalAggregationStore,
+    StoreCorruptionError,
+    open_store,
+    read_checksummed_npz,
+    write_checksummed_npz,
+)
+from flox_tpu.telemetry import METRICS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FUNCS = ("sum", "count", "min", "max", "mean", "var", "nanstd")
+#: exact equality holds for these on integer-valued float64 data: sums of
+#: small integers are exact in binary64 regardless of association, so the
+#: slab-merged carry reproduces the single-pass result bit for bit
+EXACT = ("sum", "count", "min", "max", "mean")
+SIZE = 23
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    METRICS.reset()
+    cache.clear_all()
+    yield
+    cache.clear_all()
+
+
+def _slabs(nslabs=4, n=120, seed=7, integer=True):
+    """Deterministic (codes, values) slabs; integer-valued floats keep the
+    additive family exactly associative."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(nslabs):
+        codes = rng.integers(0, SIZE, n)
+        vals = (
+            rng.integers(-50, 50, n).astype(np.float64)
+            if integer
+            else rng.normal(size=n)
+        )
+        out.append((codes, vals))
+    return out
+
+
+def _inline(slabs, funcs=FUNCS, **kw):
+    codes = np.concatenate([c for c, _ in slabs])
+    vals = np.concatenate([v for _, v in slabs])
+    res, _ = groupby_aggregate_many(
+        vals, codes, funcs=funcs, expected_groups=np.arange(SIZE), **kw
+    )
+    return {f: np.asarray(v) for f, v in res.items()}
+
+
+def _check(store_res, oracle, funcs=FUNCS):
+    for f in funcs:
+        a, b = np.asarray(store_res[f]), np.asarray(oracle[f])
+        if f in EXACT:
+            np.testing.assert_array_equal(a, b, err_msg=f)
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-12, atol=1e-12, err_msg=f)
+
+
+def _fill(path, slabs, funcs=FUNCS, **create_kw):
+    s = IncrementalAggregationStore.create(path, funcs=funcs, size=SIZE, **create_kw)
+    for codes, vals in slabs:
+        s.append(codes, vals)
+    return s
+
+
+class TestChecksummedNpz:
+    def test_round_trip(self, tmp_path):
+        p = str(tmp_path / "x.npz")
+        arrays = {"a": np.arange(5.0), "b": np.array([[1, 2], [3, 4]], dtype=np.int32)}
+        write_checksummed_npz(p, arrays, {"kind": "t", "gen": 3})
+        got, meta = read_checksummed_npz(p)
+        assert meta["kind"] == "t" and meta["gen"] == 3
+        for k in arrays:
+            np.testing.assert_array_equal(got[k], arrays[k])
+            assert got[k].dtype == arrays[k].dtype
+
+    def test_bit_flip_detected(self, tmp_path):
+        p = str(tmp_path / "x.npz")
+        write_checksummed_npz(p, {"a": np.arange(100.0)}, {})
+        data = bytearray(open(p, "rb").read())
+        data[len(data) // 2] ^= 0x04
+        open(p, "wb").write(bytes(data))
+        with pytest.raises(StoreCorruptionError):
+            read_checksummed_npz(p)
+
+    def test_truncation_detected(self, tmp_path):
+        p = str(tmp_path / "x.npz")
+        write_checksummed_npz(p, {"a": np.arange(100.0)}, {})
+        data = open(p, "rb").read()
+        open(p, "wb").write(data[: len(data) // 2])
+        with pytest.raises(StoreCorruptionError):
+            read_checksummed_npz(p)
+
+    def test_headerless_npz_rejected(self, tmp_path):
+        p = str(tmp_path / "x.npz")
+        np.savez(p[:-4], a=np.arange(3.0))
+        with pytest.raises(StoreCorruptionError, match="header"):
+            read_checksummed_npz(p)
+
+    def test_future_format_rejected(self, tmp_path):
+        p = str(tmp_path / "x.npz")
+        write_checksummed_npz(p, {"a": np.arange(3.0)}, {})
+        arrays, _ = read_checksummed_npz(p)
+        header = json.dumps({"format": 99, "meta": {}, "digests": {}})
+        np.savez(p[:-4], __header__=np.frombuffer(header.encode(), dtype=np.uint8))
+        with pytest.raises(StoreCorruptionError, match="format"):
+            read_checksummed_npz(p)
+
+    def test_missing_file_is_filenotfound(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            read_checksummed_npz(str(tmp_path / "nope.npz"))
+
+
+class TestMergePresentVar:
+    def _oracle(self, codes, vals):
+        res, _ = groupby_aggregate_many(
+            vals, codes, funcs=("var", "mean", "count"),
+            expected_groups=np.arange(SIZE), engine="numpy",
+        )
+        return res
+
+    def _triple(self, codes, vals):
+        """(m2, total, count) PresentGroups for one slab, built the same way
+        the store builds its var leg."""
+        present, cidx = np.unique(codes, return_inverse=True)
+        cap = len(present) + 1
+        m2 = np.zeros(cap)
+        tot = np.zeros(cap)
+        cnt = np.zeros(cap)
+        for j, p in enumerate(present):
+            x = vals[codes == p]
+            cnt[j] = x.size
+            tot[j] = x.sum()
+            m2[j] = ((x - x.mean()) ** 2).sum()
+        return tuple(
+            PresentGroups(present, leaf, SIZE) for leaf in (m2, tot, cnt)
+        )
+
+    def test_matches_single_pass(self):
+        rng = np.random.default_rng(3)
+        ca, va = rng.integers(0, SIZE, 200), rng.normal(size=200)
+        cb, vb = rng.integers(0, SIZE, 150), rng.normal(size=150)
+        m2, tot, cnt = merge_present_var(self._triple(ca, va), self._triple(cb, vb))
+        oracle = self._oracle(np.concatenate([ca, cb]), np.concatenate([va, vb]))
+        dense_cnt = cnt.scatter_dense()
+        dense_m2 = m2.scatter_dense()
+        with np.errstate(invalid="ignore", divide="ignore"):
+            var = np.where(dense_cnt > 0, dense_m2 / dense_cnt, np.nan)
+        np.testing.assert_allclose(var, oracle["var"], rtol=1e-10, atol=1e-12)
+
+    def test_disjoint_groups(self):
+        a = self._triple(np.array([0, 0, 1]), np.array([1.0, 3.0, 5.0]))
+        b = self._triple(np.array([4, 4]), np.array([2.0, 6.0]))
+        m2, tot, cnt = merge_present_var(a, b)
+        np.testing.assert_array_equal(m2.present, [0, 1, 4])
+        dense = cnt.scatter_dense()
+        assert dense[0] == 2 and dense[1] == 1 and dense[4] == 2
+        # no cross-talk: singleton group 1 keeps zero m2
+        assert m2.scatter_dense()[1] == 0.0
+
+
+class TestStoreBasics:
+    def test_direct_ctor_rejected(self, tmp_path):
+        with pytest.raises(TypeError, match="create"):
+            IncrementalAggregationStore(str(tmp_path / "s"))
+
+    def test_create_twice_rejected(self, tmp_path):
+        p = str(tmp_path / "s")
+        IncrementalAggregationStore.create(p, funcs=("sum",), size=4)
+        with pytest.raises(FileExistsError):
+            IncrementalAggregationStore.create(p, funcs=("sum",), size=4)
+
+    def test_open_missing_rejected(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            IncrementalAggregationStore.open(str(tmp_path / "nope"))
+
+    def test_create_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="engine"):
+            IncrementalAggregationStore.create(
+                str(tmp_path / "a"), funcs=("sum",), size=4, engine="pallas"
+            )
+        with pytest.raises(ValueError, match="size"):
+            IncrementalAggregationStore.create(
+                str(tmp_path / "b"), funcs=("sum",), size=0
+            )
+
+    def test_append_query_matches_inline(self, tmp_path):
+        slabs = _slabs()
+        s = _fill(str(tmp_path / "s"), slabs)
+        _check(s.query(), _inline(slabs, engine="numpy"))
+
+    def test_plan_persisted_across_open(self, tmp_path):
+        p = str(tmp_path / "s")
+        s = IncrementalAggregationStore.create(
+            p, funcs=("sum", "var"), size=9, array_dtype="float32",
+            min_count=2, finalize_kwargs={"var": {"ddof": 1}},
+        )
+        s2 = IncrementalAggregationStore.open(p)
+        assert s2.funcs == ("sum", "var")
+        assert s2.size == 9
+        assert s2.array_dtype == np.dtype("float32")
+        assert s2.min_count == 2
+        assert s2.finalize_kwargs == {"var": {"ddof": 1}}
+
+    def test_reopen_bit_identical(self, tmp_path):
+        slabs = _slabs()
+        s = _fill(str(tmp_path / "s"), slabs)
+        before = s.query()
+        s2 = IncrementalAggregationStore.open(s.path)
+        assert not s2.recovered
+        after = s2.query()
+        for f in FUNCS:
+            np.testing.assert_array_equal(
+                np.asarray(before[f]), np.asarray(after[f]), err_msg=f
+            )
+
+    def test_duplicate_slab_is_noop(self, tmp_path):
+        slabs = _slabs(2)
+        s = _fill(str(tmp_path / "s"), slabs)
+        before = s.query()
+        gen = s.gen
+        ack = s.append(*slabs[0])
+        assert ack["ack"] == "slab_already_ingested"
+        assert s.gen == gen
+        assert METRICS.counters()["store.duplicates"] == 1
+        _check(s.query(), before)
+
+    def test_slab_id_overrides_fingerprint(self, tmp_path):
+        slabs = _slabs(2)
+        s = IncrementalAggregationStore.create(
+            str(tmp_path / "s"), funcs=FUNCS, size=SIZE
+        )
+        s.append(*slabs[0], slab_id="batch-0")
+        # different content, same idempotency key: a retried producer that
+        # re-reads its source must not double-ingest
+        ack = s.append(*slabs[1], slab_id="batch-0")
+        assert ack["ack"] == "slab_already_ingested"
+        _check(s.query(), _inline(slabs[:1], engine="numpy"))
+
+    def test_out_of_range_codes_dropped(self, tmp_path):
+        s = IncrementalAggregationStore.create(
+            str(tmp_path / "s"), funcs=("sum", "count"), size=4
+        )
+        s.append(np.array([0, -1, 2, 99]), np.array([1.0, 100.0, 3.0, 100.0]))
+        res = s.query()
+        np.testing.assert_array_equal(res["sum"], [1.0, 0.0, 3.0, 0.0])
+        np.testing.assert_array_equal(res["count"], [1, 0, 1, 0])
+
+    def test_all_invalid_slab_is_journal_only(self, tmp_path):
+        s = IncrementalAggregationStore.create(
+            str(tmp_path / "s"), funcs=("sum",), size=4
+        )
+        ack = s.append(np.array([-1, 77]), np.array([1.0, 2.0]))
+        assert ack["ack"] == "ingested" and s.gen == 1
+        assert not [f for f in os.listdir(s.path) if f.startswith("seg-")]
+        # still exactly-once, and the generation survives reopen
+        s2 = IncrementalAggregationStore.open(s.path)
+        assert s2.gen == 1
+        assert s2.append(np.array([-1, 77]), np.array([1.0, 2.0]))["ack"] == (
+            "slab_already_ingested"
+        )
+
+    def test_empty_store_query(self, tmp_path):
+        s = IncrementalAggregationStore.create(
+            str(tmp_path / "s"), funcs=("sum", "count", "mean"), size=5
+        )
+        res = s.query()
+        np.testing.assert_array_equal(res["sum"], np.zeros(5))
+        np.testing.assert_array_equal(res["count"], np.zeros(5, dtype=np.int64))
+        assert np.isnan(np.asarray(res["mean"])).all()
+
+    def test_query_subset_and_unknown(self, tmp_path):
+        s = _fill(str(tmp_path / "s"), _slabs(2))
+        res = s.query(("mean", "max"))
+        assert sorted(res) == ["max", "mean"]
+        with pytest.raises(ValueError, match="median"):
+            s.query(("median",))
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        s = IncrementalAggregationStore.create(
+            str(tmp_path / "s"), funcs=("sum",), size=4
+        )
+        with pytest.raises(ValueError, match="trailing axis"):
+            s.append(np.array([0, 1]), np.array([1.0, 2.0, 3.0]))
+
+    def test_info_snapshot(self, tmp_path):
+        s = _fill(str(tmp_path / "s"), _slabs(3))
+        info = s.info()
+        assert info["gen"] == 3 and info["slabs"] == 3
+        assert info["segments"] == 3 and info["nbytes"] > 0
+        json.dumps(info)  # JSON-able is part of the contract
+
+    def test_open_store_convenience(self, tmp_path):
+        p = str(tmp_path / "s")
+        with pytest.raises(FileNotFoundError):
+            open_store(p)
+        s = open_store(p, create={"funcs": ("sum",), "size": 4})
+        s.append(np.array([1]), np.array([5.0]))
+        s2 = open_store(p, create={"funcs": ("sum",), "size": 4})
+        assert s2.gen == 1
+
+
+def _writes_per_append(tmp_path, slabs):
+    """(first, last) 1-based durable-write ordinals of the FINAL append in
+    a create + append-all run."""
+    with faults.store_inject():
+        s = IncrementalAggregationStore.create(
+            str(tmp_path / "probe"), funcs=FUNCS, size=SIZE
+        )
+        for codes, vals in slabs[:-1]:
+            s.append(codes, vals)
+        before = faults._STORE_PLAN.writes
+        s.append(*slabs[-1])
+        after = faults._STORE_PLAN.writes
+    return before + 1, after
+
+
+class TestRecoveryMatrix:
+    """Kill / tear / flip at EVERY durable-write ordinal of the final
+    append, then reopen (= crash recovery) + re-append + query: must be
+    bit-identical to the uninterrupted control run."""
+
+    @pytest.fixture(scope="class")
+    def control(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("ctrl")
+        slabs = _slabs()
+        s = _fill(str(tmp / "ctrl"), slabs)
+        return slabs, {f: np.asarray(v) for f, v in s.query().items()}
+
+    @pytest.mark.parametrize("action", ["kill", "torn", "flip"])
+    @pytest.mark.parametrize("offset", [0, 1])  # journal write, segment write
+    def test_crash_during_append(self, tmp_path, control, action, offset):
+        slabs, ctrl = control
+        first, last = _writes_per_append(tmp_path, slabs)
+        assert last - first == 1, "append = one journal write + one segment write"
+        ordinal = first + offset
+        key = {"kill": "kill_at", "torn": "torn_at", "flip": "flip_at"}[action]
+        p = str(tmp_path / "s")
+        with faults.store_inject(**{key: (ordinal,)}):
+            s = IncrementalAggregationStore.create(p, funcs=FUNCS, size=SIZE)
+            for codes, vals in slabs[:-1]:
+                s.append(codes, vals)
+            try:
+                s.append(*slabs[-1])
+            except faults.StoreWriteKilled:
+                pass
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            s2 = IncrementalAggregationStore.open(p)
+        assert s2.gen in (len(slabs) - 1, len(slabs))
+        # exactly-once re-delivery: a no-op if the append committed, an
+        # ingest if it rolled back — either way the final state matches
+        s2.append(*slabs[-1])
+        assert s2.gen == len(slabs)
+        res = s2.query()
+        for f in FUNCS:
+            np.testing.assert_array_equal(
+                np.asarray(res[f]), ctrl[f], err_msg=f"{action}@{ordinal} {f}"
+            )
+
+    def test_torn_journal_tail_counts_recovery(self, tmp_path, control):
+        slabs, ctrl = control
+        first, _ = _writes_per_append(tmp_path, slabs)
+        p = str(tmp_path / "s")
+        with faults.store_inject(torn_at=(first,)):
+            s = IncrementalAggregationStore.create(p, funcs=FUNCS, size=SIZE)
+            for codes, vals in slabs[:-1]:
+                s.append(codes, vals)
+            with pytest.raises(faults.StoreWriteKilled):
+                s.append(*slabs[-1])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            s2 = IncrementalAggregationStore.open(p)
+        assert s2.recovered
+        assert METRICS.counters()["store.recoveries"] == 1
+        assert s2.gen == len(slabs) - 1
+
+    def test_torn_tail_is_truncated_so_reappend_survives_reopen(
+        self, tmp_path, control
+    ):
+        """Regression: a torn journal tail must be REMOVED at open, not just
+        skipped at parse. Otherwise the post-recovery append glues its record
+        onto the half-written line and the NEXT open drops the glued line as
+        a torn tail — silently rolling back an acked generation."""
+        slabs, ctrl = control
+        first, _ = _writes_per_append(tmp_path, slabs)
+        p = str(tmp_path / "s")
+        with faults.store_inject(torn_at=(first,)):
+            s = IncrementalAggregationStore.create(p, funcs=FUNCS, size=SIZE)
+            for codes, vals in slabs[:-1]:
+                s.append(codes, vals)
+            with pytest.raises(faults.StoreWriteKilled):
+                s.append(*slabs[-1])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            s2 = IncrementalAggregationStore.open(p)
+        assert s2.append(*slabs[-1])["ack"] == "ingested"
+        # the open AFTER the repair + re-append is the one the bug broke
+        s3 = IncrementalAggregationStore.open(p)
+        assert not s3.recovered
+        assert s3.gen == len(slabs)
+        assert s3.append(*slabs[-1])["ack"] == "slab_already_ingested"
+        res = s3.query()
+        for f in FUNCS:
+            np.testing.assert_array_equal(np.asarray(res[f]), ctrl[f])
+
+    def test_crash_before_any_append(self, tmp_path):
+        p = str(tmp_path / "s")
+        with faults.store_inject(kill_at=(2,)):  # first append's journal write
+            s = IncrementalAggregationStore.create(p, funcs=("sum",), size=4)
+            with pytest.raises(faults.StoreWriteKilled):
+                s.append(np.array([0]), np.array([1.0]))
+        s2 = IncrementalAggregationStore.open(p)
+        assert s2.gen == 0
+        assert s2.append(np.array([0]), np.array([1.0]))["ack"] == "ingested"
+
+    def test_mid_history_corruption_typed_error(self, tmp_path, control):
+        slabs, _ = control
+        p = str(tmp_path / "s")
+        _fill(p, slabs)
+        segs = sorted(f for f in os.listdir(p) if f.startswith("seg-"))
+        victim = os.path.join(p, segs[1])
+        data = bytearray(open(victim, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(victim, "wb").write(bytes(data))
+        with pytest.raises(StoreCorruptionError) as exc_info:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                IncrementalAggregationStore.open(p)
+        assert exc_info.value.segment == segs[1]
+        assert os.path.exists(victim + ".corrupt")
+        assert not os.path.exists(victim)
+
+    def test_deleted_tail_segment_rolls_back(self, tmp_path, control):
+        slabs, ctrl = control
+        p = str(tmp_path / "s")
+        _fill(p, slabs)
+        segs = sorted(f for f in os.listdir(p) if f.startswith("seg-"))
+        os.unlink(os.path.join(p, segs[-1]))
+        with pytest.warns(RuntimeWarning, match="rolling back"):
+            s2 = IncrementalAggregationStore.open(p)
+        assert s2.recovered and s2.gen == len(slabs) - 1
+        s2.append(*slabs[-1])
+        res = s2.query()
+        for f in FUNCS:
+            np.testing.assert_array_equal(np.asarray(res[f]), ctrl[f], err_msg=f)
+
+    def test_orphan_tmp_cleaned_on_open(self, tmp_path):
+        p = str(tmp_path / "s")
+        s = _fill(p, _slabs(2))
+        open(os.path.join(p, "seg-00000009.npz.tmp"), "wb").write(b"junk")
+        open(os.path.join(p, "seg-00000009.npz"), "wb").write(b"junk")
+        IncrementalAggregationStore.open(p)
+        left = os.listdir(p)
+        assert "seg-00000009.npz.tmp" not in left
+        assert "seg-00000009.npz" not in left
+
+
+class TestCompaction:
+    def test_compact_preserves_results(self, tmp_path):
+        slabs = _slabs()
+        s = _fill(str(tmp_path / "s"), slabs)
+        before = {f: np.asarray(v) for f, v in s.query().items()}
+        out = s.compact()
+        assert out["compacted"] and out["segments"] == 1
+        assert len([f for f in os.listdir(s.path) if f.startswith("seg-")]) == 1
+        for store in (s, IncrementalAggregationStore.open(s.path)):
+            res = store.query()
+            for f in FUNCS:
+                np.testing.assert_array_equal(
+                    np.asarray(res[f]), before[f], err_msg=f
+                )
+
+    def test_compact_then_append_then_compact(self, tmp_path):
+        slabs = _slabs(6)
+        s = _fill(str(tmp_path / "s"), slabs[:3])
+        s.compact()
+        for codes, vals in slabs[3:]:
+            s.append(codes, vals)
+        s.compact()
+        s2 = IncrementalAggregationStore.open(s.path)
+        assert s2.gen == 6 and s2.info()["segments"] == 1
+        _check(s2.query(), _inline(slabs, engine="numpy"))
+
+    def test_compact_noop_cases(self, tmp_path):
+        s = IncrementalAggregationStore.create(
+            str(tmp_path / "s"), funcs=("sum",), size=4
+        )
+        assert not s.compact()["compacted"]  # empty store
+        s.append(np.array([0]), np.array([1.0]))
+        assert not s.compact()["compacted"]  # single live segment
+
+    @pytest.mark.parametrize("op,ordinal", [("segment", 1), ("journal", 1)])
+    def test_crash_during_compact(self, tmp_path, op, ordinal):
+        slabs = _slabs()
+        ctrl = _inline(slabs, engine="numpy")
+        p = str(tmp_path / "s")
+        s = _fill(p, slabs)
+        with faults.store_inject(kill_at=(ordinal,), op=op):
+            with pytest.raises(faults.StoreWriteKilled):
+                s.compact()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            s2 = IncrementalAggregationStore.open(p)
+        assert s2.gen == len(slabs)
+        _check(s2.query(), ctrl)
+
+    @pytest.mark.parametrize("ordinal", [1, 2, 4])
+    def test_crash_during_swap_delete(self, tmp_path, ordinal):
+        slabs = _slabs()
+        ctrl = _inline(slabs, engine="numpy")
+        p = str(tmp_path / "s")
+        s = _fill(p, slabs)
+        with faults.store_inject(kill_at=(ordinal,), op="swap"):
+            with pytest.raises(faults.StoreWriteKilled):
+                s.compact()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            s2 = IncrementalAggregationStore.open(p)
+        # the compact committed (journal flipped before deletes): the new
+        # base serves, the undeleted replaced segments are swept as orphans
+        assert s2.gen == len(slabs) and s2.info()["segments"] == 1
+        _check(s2.query(), ctrl)
+        live = [f for f in os.listdir(p) if f.startswith("seg-") and f.endswith(".npz")]
+        assert len(live) == 1
+
+    def test_auto_compact_threshold(self, tmp_path):
+        slabs = _slabs(6)
+        with flox_tpu.set_options(store_compact_threshold=2):
+            s = _fill(str(tmp_path / "s"), slabs)
+        assert s.info()["segments"] <= 3
+        assert METRICS.counters()["store.compactions"] >= 1
+        _check(s.query(), _inline(slabs, engine="numpy"))
+
+
+class TestInlineEquivalence:
+    """query == the one-shot fused aggregation over concatenated history,
+    whatever engine/execution the inline side used."""
+
+    @pytest.mark.parametrize("inline_engine", ["numpy", "jax", "sort"])
+    def test_engines(self, tmp_path, inline_engine):
+        slabs = _slabs()
+        s = _fill(str(tmp_path / "s"), slabs)
+        _check(s.query(), _inline(slabs, engine=inline_engine))
+
+    def test_mesh(self, tmp_path):
+        from flox_tpu.parallel.mesh import make_mesh
+
+        slabs = _slabs(4, n=128)
+        s = _fill(str(tmp_path / "s"), slabs)
+        oracle = _inline(slabs, method="map-reduce", mesh=make_mesh())
+        _check(s.query(), oracle)
+
+    def test_store_jax_engine(self, tmp_path):
+        slabs = _slabs()
+        s = _fill(str(tmp_path / "s"), slabs, engine="jax")
+        _check(s.query(), _inline(slabs, engine="jax"))
+
+    def test_nan_data(self, tmp_path):
+        rng = np.random.default_rng(5)
+        slabs = []
+        for _ in range(3):
+            codes = rng.integers(0, SIZE, 90)
+            vals = rng.normal(size=90)
+            vals[rng.random(90) < 0.2] = np.nan
+            slabs.append((codes, vals))
+        funcs = ("nansum", "count", "nanmax", "nanmean", "nanstd")
+        s = IncrementalAggregationStore.create(
+            str(tmp_path / "s"), funcs=funcs, size=SIZE
+        )
+        for codes, vals in slabs:
+            s.append(codes, vals)
+        oracle = _inline(slabs, funcs=funcs, engine="numpy")
+        res = s.query()
+        for f in funcs:
+            np.testing.assert_allclose(
+                np.asarray(res[f]), oracle[f], rtol=1e-12, atol=1e-12, err_msg=f
+            )
+
+
+class TestOptions:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            flox_tpu.set_options(store_compact_threshold=-1)
+        with pytest.raises(ValueError):
+            flox_tpu.set_options(store_fsync="maybe")
+        with pytest.raises(ValueError):
+            flox_tpu.set_options(store_root=123)
+
+    def test_fsync_off_still_correct(self, tmp_path):
+        slabs = _slabs(2)
+        with flox_tpu.set_options(store_fsync="off"):
+            s = _fill(str(tmp_path / "s"), slabs)
+        s2 = IncrementalAggregationStore.open(s.path)
+        _check(s2.query(), _inline(slabs, engine="numpy"))
+
+
+class TestCheckpointHardening:
+    """StreamCheckpointer spills ride the store's checksummed format; a
+    damaged spill means 'fresh run', loudly — never silently wrong state."""
+
+    KEY = ("stream", "sum", 64, 8, 5, (), "fp", None, None, ())
+
+    def _spill(self, tmp_path):
+        from flox_tpu.resilience import Snapshot, _dump_snapshot
+
+        p = str(tmp_path / "ckpt.npz")
+        snap = Snapshot(
+            key=self.KEY, phase=1, slabs_done=4, payload={"acc": np.arange(6.0)}
+        )
+        _dump_snapshot(p, snap)
+        return p
+
+    def test_round_trip(self, tmp_path):
+        from flox_tpu.resilience import _load_snapshot
+
+        p = self._spill(tmp_path)
+        got = _load_snapshot(p, self.KEY)
+        assert got is not None and got.slabs_done == 4 and got.phase == 1
+        np.testing.assert_array_equal(got.payload["acc"], np.arange(6.0))
+
+    def test_truncated_spill_restarts_fresh(self, tmp_path):
+        from flox_tpu.resilience import _load_snapshot
+
+        p = self._spill(tmp_path)
+        data = open(p, "rb").read()
+        open(p, "wb").write(data[: len(data) // 2])
+        with pytest.warns(RuntimeWarning, match="corrupt"):
+            assert _load_snapshot(p, self.KEY) is None
+        assert METRICS.counters()["stream.checkpoint_corrupt"] == 1
+
+    def test_bitflip_spill_restarts_fresh(self, tmp_path):
+        from flox_tpu.resilience import _load_snapshot
+
+        p = self._spill(tmp_path)
+        data = bytearray(open(p, "rb").read())
+        data[len(data) // 2] ^= 0x01
+        open(p, "wb").write(bytes(data))
+        with pytest.warns(RuntimeWarning, match="corrupt"):
+            assert _load_snapshot(p, self.KEY) is None
+
+    def test_legacy_uncheck_summed_spill_restarts_fresh(self, tmp_path):
+        from flox_tpu.resilience import _load_snapshot
+
+        p = str(tmp_path / "legacy.npz")
+        np.savez(p[:-4], leaf0=np.arange(3.0))
+        with pytest.warns(RuntimeWarning, match="corrupt"):
+            assert _load_snapshot(p, self.KEY) is None
+
+
+class TestServeStores:
+    @pytest.fixture(autouse=True)
+    def _root(self, tmp_path):
+        with flox_tpu.set_options(store_root=str(tmp_path)):
+            yield str(tmp_path)
+
+    def test_unknown_store_typed(self):
+        from flox_tpu.serve import stores
+
+        with pytest.raises(stores.UnknownStoreError) as exc_info:
+            stores.query("nope")
+        assert exc_info.value.code == "unknown_store"
+
+    def test_no_root_typed(self):
+        from flox_tpu.serve import stores
+
+        with flox_tpu.set_options(store_root=None):
+            with pytest.raises(stores.UnknownStoreError, match="store root"):
+                stores.query("x")
+
+    def test_bad_names_typed(self):
+        from flox_tpu.serve import stores
+
+        for bad in ("", None, "../evil", "a/b", ".hidden"):
+            with pytest.raises(stores.UnknownStoreError):
+                stores.resolve(bad)
+
+    def test_append_query_roundtrip(self):
+        from flox_tpu.serve import stores
+
+        slabs = _slabs(3)
+        create = {"funcs": list(FUNCS), "size": SIZE}
+        for codes, vals in slabs:
+            ack = stores.append("t", codes, vals, create=create)
+        assert ack["ack"] == "ingested" and ack["gen"] == 3
+        _check(stores.query("t"), _inline(slabs, engine="numpy"))
+
+    def test_query_device_cache_invalidated_by_append(self):
+        from flox_tpu.serve import stores
+
+        slabs = _slabs(3)
+        create = {"funcs": list(FUNCS), "size": SIZE}
+        stores.append("t", *slabs[0], create=create)
+        stores.query("t")
+        stores.query("t")
+        assert METRICS.counters().get("store.query_device_hits", 0) == 1
+        stores.append("t", *slabs[1])
+        res = stores.query("t")  # generation moved: must recompute
+        assert METRICS.counters().get("store.query_device_hits", 0) == 1
+        _check(res, _inline(slabs[:2], engine="numpy"))
+
+    def test_restage_all_recovers(self):
+        from flox_tpu.serve import stores
+
+        slabs = _slabs(2)
+        create = {"funcs": list(FUNCS), "size": SIZE}
+        for codes, vals in slabs:
+            stores.append("t", codes, vals, create=create)
+        before = stores.query("t")
+        assert stores.restage_all() == 1
+        assert METRICS.counters()["store.restaged"] == 1
+        res = stores.query("t")
+        for f in FUNCS:
+            np.testing.assert_array_equal(
+                np.asarray(res[f]), np.asarray(before[f]), err_msg=f
+            )
+
+    def test_corruption_typed_and_quarantined(self, _root):
+        from flox_tpu.serve import stores
+
+        p = os.path.join(_root, "bad")
+        s = _fill(p, _slabs(3))
+        del s
+        segs = sorted(f for f in os.listdir(p) if f.startswith("seg-"))
+        victim = os.path.join(p, segs[0])
+        data = bytearray(open(victim, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(victim, "wb").write(bytes(data))
+        with pytest.raises(stores.StoreCorruptedError) as exc_info:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                stores.query("bad")
+        assert exc_info.value.code == "store_corruption"
+        assert os.path.exists(victim + ".corrupt")
+
+    def test_list_stores_sees_unopened(self, _root):
+        from flox_tpu.serve import stores
+
+        _fill(os.path.join(_root, "cold"), _slabs(1))
+        stores.append(
+            "hot", *_slabs(1)[0], create={"funcs": ["sum"], "size": SIZE}
+        )
+        rows = {r["store"]: r for r in stores.list_stores()}
+        assert rows["hot"]["open"] is True
+        assert rows["cold"]["open"] is False
+
+    def test_cache_stats_and_clear_all(self):
+        from flox_tpu.serve import stores
+
+        stores.append(
+            "t", *_slabs(1)[0], create={"funcs": ["sum", "mean"], "size": SIZE}
+        )
+        panel = cache.stats()["stores"]
+        assert panel["stores"] == 1 and panel["generations"] == {"t": 1}
+        assert panel["state_bytes"] > 0
+        cache.clear_all()
+        assert stores.stores_stats()["stores"] == 0
+        # durable state untouched: a later reference reopens it
+        assert stores.query("t")["sum"].shape == (SIZE,)
+
+    def test_debug_stores_payload(self):
+        from flox_tpu.exposition import _Handler
+        from flox_tpu.serve import stores
+
+        stores.append(
+            "t", *_slabs(1)[0], create={"funcs": ["sum"], "size": SIZE}
+        )
+        stores.query("t")
+        body, status = _Handler._stores("")
+        assert status == 200
+        payload = json.loads(body)
+        rows = {r["store"]: r for r in payload["stores"]}
+        assert rows["t"]["gen"] == 1
+        assert "cost_by_store" in payload
+
+    def test_gauges_track_table(self):
+        from flox_tpu.serve import stores
+
+        stores.append(
+            "t", *_slabs(1)[0], create={"funcs": ["sum"], "size": SIZE}
+        )
+        g = METRICS.gauges()
+        assert g["store.open_stores"] == 1.0 and g["store.state_bytes"] > 0
+        stores.clear()
+        assert METRICS.gauges()["store.open_stores"] == 0.0
+
+    def test_cost_ledger_rows(self):
+        from flox_tpu.serve import stores
+
+        with flox_tpu.set_options(telemetry=True):
+            stores.append(
+                "t", *_slabs(1)[0], create={"funcs": ["sum"], "size": SIZE}
+            )
+            stores.query("t")
+            by_ds = telemetry.cost_by_dataset()
+        assert "t" in by_ds
+
+
+@pytest.mark.slow
+class TestProtocol:
+    """append/query/compact/list_stores over the ``python -m
+    flox_tpu.serve`` JSON-lines loop, including typed error payloads."""
+
+    def test_line_protocol(self, tmp_path):
+        lines = [
+            {"id": "1", "op": "append", "store": "s1",
+             "codes": [0, 1, 1, 2], "array": [1.0, 2.0, 3.0, 4.0],
+             "create": {"funcs": ["sum", "count"], "size": 4}},
+            {"id": "2", "op": "append", "store": "s1",
+             "codes": [0, 1, 1, 2], "array": [1.0, 2.0, 3.0, 4.0]},
+            {"id": "3", "op": "query", "store": "s1"},
+            {"id": "4", "op": "compact", "store": "s1"},
+            {"id": "5", "op": "list_stores"},
+            {"id": "6", "op": "query", "store": "missing"},
+        ]
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            FLOX_TPU_STORE_ROOT=str(tmp_path),
+        )
+        out = subprocess.run(
+            [sys.executable, "-m", "flox_tpu.serve"],
+            input="\n".join(json.dumps(l) for l in lines) + "\n",
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+        )
+        got = [json.loads(l) for l in out.stdout.splitlines() if l.startswith("{")]
+        by_op = {}
+        for g in got:
+            by_op.setdefault(g["op"], []).append(g)
+        acks = [g["ack"] for g in by_op["append"]]
+        assert acks == ["ingested", "slab_already_ingested"]
+        queries = [g for g in by_op["query"] if g.get("ok")]
+        assert queries[0]["result"]["sum"] == [1.0, 5.0, 4.0, 0.0]
+        assert by_op["compact"][0]["ok"]
+        assert any(r["store"] == "s1" for r in by_op["list_stores"][0]["stores"])
+        err = [g for g in by_op["query"] if not g.get("ok")][0]
+        assert err["code"] == "unknown_store"

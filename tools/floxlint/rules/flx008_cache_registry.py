@@ -14,7 +14,8 @@ Scope: modules in the same top-level package as a ``*.cache`` module that
 defines ``clear_all``. A candidate is a module-level ALL_CAPS name whose
 name marks it as cache-like (CACHE / MEMO / REGISTRY / SNAPSHOT / PROBE /
 LEDGER — the cost-attribution tables of ISSUE 9 accrete per program key
-exactly like a cache),
+exactly like a cache — / TABLE — the durable-store table of ISSUE 18
+accretes one entry per opened store),
 bound to a mutable container literal or constructor, and mutated from at
 least one function body (import-time-populated static registries such as
 ``AGGREGATIONS`` or ``KERNELS`` are exempt: they are tables, not caches).
@@ -35,7 +36,7 @@ from .common import dotted_name
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from ..core import ProjectContext
 
-_NAME_TOKEN = re.compile(r"CACHE|MEMO|REGISTR|SNAPSHOT|PROBE|LEDGER")
+_NAME_TOKEN = re.compile(r"CACHE|MEMO|REGISTR|SNAPSHOT|PROBE|LEDGER|TABLE")
 _CONTAINER_CALLS = frozenset(
     {"dict", "list", "set", "defaultdict", "OrderedDict", "Counter", "deque",
      # the in-repo LRU wrapper around OrderedDict (flox_tpu.cache.LRUCache):
