@@ -122,6 +122,7 @@ def stats() -> dict:
     from .serve.dispatcher import _BATCH_REGISTRY, _COALESCE_CACHE, _PENDING_REGISTRY
     from .serve.registry import registry_stats
     from .serve.stores import stores_stats
+    from .slo import slo_stats
     from .streaming import _STEP_CACHE
     from .telemetry import (
         FLIGHT_RECORDER,
@@ -188,6 +189,10 @@ def stats() -> dict:
         # open/half-open detail (which program labels are being fast-failed
         # and how long their cooldowns have left)
         "serve_breakers": breaker_stats(),
+        # SLO plane: spec in force, window-snapshot depth, alert counts per
+        # state, canary probe/failure totals — a snapshot, never a fresh
+        # evaluation (stats must not move the alert state machine)
+        "slo": slo_stats(),
         "bundle_lru": {
             "size": info.currsize, "hits": info.hits, "misses": info.misses
         },
@@ -313,3 +318,12 @@ def clear_all() -> None:
     _CAPTURE_STATE.clear()
     _PREFETCH_INFLIGHT[0] = 0
     METRICS.reset()
+    # SLO plane (flox_tpu/slo.py): slo.clear() drops the burn-rate window
+    # snapshot ring, the alert state table, the canary probe ledger, the
+    # freshness tick ledger and the parsed-spec cache (its body references
+    # _SNAPSHOT_RING / _ALERT_TABLE / _CANARY_LEDGER / _FRESHNESS_LEDGER /
+    # _SPEC_CACHE directly for floxlint FLX008) — alert state must not
+    # outlive the counters (just reset above) it judged
+    from . import slo as slo_plane
+
+    slo_plane.clear()

@@ -28,10 +28,14 @@ nothing but the stdlib:
   per-program / per-tenant cost ledger as JSON — what ``python -m
   flox_tpu.telemetry costs`` tabulates), ``/debug/datasets`` (the resident
   dataset registry: pinned entries, HBM budget, evictions, per-dataset
-  cost ledger), and ``/debug/profile?seconds=N`` (starts an on-demand
-  on-chip capture; 409 while one runs, 501 on profiler-less backends). Starting the server seeds the saturation
-  gauges to 0 and starts the opt-in saturation sampler
-  (``OPTIONS["metrics_sample_interval"]``).
+  cost ledger), ``/debug/profile?seconds=N`` (starts an on-demand
+  on-chip capture; 409 while one runs, 501 on profiler-less backends),
+  and ``/slo`` + ``/alerts`` (one ``flox_tpu.slo`` burn-rate evaluation
+  as JSON — the scraper polling them IS the alert evaluator; what
+  ``python -m flox_tpu.telemetry slo`` tabulates and the fleet federator
+  unions). Starting the server seeds the saturation + resident-state
+  gauges to 0, runs one SLO evaluation, and starts the opt-in saturation
+  sampler (``OPTIONS["metrics_sample_interval"]``).
 
 Embedded automatically by ``python -m flox_tpu.serve`` when
 ``OPTIONS["metrics_port"]`` (env ``FLOX_TPU_METRICS_PORT``) or
@@ -285,6 +289,12 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/debug/profile":
             body, status = self._profile(query)
             ctype = "application/json; charset=utf-8"
+        elif path == "/slo":
+            body, status = self._slo()
+            ctype = "application/json; charset=utf-8"
+        elif path == "/alerts":
+            body, status = self._alerts()
+            ctype = "application/json; charset=utf-8"
         else:
             body, status, ctype = b"not found\n", 404, "text/plain; charset=utf-8"
         self.send_response(status)
@@ -399,6 +409,48 @@ class _Handler(BaseHTTPRequestHandler):
         return (json.dumps(payload, default=str) + "\n").encode(), 200
 
     @staticmethod
+    def _slo() -> tuple[bytes, int]:
+        """One SLO evaluation as JSON: per-objective burn rates against
+        every window rule, budget remaining, and the live alert rows —
+        the machine-readable face of ``slo.evaluate()`` (``python -m
+        flox_tpu.telemetry slo <scrape>`` tabulates exactly this payload,
+        and the fleet federator unions it across replicas).
+
+        Evaluating ON scrape keeps the endpoint and the state machine in
+        lockstep: a scraper polling /slo IS the alert evaluator, no extra
+        daemon required. An invalid configured spec is this endpoint's
+        500 — loudly, per the no-silent-fallback contract."""
+        from . import slo, telemetry
+
+        try:
+            payload = slo.evaluate()
+        except ValueError as exc:
+            return (json.dumps({"error": str(exc)}) + "\n").encode(), 500
+        payload["replica"] = telemetry.replica_instance()
+        payload["host"] = telemetry.host_name()
+        return (json.dumps(payload, default=str) + "\n").encode(), 200
+
+    @staticmethod
+    def _alerts() -> tuple[bytes, int]:
+        """The alert state machine's rows as JSON (evaluated fresh, same
+        contract as ``/slo`` — a firing alert must not need a second
+        scrape to appear)."""
+        from . import slo, telemetry
+
+        try:
+            payload = slo.evaluate()
+        except ValueError as exc:
+            return (json.dumps({"error": str(exc)}) + "\n").encode(), 500
+        body = {
+            "alerts": payload["alerts"],
+            "healthy": payload["healthy"],
+            "evaluated_at": payload["evaluated_at"],
+            "replica": telemetry.replica_instance(),
+            "host": telemetry.host_name(),
+        }
+        return (json.dumps(body, default=str) + "\n").encode(), 200
+
+    @staticmethod
     def _profile(query: str) -> tuple[bytes, int]:
         """Start an on-demand on-chip capture (``?seconds=N``, default 5).
 
@@ -485,6 +537,14 @@ def start_metrics_server(port: int | None = None, host: str = "127.0.0.1") -> in
     # at endpoint start so utilization math never reads an absent gauge
     telemetry.seed_hbm_limit()
     telemetry.start_saturation_sampler()
+    # resident-state gauges (registry occupancy, store staleness) + one
+    # SLO evaluation seed with the endpoint too: freshness SLOs need a
+    # signal on an idle replica, and /slo + the budget gauges must answer
+    # from the very first scrape
+    telemetry.sample_resident_state()
+    from . import slo
+
+    slo.seed_gauges()
     return server.port
 
 

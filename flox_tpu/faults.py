@@ -24,6 +24,11 @@ where the resilience layer must handle them:
   chosen program label (drives the per-program circuit breaker),
   :class:`SimulatedDeviceLoss` at dispatch N (drives backend recovery),
   and hang a chosen dispatch (drives the dispatch watchdog).
+* :func:`slo_inject` installs the SLO-plane plan ``flox_tpu.slo``
+  consults: a controllable clock plus synthetic SLI event bursts (so the
+  multi-window burn-rate alert lifecycle walks in test time, not wall
+  time) and canary-response corruption (so CI proves a silent wrong
+  answer is caught as a correctness-SLO breach).
 
 Everything is index-deterministic: the same plan against the same stream
 fires at the same slabs in the same order, prefetch on or off. The plan
@@ -71,6 +76,11 @@ __all__ = [
     "store_inject",
     "store_poke",
     "store_active",
+    "slo_inject",
+    "slo_active",
+    "slo_now",
+    "slo_injected",
+    "slo_canary_corrupt",
     "misshaping_loader",
     "stress_schedule",
     "LockOrderViolation",
@@ -533,6 +543,126 @@ def store_inject(
         yield plan
     finally:
         _STORE_PLAN = prev
+
+
+@dataclass
+class _SLOPlan:
+    """One installed SLO-plane injection plan: a controllable clock plus
+    synthetic SLI events, so the multi-window burn-rate math and the alert
+    state machine (``flox_tpu.slo``) are testable without wall-clock
+    sleeps. Consulted by ``slo._now`` (clock), ``slo._collect``
+    (synthetic events) and the canary's bit-exact compare
+    (``corrupt_canary`` — the injected wrong answer CI proves is caught)."""
+
+    #: the plan's synthetic "now" (seconds); ``advance`` moves it forward.
+    #: None leaves the real clock in charge (events-only plans)
+    clock: float | None = None
+    #: objective name -> [good, bad] cumulative synthetic SLI events,
+    #: added on top of the real collectors by ``slo._collect``
+    events: dict = field(default_factory=dict)
+    #: canary op name (or "*") -> how many of its next comparisons to
+    #: corrupt (-1 = every one)
+    corrupt_canary: dict = field(default_factory=dict)
+    #: ("burst"|"advance"|"corrupt", ...) per consulted event, in order
+    log: list = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def advance(self, seconds: float) -> float:
+        """Move the synthetic clock forward; returns the new now."""
+        with self._lock:
+            if self.clock is None:
+                raise ValueError("slo_inject plan has no clock (pass clock0=)")
+            self.clock += float(seconds)
+            self.log.append(("advance", float(seconds), self.clock))
+            return self.clock
+
+    def burst(self, objective: str, *, good: int = 0, bad: int = 0) -> None:
+        """Add synthetic SLI events to ``objective``'s cumulative totals
+        (they appear in every evaluation until the plan is uninstalled —
+        uninstalling makes counters drop, which the window math clamps
+        to zero burn, i.e. the incident ends)."""
+        with self._lock:
+            slot = self.events.setdefault(str(objective), [0, 0])
+            slot[0] += int(good)
+            slot[1] += int(bad)
+            self.log.append(("burst", str(objective), int(good), int(bad)))
+
+
+_SLO_PLAN: _SLOPlan | None = None
+
+
+def slo_active() -> bool:
+    return _SLO_PLAN is not None
+
+
+def slo_now() -> float | None:
+    """The installed plan's synthetic clock, or None when the real clock
+    is in charge (no plan, or a plan without ``clock0``)."""
+    plan = _SLO_PLAN
+    if plan is None:
+        return None
+    with plan._lock:
+        return plan.clock
+
+
+def slo_injected(objective: str) -> tuple[int, int]:
+    """Cumulative synthetic (good, bad) events for ``objective`` from the
+    installed plan; (0, 0) with no plan."""
+    plan = _SLO_PLAN
+    if plan is None:
+        return (0, 0)
+    with plan._lock:
+        slot = plan.events.get(str(objective))
+        return (int(slot[0]), int(slot[1])) if slot else (0, 0)
+
+
+def slo_canary_corrupt(op: str) -> bool:
+    """Canary-corruption hook: True tells the prober's compare to perturb
+    the received result (simulating silent wrong-answer corruption).
+    Budgeted per op name ("*" matches any); -1 corrupts every compare."""
+    plan = _SLO_PLAN
+    if plan is None:
+        return False
+    with plan._lock:
+        key = str(op) if str(op) in plan.corrupt_canary else "*"
+        times = plan.corrupt_canary.get(key, 0)
+        if times == 0:
+            return False
+        if times > 0:
+            plan.corrupt_canary[key] = times - 1
+        plan.log.append(("corrupt", str(op)))
+        return True
+
+
+@contextlib.contextmanager
+def slo_inject(
+    *,
+    clock0: float | None = None,
+    corrupt_canary: dict | tuple | list | None = None,
+) -> Iterator[_SLOPlan]:
+    """Install a deterministic SLO-plane injection plan for the scope.
+
+    ``clock0`` seeds the synthetic clock ``slo.evaluate`` reads (advance
+    it with ``plan.advance(seconds)`` to walk burn-rate windows without
+    sleeping); ``corrupt_canary`` maps canary op names to how many of
+    their next bit-exact compares to corrupt (a bare tuple/list corrupts
+    each named op once; -1 = every compare). Synthetic SLI events are
+    added with ``plan.burst(objective, good=..., bad=...)``. Yields the
+    plan; its ``log`` records every consulted event in order.
+    """
+    global _SLO_PLAN
+    plan = _SLOPlan(clock=float(clock0) if clock0 is not None else None)
+    if corrupt_canary:
+        if isinstance(corrupt_canary, dict):
+            plan.corrupt_canary = {str(k): int(v) for k, v in corrupt_canary.items()}
+        else:
+            plan.corrupt_canary = {str(op): 1 for op in corrupt_canary}
+    prev = _SLO_PLAN
+    _SLO_PLAN = plan
+    try:
+        yield plan
+    finally:
+        _SLO_PLAN = prev
 
 
 def misshaping_loader(

@@ -19,13 +19,23 @@ like the exposition layer it scrapes:
 * ``python -m flox_tpu.fleet federate`` — the aggregator as a process:
   ``/metrics`` (merged text format), ``/debug/costs`` (merged ledger JSON,
   same shape the costs CLI reads), ``/replicas`` (readiness/status table),
+  ``/alerts`` (fleet-deduped SLO alert rows, each tagged with its
+  replica), ``/slo`` (per-replica SLO health + the deduped alerts),
   ``/healthz``, ``/readyz`` (200 while at least one replica is ready —
   what a front-door load balancer should probe).
 * ``python -m flox_tpu.fleet top`` — the live ops console: a refresh loop
   over the same scrapes showing per-replica qps, p50/p99 request latency,
-  queue depth, open breakers, HBM, readiness, and the fleet's top cost
-  rows. ``--once`` renders a single frame (scripts, tests); ``--plain``
-  skips the screen-clear escape.
+  queue depth, open breakers, HBM, resident datasets/stores, store
+  freshness, the ALERTS column (``2F/1P`` = 2 firing / 1 pending), and
+  the fleet's top cost rows. ``--once`` renders a single frame (scripts,
+  tests); ``--plain`` skips the screen-clear escape.
+
+The scrape also soft-GETs each replica's ``/debug/datasets`` +
+``/debug/stores`` + ``/slo``: resident-state tables federate per NAME
+(bytes summed, store generations and the freshest staleness kept per
+replica), and alert rows dedup by (objective, window, replica) with the
+most-live state winning — replicas without those planes contribute empty
+tables instead of failing the round.
 
 Replica targets are ``name=http://host:port`` pairs (bare URLs get a
 ``host:port`` name), from ``--replicas`` or ``OPTIONS["fleet_replicas"]``
@@ -295,6 +305,10 @@ class ReplicaSnapshot:
     metrics: dict = field(default_factory=dict)
     costs: dict = field(default_factory=dict)
     programs: dict = field(default_factory=dict)
+    datasets: dict = field(default_factory=dict)
+    stores: dict = field(default_factory=dict)
+    slo: dict = field(default_factory=dict)
+    alerts: list = field(default_factory=list)
     scraped_at: float = 0.0
 
     @property
@@ -332,6 +346,19 @@ def scrape_replica(name: str, url: str, timeout: float = 5.0) -> ReplicaSnapshot
         status, body = _http_get(f"{snap.url}/debug/programs", timeout)
         if status == 200:
             snap.programs = json.loads(body).get("programs") or {}
+        # resident state (dataset registry + durable stores) and the SLO /
+        # alert plane: soft scrapes — older replicas (or replicas with the
+        # planes dark) simply contribute empty tables, never a scrape fail
+        status, body = _http_get(f"{snap.url}/debug/datasets", timeout)
+        if status == 200:
+            snap.datasets = json.loads(body)
+        status, body = _http_get(f"{snap.url}/debug/stores", timeout)
+        if status == 200:
+            snap.stores = json.loads(body)
+        status, body = _http_get(f"{snap.url}/slo", timeout)
+        if status == 200:
+            snap.slo = json.loads(body)
+            snap.alerts = list(snap.slo.get("alerts") or [])
         status, body = _http_get(f"{snap.url}/readyz", timeout)
         snap.ready = status == 200
         snap.ready_reason = body.strip()
@@ -360,8 +387,18 @@ def federate(snapshots: list[ReplicaSnapshot]) -> dict[str, Any]:
         "cost_by_tenant": {},
         "cost_by_replica": {},
         "programs": {},     # card digest -> {card fields, labels, observed merged}
+        "datasets": {},     # name -> {"bytes", "pins", "hits", "replicas": {...}}
+        "stores": {},       # name -> {"state_bytes", "generations", "staleness_s", ...}
+        "alerts": [],       # deduped alert rows, each tagged with its replica
+        "slo": {},          # replica label -> that replica's /slo health summary
         "replicas": [],
     }
+    #: (objective, window, replica) -> alert row — the dedup table behind
+    #: view["alerts"]; a replica re-reporting one alert keeps the
+    #: most-severe / most-live row (firing beats pending beats resolved)
+    alert_table: dict[tuple, dict] = {}
+    state_rank = {"firing": 0, "pending": 1, "resolved": 2}
+    severity_rank = {"page": 0, "ticket": 1}
     for snap in snapshots:
         label = snap.replica_label
         view["replicas"].append(
@@ -409,6 +446,68 @@ def federate(snapshots: list[ReplicaSnapshot]) -> dict[str, Any]:
                 )[label] = dict(row)
         for prog_label, row in (snap.programs or {}).items():
             _merge_program_row(view["programs"], prog_label, row)
+        for row in (snap.datasets or {}).get("datasets") or []:
+            name = str(row.get("name"))
+            slot = view["datasets"].setdefault(
+                name, {"bytes": 0, "pins": 0, "hits": 0, "replicas": {}}
+            )
+            slot["bytes"] += int(row.get("nbytes", 0))
+            slot["pins"] += int(row.get("pins", 0))
+            slot["hits"] += int(row.get("hits", 0))
+            slot["replicas"][label] = dict(row)
+        for row in (snap.stores or {}).get("stores") or []:
+            name = str(row.get("store"))
+            slot = view["stores"].setdefault(
+                name,
+                {"state_bytes": 0, "generations": {}, "staleness_s": None, "replicas": {}},
+            )
+            slot["state_bytes"] += int(row.get("nbytes", 0))
+            if row.get("gen") is not None:
+                slot["generations"][label] = int(row["gen"])
+            stale = row.get("staleness_s")
+            if stale is not None:
+                # the FRESHEST copy wins: one replica still ingesting means
+                # the fleet's view of the store is that fresh
+                held = slot["staleness_s"]
+                slot["staleness_s"] = (
+                    float(stale) if held is None else min(held, float(stale))
+                )
+            slot["replicas"][label] = dict(row)
+        if snap.slo:
+            view["slo"][label] = {
+                "healthy": bool(snap.slo.get("healthy", True)),
+                "evaluated_at": snap.slo.get("evaluated_at"),
+                "objectives": [
+                    {
+                        "name": o.get("name"),
+                        "kind": o.get("kind"),
+                        "healthy": o.get("healthy"),
+                        "budget_remaining": o.get("budget_remaining"),
+                    }
+                    for o in snap.slo.get("objectives") or []
+                ],
+            }
+        for alert in snap.alerts or []:
+            key = (alert.get("objective"), alert.get("window"), label)
+            row = dict(alert, replica=label)
+            held = alert_table.get(key)
+            if held is None or (
+                state_rank.get(row.get("state"), 9),
+                severity_rank.get(row.get("severity"), 9),
+            ) < (
+                state_rank.get(held.get("state"), 9),
+                severity_rank.get(held.get("severity"), 9),
+            ):
+                alert_table[key] = row
+    view["alerts"] = sorted(
+        alert_table.values(),
+        key=lambda a: (
+            state_rank.get(a.get("state"), 9),
+            severity_rank.get(a.get("severity"), 9),
+            str(a.get("objective")),
+            str(a.get("replica")),
+        ),
+    )
     # a merge error poisons EVERY label set of its metric: sibling keys
     # processed before the error still hold a partial (first-replicas-only)
     # merged histogram, and publishing that as the fleet aggregate would be
@@ -601,10 +700,12 @@ def render_top(
     prev: dict[str, Any] | None = None,
     interval: float = 0.0,
     top: int = 5,
-    width: int = 100,
+    width: int = 120,
 ) -> str:
-    """One ops-console frame: per-replica vitals + the fleet's top cost
-    rows. ``prev``/``interval`` turn the monotonically increasing
+    """One ops-console frame: per-replica vitals (now including resident
+    datasets/stores, store freshness, and the SLO alert column) + the
+    fleet's top cost rows + any firing/pending alerts.
+    ``prev``/``interval`` turn the monotonically increasing
     ``serve.requests`` counter into a qps column (blank on the first
     frame). This is the ANSI *formatting* of exactly the dict
     :func:`render_top_json` builds — the two views cannot drift."""
@@ -614,7 +715,8 @@ def render_top(
         f"{time.strftime('%H:%M:%S')}",
         "",
         f"{'replica':<16} {'state':<12} {'qps':>7} {'p50 ms':>9} {'p99 ms':>9} "
-        f"{'queue':>6} {'brk':>4} {'hbm':>10}  endpoint",
+        f"{'queue':>6} {'brk':>4} {'hbm':>10} {'ds':>4} {'st':>4} "
+        f"{'fresh':>7} {'alerts':>6}  endpoint",
         "-" * width,
     ]
     for row in frame["replicas"]:
@@ -627,11 +729,28 @@ def render_top(
         if hbm and limit:
             # the bytes_limit gauge makes the column a fraction of capacity
             hbm_s = f"{hbm / 2**30:.2f}G/{100 * hbm / limit:.0f}%"
+        stale = row["staleness_s"]
+        fresh = f"{stale:.0f}s" if stale is not None else "-"
+        firing, pending = row["alerts_firing"], row["alerts_pending"]
+        alerts_s = "-" if not (firing or pending) else f"{firing}F/{pending}P"
         lines.append(
             f"{row['replica'][:16]:<16} {row['state'][:12]:<12} {qps:>7} "
             f"{p50:>9} {p99:>9} {row['queue_depth']:>6} "
-            f"{row['breakers_open']:>4} {hbm_s:>10}  {row['url']}"
+            f"{row['breakers_open']:>4} {hbm_s:>10} {row['datasets']:>4} "
+            f"{row['stores']:>4} {fresh:>7} {alerts_s:>6}  {row['url']}"
         )
+    live_alerts = [
+        a for a in frame["alerts"] if a.get("state") in ("firing", "pending")
+    ]
+    if live_alerts:
+        lines += ["", "alerts (most severe first):"]
+        for a in live_alerts:
+            lines.append(
+                f"  [{str(a.get('state', '?')).upper():<7}] "
+                f"{a.get('objective')}/{a.get('window')} "
+                f"severity={a.get('severity')} replica={a.get('replica')} "
+                f"burn={a.get('burn_short', 0):.1f}x/{a.get('burn_long', 0):.1f}x"
+            )
     lines += [
         "",
         f"top {top} cost rows (fleet-unioned /debug/costs, by device time):",
@@ -698,6 +817,22 @@ def render_top_json(
             .get(label)
         )
         limit = gauge("flox_tpu_hbm_bytes_limit", label)
+        ds_rows = [
+            slot["replicas"][label]
+            for slot in view.get("datasets", {}).values()
+            if label in slot.get("replicas", {})
+        ]
+        st_rows = [
+            slot["replicas"][label]
+            for slot in view.get("stores", {}).values()
+            if label in slot.get("replicas", {})
+        ]
+        stale = [
+            float(r["staleness_s"]) for r in st_rows if r.get("staleness_s") is not None
+        ]
+        my_alerts = [
+            a for a in view.get("alerts", []) if a.get("replica") == label
+        ]
         replicas.append(
             {
                 "replica": label,
@@ -711,6 +846,22 @@ def render_top_json(
                 "breakers_open": int(gauge("flox_tpu_serve_breakers_open", label)),
                 "hbm_bytes": gauge("flox_tpu_hbm_bytes_in_use", label),
                 "hbm_bytes_limit": limit or None,
+                "datasets": len(ds_rows),
+                "dataset_bytes": sum(int(r.get("nbytes", 0)) for r in ds_rows),
+                "stores": len(st_rows),
+                # the STALEST store on this replica: the freshness headline
+                "staleness_s": round(max(stale), 3) if stale else None,
+                "alerts_firing": sum(
+                    1 for a in my_alerts if a.get("state") == "firing"
+                ),
+                "alerts_pending": sum(
+                    1 for a in my_alerts if a.get("state") == "pending"
+                ),
+                "slo_healthy": (
+                    view.get("slo", {}).get(label, {}).get("healthy")
+                    if label in view.get("slo", {})
+                    else None
+                ),
             }
         )
     util_by_label: dict[str, float] = {}
@@ -743,6 +894,7 @@ def render_top_json(
         "replicas": replicas,
         "top_costs": top_costs,
         "programs": programs,
+        "alerts": [dict(a) for a in view.get("alerts", [])],
         "merge_errors": dict(view.get("merge_errors", {})),
     }
 
@@ -916,6 +1068,36 @@ class Federator:
                 elif path == "/replicas":
                     body = (json.dumps(view["replicas"], default=str) + "\n").encode()
                     status, ctype = 200, "application/json; charset=utf-8"
+                elif path == "/alerts":
+                    alerts = view.get("alerts", [])
+                    payload = {
+                        "alerts": alerts,
+                        "firing": sum(
+                            1 for a in alerts if a.get("state") == "firing"
+                        ),
+                        "healthy": not any(
+                            a.get("state") == "firing" for a in alerts
+                        ),
+                        "replica": "_fleet",
+                    }
+                    body = (json.dumps(payload, default=str) + "\n").encode()
+                    status, ctype = 200, "application/json; charset=utf-8"
+                elif path == "/slo":
+                    by_replica = view.get("slo", {})
+                    payload = {
+                        "healthy": all(
+                            s.get("healthy", True) for s in by_replica.values()
+                        )
+                        and not any(
+                            a.get("state") == "firing"
+                            for a in view.get("alerts", [])
+                        ),
+                        "replicas": by_replica,
+                        "alerts": view.get("alerts", []),
+                        "replica": "_fleet",
+                    }
+                    body = (json.dumps(payload, default=str) + "\n").encode()
+                    status, ctype = 200, "application/json; charset=utf-8"
                 elif path == "/healthz":
                     body, status, ctype = b"ok\n", 200, "text/plain; charset=utf-8"
                 elif path == "/readyz":
@@ -962,7 +1144,7 @@ def main(argv: list[str] | None = None) -> int:
     federate_cmd = sub.add_parser(
         "federate",
         help="scrape N replicas and serve the merged /metrics + "
-        "/debug/costs + /replicas view from one endpoint",
+        "/debug/costs + /replicas + /alerts + /slo view from one endpoint",
     )
     top_cmd = sub.add_parser(
         "top", help="live per-replica vitals + fleet top-cost console"
@@ -1018,7 +1200,7 @@ def main(argv: list[str] | None = None) -> int:
         print(
             f"federating {len(targets)} replica(s) every {federator.interval:g}s "
             f"on http://{args.host}:{port} (/metrics /debug/costs /replicas "
-            f"/healthz /readyz)",
+            f"/alerts /slo /healthz /readyz)",
             flush=True,
         )
         try:

@@ -444,6 +444,18 @@ OPTIONS: dict[str, Any] = {
     "costmodel_overhead_ms": _env_float(
         "FLOX_TPU_COSTMODEL_OVERHEAD_MS", 25.0, 0.0, 60_000.0
     ),
+    # SLO plane (flox_tpu/slo.py): path of the declarative objective spec
+    # consumed by slo.load_spec — JSON, or TOML for *.toml. None (the
+    # default) uses the built-in objectives (latency / availability /
+    # correctness / freshness under Google-SRE fast+slow burn windows).
+    # An unreadable or invalid spec raises ValueError at the surface that
+    # evaluates it (/slo answers 500), never a silent default fallback.
+    "slo_path": os.environ.get("FLOX_TPU_SLO_PATH") or None,
+    # seconds between canary-prober cycles in `python -m flox_tpu.serve`:
+    # known-answer requests across the op matrix, billed to the reserved
+    # "__canary__" tenant, feeding the correctness SLO. 0 (the default)
+    # keeps the prober off; the serve CLI's --canary-interval overrides.
+    "slo_canary_interval": _env_float("FLOX_TPU_SLO_CANARY_INTERVAL", 0.0, 0.0, 3600.0),
 }
 
 # single source of truth for the accumulation disciplines — referenced by
@@ -554,6 +566,13 @@ _VALIDATORS = {
     "costmodel": lambda x: isinstance(x, bool),
     "costmodel_drift_threshold": lambda x: _is_finite_num(x) and 1 <= x <= 1e6,
     "costmodel_overhead_ms": lambda x: _is_finite_num(x) and 0 <= x <= 60_000,
+    # SLO-plane knobs: a bad spec path or a runaway canary period raises
+    # here, not at the first evaluation (spec CONTENT is validated by
+    # slo.load_spec at read time — the path can point anywhere writable)
+    "slo_path": lambda x: x is None or (
+        isinstance(x, (str, os.PathLike)) and bool(str(x))
+    ),
+    "slo_canary_interval": lambda x: _is_finite_num(x) and 0 <= x <= 3600,
 }
 
 # rebind the literal through the overlay-aware view: same object contents,

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import json
 import sys
 import threading
@@ -279,6 +280,24 @@ async def _amain(args: argparse.Namespace) -> int:
         microbatch_max=args.microbatch_max,
         batch_window=args.batch_window,
     )
+    # the SLO canary prober: known-answer requests across the op matrix on
+    # a period, billed under the reserved tenant, feeding the correctness
+    # SLO. Off by default (0); --canary-interval overrides the option.
+    from .. import options
+
+    canary_interval = (
+        args.canary_interval
+        if args.canary_interval is not None
+        else options.OPTIONS["slo_canary_interval"]
+    )
+    canary_task: asyncio.Task | None = None
+    if canary_interval:
+        from .. import slo
+
+        canary_task = asyncio.ensure_future(
+            slo.canary_loop(dispatcher, float(canary_interval))
+        )
+        _emit({"op": "canary", "interval": float(canary_interval)})
     drain_event = asyncio.Event()
     drain_state: dict[str, str] = {}
 
@@ -488,6 +507,12 @@ async def _amain(args: argparse.Namespace) -> int:
                 task.add_done_callback(pending.discard)
     finally:
         drainer.cancel()
+        if canary_task is not None:
+            # the prober holds no state needing a flush — cancel before the
+            # drain so no new probe races admission-closed
+            canary_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await canary_task
         if drain_state:
             await _drain_and_exit(dispatcher, pending, drain_state["source"])
         else:
@@ -519,6 +544,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--deadline", type=float, default=None)
     parser.add_argument("--microbatch-max", type=int, default=None)
     parser.add_argument("--batch-window", type=float, default=None)
+    parser.add_argument(
+        "--canary-interval", type=float, default=None,
+        help="seconds between SLO canary-prober cycles (known-answer "
+        "requests billed to the reserved __canary__ tenant, feeding the "
+        "correctness SLO; overrides FLOX_TPU_SLO_CANARY_INTERVAL; "
+        "0 keeps the prober off)",
+    )
     parser.add_argument(
         "--metrics-port", type=int, default=None,
         help="serve /metrics + /healthz + /readyz on this port "

@@ -453,7 +453,13 @@ class Dispatcher:
         if request is None:
             request = AggregationRequest(**kwargs)
         t0 = time.perf_counter()
-        METRICS.inc("serve.requests")
+        # canary probes get their own admission counter: serve.requests is
+        # the availability SLO's denominator, and synthetic known-answer
+        # traffic must neither dilute nor burn a user-facing budget
+        if request.tenant == telemetry.CANARY_TENANT:
+            METRICS.inc("canary.requests")
+        else:
+            METRICS.inc("serve.requests")
         if self._draining:
             METRICS.inc("serve.drain_rejected")
             raise DrainingError(
@@ -462,7 +468,13 @@ class Dispatcher:
             )
         depth = self._knob(self.queue_depth, "serve_queue_depth")
         if depth and len(_PENDING_REGISTRY) >= depth:
-            METRICS.inc("serve.shed")
+            # canary admission failures land on their own counter:
+            # serve.shed is an availability-SLO bad counter, and synthetic
+            # probes hitting a saturated queue is not a user-facing outage
+            if request.tenant == telemetry.CANARY_TENANT:
+                METRICS.inc("canary.shed")
+            else:
+                METRICS.inc("serve.shed")
             window = float(self._knob(self.batch_window, "serve_batch_window"))
             raise LoadShedError(
                 f"dispatcher saturated: {len(_PENDING_REGISTRY)} requests pending "
@@ -661,7 +673,12 @@ class Dispatcher:
             # dispatch time (never dispatched), so expired requests cannot
             # poison the queue
             leaf.waiters -= 1
-            METRICS.inc("serve.deadline_exceeded")
+            # same canary split as serve.shed: deadline_exceeded is an
+            # availability-SLO bad counter
+            if request.tenant == telemetry.CANARY_TENANT:
+                METRICS.inc("canary.deadline_exceeded")
+            else:
+                METRICS.inc("serve.deadline_exceeded")
             raise DeadlineExceededError(
                 f"deadline of {deadline:.4f}s exceeded "
                 f"({'dispatched' if leaf.t_dispatch else 'still queued'})"
@@ -671,8 +688,13 @@ class Dispatcher:
         # waited 0, not a negative interval (t_dispatch predates its t0)
         queue_ms = max(0.0, ((leaf.t_dispatch or t1) - t0) * 1e3)
         request_ms = (t1 - t0) * 1e3
-        METRICS.observe("serve.request_ms", request_ms, exemplar=request.request_id)
-        METRICS.observe("serve.queue_ms", queue_ms, exemplar=request.request_id)
+        # the SLO canary's known-answer probes stay OUT of the base latency
+        # series (user-facing latency SLOs read serve.request_ms) but keep
+        # their own labeled series + cost row below — "billed under the
+        # reserved tenant, excluded from user-facing SLOs"
+        if request.tenant != telemetry.CANARY_TENANT:
+            METRICS.observe("serve.request_ms", request_ms, exemplar=request.request_id)
+            METRICS.observe("serve.queue_ms", queue_ms, exemplar=request.request_id)
         if request.tenant is not None:
             # the tenant axis: a labeled latency series on /metrics plus a
             # cost-ledger row. The raw tag is client-supplied, so it goes

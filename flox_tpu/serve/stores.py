@@ -50,9 +50,11 @@ __all__ = [
     "compact",
     "debug_table",
     "list_stores",
+    "publish_staleness",
     "query",
     "resolve",
     "restage_all",
+    "staleness_by_store",
     "stores_stats",
 ]
 
@@ -80,12 +82,18 @@ class StoreEntry:
     """One open store: the durable store object + the device-side finalized
     result cache (generation-keyed)."""
 
-    __slots__ = ("name", "store", "opened", "dev", "dev_gen", "dev_key", "lock")
+    __slots__ = (
+        "name", "store", "opened", "last_ack", "dev", "dev_gen", "dev_key", "lock",
+    )
 
     def __init__(self, name: str, store: IncrementalAggregationStore) -> None:
         self.name = name
         self.store = store
         self.opened = time.time()
+        # the freshness-SLO signal: wall time of the last acked append
+        # (open counts as the epoch — a just-recovered store is as fresh
+        # as its recovery, not as stale as its history)
+        self.last_ack = self.opened
         self.dev: dict | None = None
         self.dev_gen = -1
         self.dev_key: tuple = ()
@@ -94,6 +102,7 @@ class StoreEntry:
     def info(self) -> dict:
         d = self.store.info()
         d["device_cached"] = self.dev is not None
+        d["staleness_s"] = round(max(0.0, time.time() - self.last_ack), 3)
         return d
 
 
@@ -171,6 +180,7 @@ def append(
     except StoreCorruptionError as exc:
         telemetry.record_serve_error(exc, what=f"store append {name}")
         raise StoreCorruptedError(str(exc)) from exc
+    entry.last_ack = time.time()
     telemetry.observe_cost(
         store_program_label("append", entry.store.funcs),
         dataset=name,
@@ -256,6 +266,24 @@ def stores_stats() -> dict:
             "state_bytes": sum(i["nbytes"] for i in infos),
             "device_cached": sum(1 for e in entries if e.dev is not None),
         }
+
+
+def staleness_by_store(now: float | None = None) -> dict[str, float]:
+    """Seconds since each OPEN store's last acked append (its ``last_ack``
+    epoch is the open itself until an append lands) — the raw freshness-SLO
+    signal ``flox_tpu.slo`` ticks per evaluation. ``now`` lets the SLO
+    plane's injected clock drive the math in tests."""
+    t = time.time() if now is None else float(now)
+    with _LOCK:
+        return {e.name: max(0.0, t - e.last_ack) for e in _STORE_TABLE.values()}
+
+
+def publish_staleness(now: float | None = None) -> None:
+    """Publish per-store ``store.staleness_s|store=<name>`` gauges — called
+    by the saturation sampler between requests, so an idle replica's stores
+    visibly age on /metrics instead of freezing at their last append."""
+    for name, stale_s in staleness_by_store(now).items():
+        METRICS.set_gauge(f"store.staleness_s|store={name}", round(stale_s, 3))
 
 
 def debug_table(top: int | None = None) -> dict:

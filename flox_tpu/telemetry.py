@@ -88,9 +88,11 @@ from collections import deque
 from typing import Any, Iterable
 
 __all__ = [
+    "CANARY_TENANT",
     "FLIGHT_RECORDER",
     "HIST_EDGES_MS",
     "METRICS",
+    "RESIDENT_GAUGES",
     "SATURATION_GAUGES",
     "MetricsRegistry",
     "anchor_event",
@@ -123,6 +125,7 @@ __all__ = [
     "replica_instance",
     "reset",
     "sample_hbm",
+    "sample_resident_state",
     "sample_saturation",
     "seed_hbm_limit",
     "seed_saturation_gauges",
@@ -893,6 +896,18 @@ def _breaker_snapshot() -> dict:
         return {}
 
 
+def _alert_snapshot() -> dict:
+    """Current SLO alert state for the flight-dump header (same lazy
+    guarded contract as :func:`_breaker_snapshot`: a dump must succeed on
+    a process that never evaluated an objective)."""
+    try:
+        from . import slo
+
+        return slo.alert_snapshot()
+    except Exception:  # noqa: BLE001 — forensics are best-effort by contract
+        return {}
+
+
 def flight_dump(path: Any = None, reason: str = "") -> str | None:
     """Dump the flight-recorder ring atomically as JSON-lines.
 
@@ -931,6 +946,10 @@ def flight_dump(path: Any = None, reason: str = "") -> str | None:
                 # breaker open, was the queue building — need the live
                 # state, not an inference from record archaeology
                 "breakers": _breaker_snapshot(),
+                # ...and since PR 19, whether an SLO alert was already
+                # pending/firing when the dump fired — "was this crash
+                # the incident or a symptom of one" in one line
+                "alerts": _alert_snapshot(),
                 "saturation": {
                     name: METRICS.get(name) for name in SATURATION_GAUGES
                 },
@@ -941,10 +960,10 @@ def flight_dump(path: Any = None, reason: str = "") -> str | None:
         if parent:
             os.makedirs(parent, exist_ok=True)
         tmp = f"{path}.tmp.{_PID}"
-        with open(tmp, "w") as f:
+        with open(tmp, "w") as f:  # noqa: FLX015 — page-transition forensic dump: rare by design, and losing the loop for one write beats losing the evidence
             for rec in [header, *records, _counters_record()]:
                 f.write(json.dumps(rec, default=str) + "\n")
-        os.replace(tmp, path)
+        os.replace(tmp, path)  # noqa: FLX015 — atomic publish of the dump above; same rare page-transition path
         return path
     except Exception as exc:  # noqa: BLE001 — dumping is best-effort by contract
         import logging
@@ -1099,10 +1118,15 @@ def cost_by_program() -> dict[str, dict]:
     return _ledger_axis("program")
 
 
-def cost_by_tenant() -> dict[str, dict]:
+def cost_by_tenant(include_canary: bool = False) -> dict[str, dict]:
     """The per-tenant cost ledger (a locked copy; populated only by serve
-    requests that carry a ``tenant`` tag)."""
-    return _ledger_axis("tenant")
+    requests that carry a ``tenant`` tag). The reserved canary tenant's
+    row is synthetic traffic, dropped from the user-facing default view
+    (``include_canary=True`` keeps it — the raw ledger is never lossy)."""
+    rows = _ledger_axis("tenant")
+    if not include_canary:
+        rows.pop(CANARY_TENANT, None)
+    return rows
 
 
 def cost_by_dataset() -> dict[str, dict]:
@@ -1124,6 +1148,13 @@ _TENANT_MAX = 64
 #: the registry's ``|key=value`` separator, newlines) into the exposition
 _TENANT_UNSAFE = re.compile(r"[^A-Za-z0-9_.:\-]")
 
+#: the reserved tenant the SLO plane's canary prober bills its known-answer
+#: requests under. Always resolvable as a label but NEVER admitted into
+#: :data:`_TENANT_LABELS` (synthetic traffic must not consume one of the
+#: :data:`_TENANT_MAX` real-tenant cardinality slots) and filtered out of
+#: user-facing surfaces (``cost_by_tenant`` rows, base latency histograms).
+CANARY_TENANT = "__canary__"
+
 
 def tenant_label(tenant: Any, register: bool = True) -> str:
     """The sanitized, cardinality-bounded label for a client tenant tag.
@@ -1136,6 +1167,9 @@ def tenant_label(tenant: Any, register: bool = True) -> str:
     admitting a new label — read-side callers (the ``/debug/costs``
     ``?tenant=`` filter) must not burn cardinality slots on lookups."""
     label = _TENANT_UNSAFE.sub("_", str(tenant))[:64] or "_"
+    if label == CANARY_TENANT:
+        # the reserved canary tenant never occupies a cardinality slot
+        return label
     with _RECORDS_LOCK:
         if label in _TENANT_LABELS:
             return label
@@ -1224,6 +1258,21 @@ SATURATION_GAUGES: tuple[str, ...] = (
     "stream.prefetch_occupancy",
 )
 
+#: resident-state gauges (dataset registry occupancy + store footprint)
+#: the sampler also publishes between requests — freshness SLOs need a
+#: staleness signal on an IDLE replica, exactly when no append is
+#: refreshing the store gauges. Seeded with the saturation gauges; the
+#: per-store ``store.staleness_s|store=`` series are labeled (dynamic)
+#: and appear with the first sample instead.
+RESIDENT_GAUGES: tuple[str, ...] = (
+    "registry.bytes",
+    "registry.pinned_bytes",
+    "registry.budget_bytes",
+    "registry.occupancy",
+    "store.open_stores",
+    "store.state_bytes",
+)
+
 _SAMPLER_LOCK = threading.Lock()
 _SAMPLER_STATE: dict[str, Any] = {"thread": None, "stop": None}
 
@@ -1236,7 +1285,7 @@ def seed_saturation_gauges() -> None:
     if not enabled():
         return
     live = METRICS.gauges()
-    for name in SATURATION_GAUGES:
+    for name in (*SATURATION_GAUGES, *RESIDENT_GAUGES):
         if name not in live:
             METRICS.set_gauge(name, 0)
 
@@ -1270,7 +1319,42 @@ def sample_saturation() -> None:
         METRICS.set_gauge("stream.prefetch_occupancy", prefetch_occupancy())
     except Exception:  # noqa: BLE001
         pass
+    sample_resident_state()
     sample_hbm()
+
+
+def sample_resident_state() -> None:
+    """One sample of the resident-state gauges: dataset-registry occupancy
+    against its HBM budget and per-store append staleness.
+
+    Resident state (PR 17 datasets, PR 18 stores) outlives any request, so
+    its health is invisible to the request histograms by construction —
+    this is the between-requests signal the freshness SLO and the fleet
+    resident-state columns read. Never raises (sampler contract); each
+    source is guarded separately so a serve plane that never imported
+    cannot block the other's gauges."""
+    if not enabled():
+        return
+    try:
+        from .serve.registry import budget_bytes, registry_stats
+
+        budget = float(budget_bytes())
+        stats = registry_stats()
+        METRICS.set_gauge("registry.bytes", float(stats["bytes"]))
+        METRICS.set_gauge("registry.pinned_bytes", float(stats["pinned_bytes"]))
+        METRICS.set_gauge("registry.budget_bytes", budget)
+        METRICS.set_gauge(
+            "registry.occupancy",
+            round(float(stats["bytes"]) / budget, 4) if budget > 0 else 0.0,
+        )
+    except Exception:  # noqa: BLE001 — sampling must never take serving down
+        pass
+    try:
+        from .serve import stores as serve_stores
+
+        serve_stores.publish_staleness()
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def start_saturation_sampler(interval: float | None = None) -> bool:
@@ -1536,6 +1620,11 @@ def reset() -> None:
 
     _CARD_REGISTRY.clear()
     _CARD_LABELS.clear()
+    # the SLO plane judges the counters being dropped; alert state and
+    # burn-rate window snapshots must not outlive their evidence
+    from . import slo
+
+    slo.clear()
 
 
 def _counters_record() -> dict:
@@ -1846,6 +1935,30 @@ def _report_lines(path: str, histograms: bool = False) -> list[str]:
             f"{row['mean_ms']:>10.3f} {row['p50_ms']:>10.3f} "
             f"{row['p99_ms']:>10.3f} {row['max_ms']:>10.2f}  {trace_col[:24]}"
         )
+    # the SLO plane's series get their own section instead of being
+    # buried in (or silently dropped from) the generic counter list: a
+    # post-mortem reader's first question about an exported incident is
+    # "what was alerting", not "what was counting"
+    slo_rows = {
+        name: counters[name]
+        for name in sorted(counters or {})
+        if name.partition("|")[0].startswith(("slo.", "alert.", "canary."))
+    }
+    transitions = [
+        r
+        for r in records
+        if r.get("type") == "event"
+        and str(r.get("name", "")).startswith(("alert-", "canary-", "slo-"))
+    ]
+    if slo_rows or transitions:
+        lines += ["", "slo / alert plane:"]
+        for name, value in slo_rows.items():
+            shown = f"{value:.4f}" if isinstance(value, float) and value % 1 else f"{int(value)}"
+            lines.append(f"  {name:<40} {shown:>14}")
+        for rec in transitions[-12:]:
+            attrs = rec.get("attrs") or {}
+            detail = ", ".join(f"{k}={attrs[k]}" for k in sorted(attrs) if k != "trace_id")
+            lines.append(f"  event {rec['name']:<20} {detail[:80]}")
     if histograms:
         lines += ["", "histograms (registry, log-spaced buckets):"]
         if not hists:
@@ -2045,6 +2158,59 @@ def _drift_lines(report: dict) -> list[str]:
     return lines
 
 
+def _load_slo(path: str | None) -> tuple[dict, str | None]:
+    """(``/slo`` payload, replica stamp) — from a scrape file, or a fresh
+    live evaluation when no file is given."""
+    if path is None:
+        from . import slo
+
+        return slo.evaluate(), None
+    with open(path) as f:
+        payload = json.load(f)
+    if not isinstance(payload, dict) or "objectives" not in payload:
+        raise ValueError(f"{path}: expected a /slo JSON payload with 'objectives'")
+    return payload, payload.get("replica")
+
+
+def _slo_lines(payload: dict, source: str = "live process") -> list[str]:
+    """The ``slo`` CLI table: one row per (objective, window rule) with
+    burn rates against thresholds, then the alert rows — the operator's
+    terminal answer to "are we in or out of budget, and is it paging"."""
+    lines = [
+        f"slo report — {source}"
+        + ("" if payload.get("healthy", True) else "  ** ALERT FIRING **"),
+        "",
+        f"{'objective':<22} {'kind':<13} {'target':>8} {'budget':>8} "
+        f"{'window':<8} {'sev':<7} {'burn s':>9} {'burn l':>9} {'thresh':>7}  state",
+        "-" * 110,
+    ]
+    for obj in payload.get("objectives", []):
+        for i, win in enumerate(obj.get("windows", [])):
+            lead = obj["name"] if i == 0 else ""
+            kind = obj.get("kind", "?") if i == 0 else ""
+            target = f"{obj.get('target', 0):.4g}" if i == 0 else ""
+            budget = f"{obj.get('budget_remaining', 0):.3f}" if i == 0 else ""
+            lines.append(
+                f"{lead[:22]:<22} {kind:<13} {target:>8} {budget:>8} "
+                f"{str(win.get('window', '?'))[:8]:<8} {str(win.get('severity', '?')):<7} "
+                f"{float(win.get('burn_short', 0)):>9.2f} "
+                f"{float(win.get('burn_long', 0)):>9.2f} "
+                f"{float(win.get('burn_threshold', 0)):>7.1f}  "
+                f"{'BREACH' if win.get('breach') else 'ok'}"
+            )
+    alerts = payload.get("alerts") or []
+    lines += ["", f"alerts ({len(alerts)}):"]
+    if not alerts:
+        lines.append("  (none — state machine clean)")
+    for a in alerts:
+        lines.append(
+            f"  {a.get('state', '?'):<9} {a.get('severity', '?'):<7} "
+            f"{a.get('objective', '?')}/{a.get('window', '?')}  "
+            f"burn {float(a.get('burn_short', 0)):.2f}/{float(a.get('burn_long', 0)):.2f}"
+        )
+    return lines
+
+
 def _fmt_bytes(value: Any) -> str:
     value = float(value or 0.0)
     if value <= 0:
@@ -2109,6 +2275,15 @@ def main(argv: list[str] | None = None) -> int:
         help="drift ratio that flags a program (default: "
         "OPTIONS['costmodel_drift_threshold'])",
     )
+    slo_cmd = sub.add_parser(
+        "slo",
+        help="SLO burn-rate + alert-state table — reads a /slo JSON scrape, "
+        "or evaluates the live in-process objectives when no file is given",
+    )
+    slo_cmd.add_argument(
+        "file", nargs="?", default=None,
+        help="a /slo JSON scrape (default: evaluate the live objectives)",
+    )
     srv = sub.add_parser(
         "serve-metrics",
         help="standalone /metrics + /healthz + /readyz HTTP endpoint "
@@ -2159,6 +2334,20 @@ def main(argv: list[str] | None = None) -> int:
             parser.error(f"{args.file} is not a readable program-card export: {exc}")
         print("\n".join(lines))
         return 0
+    if args.command == "slo":
+        try:
+            payload, replica = _load_slo(args.file)
+            source = args.file or "live process"
+            if replica:
+                source = f"{source} (replica {replica})"
+        except OSError as exc:
+            parser.error(f"cannot read {args.file}: {exc}")
+        except (ValueError, KeyError, TypeError, AttributeError) as exc:
+            parser.error(f"{args.file} is not a readable /slo export: {exc}")
+        print("\n".join(_slo_lines(payload, source=source)))
+        # exit 2 while an alert is firing — scriptable like `programs
+        # --drift`, so a canary deploy gate is one CLI call
+        return 0 if payload.get("healthy", True) else 2
     if args.command == "serve-metrics":
         # a process whose only job is to be scraped (smoke tests,
         # sidecars): telemetry forced on (an endpoint over a dead registry

@@ -1,0 +1,619 @@
+"""SLO & canary plane test suite (ISSUE 19).
+
+The contract under test: operators declare latency / availability /
+correctness / freshness objectives in a validated spec (JSON/TOML via
+``OPTIONS["slo_path"]``, built-in defaults otherwise); ``slo.evaluate``
+runs Google-SRE multi-window multi-burn-rate math over the always-on
+metrics registry and walks a pending → firing → resolved alert state
+machine (a page-severity fire triggers a flight dump + capture hint);
+the background canary prober issues known-answer requests billed under
+the reserved ``__canary__`` tenant — excluded from every user-facing
+SLO — and a silently wrong answer burns the correctness budget while
+availability correctly reads the replica as up. All of it is
+deterministic under ``faults.slo_inject`` and none of it changes
+results.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import flox_tpu
+from flox_tpu import cache, exposition, faults, fleet, slo, telemetry
+from flox_tpu.core import groupby_reduce
+from flox_tpu.telemetry import METRICS
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Each test starts with telemetry OFF, an empty SLO plane, and no
+    flight path — even under the CI instrumented leg."""
+    with flox_tpu.set_options(
+        telemetry=False, telemetry_export_path=None, flight_recorder_path=None,
+        slo_path=None,
+    ):
+        cache.clear_all()  # stores/registry/SLO state must not leak across tests
+        telemetry.reset()
+        exposition.set_ready(False)
+        yield
+        cache.clear_all()
+        telemetry.reset()
+    exposition.stop_metrics_server()
+    exposition.set_ready(False)
+
+
+def _submit_canary_cycle(cycle=1):
+    from flox_tpu.serve import Dispatcher
+
+    async def go():
+        dispatcher = Dispatcher()
+        verdicts = await slo.canary_cycle(dispatcher, cycle)
+        await dispatcher.close()
+        return verdicts
+
+    return asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# spec loading + validation
+# ---------------------------------------------------------------------------
+
+
+class TestSpec:
+    def test_defaults_when_no_path(self):
+        spec = slo.load_spec()
+        names = [o["name"] for o in spec["objectives"]]
+        assert names == ["latency", "availability", "correctness", "freshness"]
+        assert [w["name"] for w in spec["windows"]] == ["fast", "slow"]
+        fast = spec["windows"][0]
+        assert (fast["short_s"], fast["long_s"], fast["burn_rate"]) == (
+            300.0, 3600.0, 14.4,
+        )
+        assert fast["severity"] == "page"
+
+    def test_json_path_roundtrip(self, tmp_path):
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps({
+            "objectives": [
+                {"name": "avail", "kind": "availability", "target": 0.99},
+            ],
+            "windows": [
+                {"name": "only", "short_s": 60, "long_s": 600,
+                 "burn_rate": 2.0, "severity": "page"},
+            ],
+        }))
+        with flox_tpu.set_options(slo_path=str(p)):
+            spec = slo.load_spec(force=True)
+        assert spec["objectives"][0]["name"] == "avail"
+        assert spec["windows"][0]["burn_rate"] == 2.0
+
+    def test_toml_path(self, tmp_path):
+        p = tmp_path / "slo.toml"
+        p.write_text(
+            "[[objectives]]\n"
+            'name = "lat"\nkind = "latency"\ntarget = 0.95\nthreshold_ms = 50.0\n'
+            "[[windows]]\n"
+            'name = "w"\nshort_s = 60.0\nlong_s = 600.0\nburn_rate = 1.0\n'
+        )
+        try:
+            spec = slo.load_spec(str(p), force=True)
+        except ValueError as exc:
+            # gated on interpreters without a TOML parser (< 3.11, no
+            # tomli): the failure must be a clear spec error, not a bare
+            # ModuleNotFoundError
+            assert "TOML" in str(exc)
+            return
+        assert spec["objectives"][0]["threshold_ms"] == 50.0
+        assert spec["windows"][0]["severity"] == "ticket"  # the default
+
+    @pytest.mark.parametrize("bad", [
+        {"objectives": []},
+        {"objectives": [{"name": "x", "kind": "nope", "target": 0.9}]},
+        {"objectives": [{"name": "x", "kind": "availability", "target": 1.5}]},
+        {"objectives": [{"name": "a|b", "kind": "availability", "target": 0.9}]},
+        {"objectives": [{"name": "x", "kind": "latency", "target": 0.9}]},  # no threshold
+        {"objectives": [{"name": "x", "kind": "freshness", "target": 0.9}]},  # no staleness
+        {"objectives": [{"name": "x", "kind": "availability", "target": 0.9,
+                         "typo_key": 1}]},
+        {"objectives": [{"name": "x", "kind": "availability", "target": 0.9}],
+         "windows": [{"name": "w", "short_s": 600, "long_s": 60, "burn_rate": 1}]},
+        {"objectives": [{"name": "x", "kind": "availability", "target": 0.9}],
+         "surprise": True},
+    ])
+    def test_invalid_specs_raise(self, bad):
+        with pytest.raises(ValueError, match="invalid SLO spec"):
+            slo.validate_spec(bad)
+
+    def test_unreadable_path_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="cannot read"):
+            slo.load_spec(str(tmp_path / "missing.json"), force=True)
+        garbage = tmp_path / "garbage.json"
+        garbage.write_text("{nope")
+        with pytest.raises(ValueError, match="cannot parse"):
+            slo.load_spec(str(garbage), force=True)
+
+    def test_per_objective_windows_override(self):
+        spec = slo.validate_spec({
+            "objectives": [{
+                "name": "x", "kind": "availability", "target": 0.9,
+                "windows": [{"name": "own", "short_s": 10, "long_s": 100,
+                             "burn_rate": 3.0}],
+            }],
+        })
+        assert spec["objectives"][0]["windows"][0]["name"] == "own"
+        # the global windows stay the defaults
+        assert [w["name"] for w in spec["windows"]] == ["fast", "slow"]
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math + the alert state machine (deterministic via slo_inject)
+# ---------------------------------------------------------------------------
+
+
+class TestAlertLifecycle:
+    def test_pending_firing_resolved(self, tmp_path):
+        dump = tmp_path / "flight.jsonl"
+        with flox_tpu.set_options(
+            telemetry=True, flight_recorder_path=str(dump)
+        ):
+            with faults.slo_inject(clock0=1000.0) as plan:
+                payload = slo.evaluate()
+                assert payload["healthy"] is True  # idle baseline never pages
+                plan.burst("availability", bad=500)
+                plan.advance(60)
+                payload = slo.evaluate()
+                rows = {(a["objective"], a["window"]): a for a in payload["alerts"]}
+                assert rows[("availability", "fast")]["state"] == "pending"
+                assert payload["healthy"] is True  # pending is not yet an operator's problem
+                plan.advance(60)
+                payload = slo.evaluate()
+                rows = {(a["objective"], a["window"]): a for a in payload["alerts"]}
+                fast = rows[("availability", "fast")]
+                assert fast["state"] == "firing" and fast["severity"] == "page"
+                assert rows[("availability", "slow")]["state"] == "firing"
+                assert payload["healthy"] is False
+                obj = next(
+                    o for o in payload["objectives"] if o["name"] == "availability"
+                )
+                assert obj["healthy"] is False
+                # 100% bad traffic burns at 1/(1-0.999) = 1000x the budget
+                assert fast["burn_short"] > 14.4
+                assert obj["budget_remaining"] < 0
+                assert METRICS.get("alert.pages") == 1
+                assert METRICS.get("alert.fired") == 2
+                # the page left its forensic record before any operator arrived
+                assert dump.exists()
+                events = [r.get("name") for r in telemetry.FLIGHT_RECORDER.records()]
+                assert "alert-firing" in events and "capture-hint" in events
+            # plan uninstalled: injected events vanish, deltas clamp to 0
+            # burn — the incident is over and the alerts must resolve
+            payload = slo.evaluate()
+            assert payload["healthy"] is True
+            assert all(a["state"] == "resolved" for a in payload["alerts"])
+            assert METRICS.get("alert.resolved_total") == 2
+
+    def test_one_evaluation_blip_never_fires(self):
+        with faults.slo_inject(clock0=1000.0) as plan:
+            slo.evaluate()
+            plan.burst("availability", bad=50)
+            plan.advance(60)
+            payload = slo.evaluate()
+            assert any(a["state"] == "pending" for a in payload["alerts"])
+        # breach gone before the pending confirmed: the row is dropped,
+        # not resolved — a blip never reaches an operator
+        payload = slo.evaluate()
+        assert payload["alerts"] == []
+        assert METRICS.get("alert.fired") == 0
+
+    def test_breach_requires_both_windows(self):
+        # a burst entirely OLDER than the short window must not page:
+        # burn_long is high but burn_short reads a quiet recent window
+        with faults.slo_inject(clock0=1000.0) as plan:
+            slo.evaluate()
+            plan.burst("availability", bad=500)
+            plan.advance(60)
+            slo.evaluate()
+            # stop burning; walk past the fast rule's short window (300s)
+            plan.advance(400)
+            slo.evaluate()
+            payload = slo.evaluate()
+            rows = {(a["objective"], a["window"]): a for a in payload["alerts"]}
+            fast = rows.get(("availability", "fast"))
+            assert fast is None or fast["state"] != "firing"
+
+
+# ---------------------------------------------------------------------------
+# SLI collectors
+# ---------------------------------------------------------------------------
+
+
+class TestCollectors:
+    def test_latency_buckets_split_on_threshold(self):
+        METRICS.observe("serve.request_ms", 5.0)       # <= 250ms: good
+        METRICS.observe("serve.request_ms", 4000.0)    # > 250ms: bad
+        payload = slo.evaluate()
+        lat = next(o for o in payload["objectives"] if o["kind"] == "latency")
+        assert (lat["good"], lat["bad"]) == (1.0, 1.0)
+
+    def test_availability_taxonomy_excludes_drain_and_protocol(self):
+        METRICS.inc("serve.requests", 10)
+        METRICS.inc("serve.shed", 2)
+        METRICS.inc("serve.drain_rejected", 5)   # planned: not a burn
+        METRICS.inc("serve.protocol_errors", 3)  # caller's bug: not a burn
+        payload = slo.evaluate()
+        avail = next(o for o in payload["objectives"] if o["kind"] == "availability")
+        assert (avail["good"], avail["bad"]) == (8.0, 2.0)
+
+    def test_freshness_ticks_from_store_staleness(self, tmp_path):
+        from flox_tpu.serve import stores as serve_stores
+
+        spec_path = tmp_path / "slo.json"
+        spec_path.write_text(json.dumps({
+            "objectives": [{"name": "fresh", "kind": "freshness",
+                            "target": 0.9, "max_staleness_s": 100.0}],
+        }))
+        with flox_tpu.set_options(
+            store_root=str(tmp_path / "stores"), slo_path=str(spec_path)
+        ):
+            serve_stores.append(
+                "user-store", np.array([0, 1]), np.array([1.0, 2.0]),
+                slab_id="s0", create={"funcs": ["sum"], "size": 2},
+            )
+            serve_stores.append(
+                slo.CANARY_STORE, np.array([0, 1]), np.array([1.0, 2.0]),
+                slab_id="s0", create={"funcs": ["sum"], "size": 2},
+            )
+            payload = slo.evaluate()
+            fresh = next(o for o in payload["objectives"] if o["name"] == "fresh")
+            # both stores just appended: one good tick (canary excluded)
+            assert (fresh["good"], fresh["bad"]) == (1.0, 0.0)
+            # backdate BOTH stores past the staleness budget
+            for entry in serve_stores._STORE_TABLE.values():
+                entry.last_ack -= 1000.0
+            payload = slo.evaluate()
+            fresh = next(o for o in payload["objectives"] if o["name"] == "fresh")
+            # exactly one bad tick accrued: the canary store stayed excluded
+            assert (fresh["good"], fresh["bad"]) == (1.0, 1.0)
+
+    def test_staleness_gauges_published(self, tmp_path):
+        from flox_tpu.serve import stores as serve_stores
+
+        with flox_tpu.set_options(store_root=str(tmp_path)):
+            serve_stores.append(
+                "gauged", np.array([0]), np.array([1.0]),
+                slab_id="s0", create={"funcs": ["sum"], "size": 1},
+            )
+            telemetry.sample_resident_state()
+            assert METRICS.get("store.staleness_s|store=gauged") >= 0.0
+            assert METRICS.get("store.open_stores") >= 1.0
+
+
+# ---------------------------------------------------------------------------
+# the canary prober + reserved-tenant exclusion (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestCanary:
+    def test_cycle_all_green_without_store_root(self):
+        with flox_tpu.set_options(telemetry=True):
+            verdicts = _submit_canary_cycle()
+        assert verdicts["reduce"] is True
+        assert verdicts["multistat"] is True
+        assert verdicts["dataset"] is True
+        assert verdicts["store"] is None  # skipped: no store root
+        assert METRICS.get("canary.ok") == 3.0
+        assert METRICS.get("canary.failures") == 0.0
+
+    def test_store_probe_roundtrips(self, tmp_path):
+        with flox_tpu.set_options(telemetry=True, store_root=str(tmp_path)):
+            verdicts = _submit_canary_cycle()
+            assert verdicts["store"] is True
+            # the constant slab id makes cycle 2 an exactly-once replay
+            verdicts = _submit_canary_cycle(cycle=2)
+            assert verdicts["store"] is True
+
+    def test_canary_billed_outside_user_slos(self):
+        with flox_tpu.set_options(telemetry=True):
+            _submit_canary_cycle()
+            # canary traffic counts under canary.requests, never the
+            # availability denominator
+            assert METRICS.get("serve.requests") == 0.0
+            assert METRICS.get("canary.requests") == 3.0
+            # no user-facing cost row: the ledger hides the reserved tenant
+            assert slo.CANARY_TENANT not in telemetry.cost_by_tenant()
+            assert slo.CANARY_TENANT in telemetry.cost_by_tenant(
+                include_canary=True
+            )
+            # the base request histogram saw nothing
+            hist = METRICS.histograms().get("serve.request_ms")
+            assert hist is None or hist["count"] == 0
+
+    def test_canary_never_consumes_a_tenant_slot(self):
+        with flox_tpu.set_options(telemetry=True):
+            for i in range(telemetry._TENANT_MAX):
+                telemetry.tenant_label(f"t{i}")
+            # the table is full; real new tenants fold into _other but the
+            # reserved canary label keeps resolving to itself
+            assert telemetry.tenant_label("newcomer") == "_other"
+            assert telemetry.tenant_label(slo.CANARY_TENANT) == slo.CANARY_TENANT
+            assert slo.CANARY_TENANT not in telemetry._TENANT_LABELS
+
+    def test_injected_wrong_answer_burns_correctness_not_availability(self):
+        with flox_tpu.set_options(telemetry=True):
+            with faults.slo_inject(corrupt_canary={"reduce": 1}):
+                verdicts = _submit_canary_cycle()
+            assert verdicts["reduce"] is False
+            assert METRICS.get("canary.failures") == 1.0
+            assert METRICS.get("canary.failures|op=reduce") == 1.0
+            payload = slo.evaluate()
+            correctness = next(
+                o for o in payload["objectives"] if o["kind"] == "correctness"
+            )
+            availability = next(
+                o for o in payload["objectives"] if o["kind"] == "availability"
+            )
+            assert correctness["bad"] == 1.0
+            # the replica answered every request: availability saw NOTHING
+            assert (availability["good"], availability["bad"]) == (0.0, 0.0)
+            events = [r.get("name") for r in telemetry.FLIGHT_RECORDER.records()]
+            assert "canary-failure" in events
+
+    def test_wildcard_corruption_hits_every_op(self):
+        with flox_tpu.set_options(telemetry=True):
+            with faults.slo_inject(corrupt_canary={"*": -1}):
+                verdicts = _submit_canary_cycle()
+            assert verdicts["reduce"] is False
+            assert verdicts["multistat"] is False
+            assert verdicts["dataset"] is False
+
+
+# ---------------------------------------------------------------------------
+# surfaces: endpoints, CLI, report, flight-dump header, cache panels
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def _get(self, port, path):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        )
+
+    def test_slo_and_alerts_endpoints(self):
+        with flox_tpu.set_options(telemetry=True):
+            port = exposition.start_metrics_server(port=0)
+            resp = self._get(port, "/slo")
+            assert resp.status == 200
+            payload = json.loads(resp.read())
+            assert payload["healthy"] is True
+            assert {o["kind"] for o in payload["objectives"]} == {
+                "latency", "availability", "correctness", "freshness",
+            }
+            assert "replica" in payload
+            resp = self._get(port, "/alerts")
+            body = json.loads(resp.read())
+            assert body["alerts"] == [] and body["healthy"] is True
+            # seeding published the gauges before any scrape-side math
+            assert METRICS.get("slo.objectives") == 4.0
+
+    def test_bad_spec_is_a_500_not_a_silent_pass(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"objectives": [{"name": "x"}]}))
+        with flox_tpu.set_options(telemetry=True, slo_path=str(bad)):
+            port = exposition.start_metrics_server(port=0)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._get(port, "/slo")
+            assert err.value.code == 500
+            assert "invalid SLO spec" in json.loads(err.value.read())["error"]
+            # server start survived the bad spec, loudly
+            assert METRICS.get("slo.spec_errors") >= 1.0
+
+    def test_cli_exit_codes_gate_deploys(self, capsys):
+        assert telemetry.main(["slo"]) == 0
+        out = capsys.readouterr().out
+        assert "availability" in out and "burn" in out
+        with faults.slo_inject(clock0=1000.0) as plan:
+            slo.evaluate()
+            plan.burst("availability", bad=500)
+            plan.advance(60)
+            slo.evaluate()
+            plan.advance(60)
+            assert telemetry.main(["slo"]) == 2  # firing = deploy gate shut
+            out = capsys.readouterr().out
+            assert "FIRING" in out.upper()
+
+    def test_cli_reads_slo_scrape_file(self, tmp_path, capsys):
+        payload = slo.evaluate()
+        p = tmp_path / "scrape.json"
+        p.write_text(json.dumps(payload))
+        assert telemetry.main(["slo", str(p)]) == 0
+        assert "availability" in capsys.readouterr().out
+
+    def test_flight_dump_header_and_report_carry_alert_state(
+        self, tmp_path, capsys
+    ):
+        dump = tmp_path / "flight.jsonl"
+        with flox_tpu.set_options(
+            telemetry=True, flight_recorder_path=str(dump)
+        ):
+            with faults.slo_inject(clock0=1000.0) as plan:
+                slo.evaluate()
+                plan.burst("availability", bad=500)
+                plan.advance(60)
+                slo.evaluate()
+                plan.advance(60)
+                slo.evaluate()  # fires the page -> dumps the flight ring
+                header = json.loads(dump.read_text().splitlines()[0])
+                snap = header["attrs"]["alerts"]
+                assert "availability/fast[page]" in snap["firing"]
+        assert telemetry.main(["report", str(dump)]) == 0
+        out = capsys.readouterr().out
+        assert "slo / alert plane:" in out
+        assert "alert-firing" in out
+
+    def test_cache_stats_panel_and_clear_all(self):
+        with faults.slo_inject(clock0=1000.0) as plan:
+            slo.evaluate()
+            plan.burst("availability", bad=500)
+            plan.advance(60)
+            slo.evaluate()
+            plan.advance(60)
+            slo.evaluate()
+            panel = cache.stats()["slo"]
+            assert panel["alerts"]["firing"] == 2
+            assert panel["snapshots"] == 3
+            cache.clear_all()
+            assert slo.alerts() == []
+            assert slo.slo_stats()["snapshots"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet federation (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def _snapshot(name, *, datasets=(), stores=(), slo_payload=None):
+    snap = fleet.ReplicaSnapshot(name=name, url=f"http://h/{name}", ok=True)
+    snap.metrics = {"counters": {}, "gauges": {}, "histograms": {}, "replica": name}
+    snap.datasets = {"datasets": list(datasets)}
+    snap.stores = {"stores": list(stores)}
+    snap.slo = slo_payload or {}
+    snap.alerts = list((slo_payload or {}).get("alerts") or [])
+    return snap
+
+
+class TestFleetFederation:
+    def test_resident_state_and_alerts_federate(self):
+        s1 = _snapshot(
+            "r1",
+            datasets=[{"name": "ds", "nbytes": 100, "pins": 1, "hits": 7}],
+            stores=[{"store": "st", "gen": 3, "nbytes": 50, "staleness_s": 12.0}],
+            slo_payload={
+                "healthy": False,
+                "objectives": [{"name": "availability", "kind": "availability",
+                                "healthy": False, "budget_remaining": -1.0}],
+                "alerts": [{"objective": "availability", "window": "fast",
+                            "severity": "page", "state": "firing",
+                            "burn_short": 20.0, "burn_long": 15.0}],
+            },
+        )
+        s2 = _snapshot(
+            "r2",
+            datasets=[{"name": "ds", "nbytes": 100, "pins": 0, "hits": 2}],
+            stores=[{"store": "st", "gen": 4, "nbytes": 60, "staleness_s": 3.0}],
+            slo_payload={"healthy": True, "objectives": [], "alerts": []},
+        )
+        view = fleet.federate([s1, s2])
+        assert view["datasets"]["ds"]["bytes"] == 200
+        assert view["datasets"]["ds"]["replicas"]["r1"]["pins"] == 1
+        assert view["stores"]["st"]["generations"] == {"r1": 3, "r2": 4}
+        assert view["stores"]["st"]["state_bytes"] == 110
+        # the freshest copy speaks for the fleet
+        assert view["stores"]["st"]["staleness_s"] == 3.0
+        assert len(view["alerts"]) == 1
+        assert view["alerts"][0]["replica"] == "r1"
+        assert view["slo"]["r1"]["healthy"] is False
+        assert view["slo"]["r2"]["healthy"] is True
+
+    def test_top_views_carry_resident_and_alert_columns(self):
+        s1 = _snapshot(
+            "r1",
+            datasets=[{"name": "ds", "nbytes": 100, "pins": 1, "hits": 7}],
+            stores=[{"store": "st", "gen": 3, "nbytes": 50, "staleness_s": 12.0}],
+            slo_payload={
+                "healthy": False,
+                "objectives": [],
+                "alerts": [
+                    {"objective": "availability", "window": "fast",
+                     "severity": "page", "state": "firing",
+                     "burn_short": 20.0, "burn_long": 15.0},
+                    {"objective": "availability", "window": "slow",
+                     "severity": "ticket", "state": "pending",
+                     "burn_short": 2.0, "burn_long": 1.5},
+                ],
+            },
+        )
+        view = fleet.federate([s1])
+        frame = fleet.render_top_json(view)
+        row = frame["replicas"][0]
+        assert row["datasets"] == 1 and row["dataset_bytes"] == 100
+        assert row["stores"] == 1 and row["staleness_s"] == 12.0
+        assert row["alerts_firing"] == 1 and row["alerts_pending"] == 1
+        assert row["slo_healthy"] is False
+        assert len(frame["alerts"]) == 2
+        text = fleet.render_top(view)
+        assert "alerts" in text          # the column header
+        assert "1F/1P" in text           # firing/pending cell
+        assert "availability/fast" in text
+
+    def test_dedup_keeps_most_live_state(self):
+        # one replica double-reporting an alert: firing beats resolved
+        s = _snapshot("r1", slo_payload={"healthy": False, "objectives": [], "alerts": []})
+        s.alerts = [
+            {"objective": "o", "window": "w", "severity": "ticket",
+             "state": "resolved"},
+            {"objective": "o", "window": "w", "severity": "page",
+             "state": "firing"},
+        ]
+        view = fleet.federate([s])
+        assert len(view["alerts"]) == 1
+        assert view["alerts"][0]["state"] == "firing"
+
+    def test_federator_endpoints_serve_alerts_and_slo(self):
+        fed = fleet.Federator([], interval=3600)
+        s1 = _snapshot(
+            "r1",
+            slo_payload={
+                "healthy": False,
+                "objectives": [],
+                "alerts": [{"objective": "availability", "window": "fast",
+                            "severity": "page", "state": "firing"}],
+            },
+        )
+        with fed._lock:
+            fed._view = fleet.federate([s1])
+        port = fed.serve(port=0)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/alerts", timeout=5).read())
+            assert body["firing"] == 1 and body["healthy"] is False
+            assert body["alerts"][0]["replica"] == "r1"
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/slo", timeout=5).read())
+            assert body["healthy"] is False
+            assert body["replicas"]["r1"]["healthy"] is False
+        finally:
+            fed.stop()
+
+
+# ---------------------------------------------------------------------------
+# plane neutrality
+# ---------------------------------------------------------------------------
+
+
+class TestPlaneNeutrality:
+    def test_bit_identity_with_slo_plane_enabled(self):
+        vals = np.random.default_rng(3).normal(size=(4, 64)).astype(np.float64)
+        codes = np.arange(64) % 7
+        baseline, _ = groupby_reduce(vals, codes, func="nanmean", engine="jax")
+        with flox_tpu.set_options(telemetry=True):
+            with faults.slo_inject(clock0=1000.0) as plan:
+                slo.evaluate()
+                plan.burst("availability", bad=500)
+                plan.advance(60)
+                slo.evaluate()
+                _submit_canary_cycle()
+                lit, _ = groupby_reduce(vals, codes, func="nanmean", engine="jax")
+        assert np.asarray(baseline).tobytes() == np.asarray(lit).tobytes()
+
+    def test_evaluate_without_serve_layer_is_healthy(self):
+        # a pure-library process (no dispatcher, no stores) evaluates to
+        # a vacuously healthy plane, not an import error
+        payload = slo.evaluate()
+        assert payload["healthy"] is True
+        assert all(o["good"] == 0 and o["bad"] == 0 for o in payload["objectives"])
