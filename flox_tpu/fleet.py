@@ -60,6 +60,27 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
+from .metric_names import (
+    HBM_BYTES_IN_USE,
+    HBM_BYTES_LIMIT,
+    SERVE_BREAKERS_OPEN,
+    SERVE_QUEUE_DEPTH,
+    SERVE_REQUEST_MS,
+    SERVE_REQUESTS,
+    prom_name,
+)
+
+#: the scrape-side spellings of the series the top view reads, derived —
+#: never respelled — from the shared registry names, so the fleet column
+#: and the replica's exposition renderer cannot drift (FLX018 checks the
+#: registry names against the contract's emit table)
+_PROM_REQUESTS_TOTAL = prom_name(SERVE_REQUESTS, counter=True)
+_PROM_REQUEST_MS = prom_name(SERVE_REQUEST_MS)
+_PROM_QUEUE_DEPTH = prom_name(SERVE_QUEUE_DEPTH)
+_PROM_BREAKERS_OPEN = prom_name(SERVE_BREAKERS_OPEN)
+_PROM_HBM_IN_USE = prom_name(HBM_BYTES_IN_USE)
+_PROM_HBM_LIMIT = prom_name(HBM_BYTES_LIMIT)
+
 __all__ = [
     "Federator",
     "FleetMergeError",
@@ -806,17 +827,17 @@ def render_top_json(
             state = row.get("reason") or "not-ready"
         qps = None
         if prev is not None and interval > 0:
-            delta = counter(view, "flox_tpu_serve_requests_total", label) - counter(
-                prev, "flox_tpu_serve_requests_total", label
+            delta = counter(view, _PROM_REQUESTS_TOTAL, label) - counter(
+                prev, _PROM_REQUESTS_TOTAL, label
             )
             qps = round(max(0.0, delta) / interval, 3)
         hist = (
             view.get("histograms", {})
-            .get(("flox_tpu_serve_request_ms", ()), {})
+            .get((_PROM_REQUEST_MS, ()), {})
             .get("replicas", {})
             .get(label)
         )
-        limit = gauge("flox_tpu_hbm_bytes_limit", label)
+        limit = gauge(_PROM_HBM_LIMIT, label)
         ds_rows = [
             slot["replicas"][label]
             for slot in view.get("datasets", {}).values()
@@ -842,9 +863,9 @@ def render_top_json(
                 "qps": qps,
                 "p50_ms": round(_hist_percentile(hist, 0.50), 4) if hist else None,
                 "p99_ms": round(_hist_percentile(hist, 0.99), 4) if hist else None,
-                "queue_depth": int(gauge("flox_tpu_serve_queue_depth", label)),
-                "breakers_open": int(gauge("flox_tpu_serve_breakers_open", label)),
-                "hbm_bytes": gauge("flox_tpu_hbm_bytes_in_use", label),
+                "queue_depth": int(gauge(_PROM_QUEUE_DEPTH, label)),
+                "breakers_open": int(gauge(_PROM_BREAKERS_OPEN, label)),
+                "hbm_bytes": gauge(_PROM_HBM_IN_USE, label),
                 "hbm_bytes_limit": limit or None,
                 "datasets": len(ds_rows),
                 "dataset_bytes": sum(int(r.get("nbytes", 0)) for r in ds_rows),

@@ -1,0 +1,62 @@
+"""Shared metric-name constants: the contract-checked consumer surface.
+
+The registry names the serve plane emits (``telemetry.METRICS``) and the
+Prometheus names scrapers read are two spellings of the same series —
+and until this module, every consumer (the SLO evaluator, the fleet
+federator's top view) respelled them as inline string literals, which is
+exactly how a scrape-name typo ships: the column is silently empty on
+every replica and nothing fails.
+
+Consumers import these constants instead. floxlint's FLX018 resolves
+every constant here against the contract compiler's emit-site table
+(``tools/floxlint/contract.py``), so a name no producer emits is a lint
+error at the definition, not a dead dashboard panel in production.
+
+:func:`prom_name` is the single Prometheus respelling — byte-compatible
+with ``exposition._metric_name`` (``flox_tpu_`` prefix, non-identifier
+characters folded to ``_``, counters suffixed ``_total``): the fleet
+scraper and the exposition renderer cannot disagree on a name.
+"""
+
+from __future__ import annotations
+
+import re
+
+# -- serve request path (counters unless noted) ------------------------------
+
+SERVE_REQUESTS = "serve.requests"
+SERVE_REQUEST_MS = "serve.request_ms"  # histogram
+SERVE_QUEUE_MS = "serve.queue_ms"  # histogram
+SERVE_DEVICE_MS = "serve.device_ms"  # histogram
+SERVE_SHED = "serve.shed"
+SERVE_DEADLINE_EXCEEDED = "serve.deadline_exceeded"
+SERVE_ERRORS = "serve.errors"
+
+# -- resilience (breakers / device loss / watchdog) --------------------------
+
+SERVE_BREAKER_FASTFAIL = "serve.breaker_fastfail"
+SERVE_BREAKERS_OPEN = "serve.breakers_open"  # gauge
+SERVE_DEVICE_LOST = "serve.device_lost"
+SERVE_WATCHDOG_FIRED = "serve.watchdog_fired"
+SERVE_QUEUE_DEPTH = "serve.queue_depth"  # gauge
+
+# -- saturation / residency gauges ------------------------------------------
+
+HBM_BYTES_IN_USE = "hbm.bytes_in_use"  # gauge
+HBM_BYTES_LIMIT = "hbm.bytes_limit"  # gauge
+
+# -- canary probes (slo.py both emits and reads these) -----------------------
+
+CANARY_PROBES = "canary.probes"
+CANARY_OK = "canary.ok"
+CANARY_FAILURES = "canary.failures"
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(name: str, *, counter: bool = False) -> str:
+    """The Prometheus spelling of a registry ``name`` — identical folding
+    to the exposition renderer, so scrape consumers and the renderer can
+    never drift: ``prom_name(SERVE_REQUESTS, counter=True)`` ->
+    ``"flox_tpu_serve_requests_total"``."""
+    return "flox_tpu_" + _NAME_BAD.sub("_", name) + ("_total" if counter else "")

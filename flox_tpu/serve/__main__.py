@@ -384,13 +384,13 @@ async def _amain(args: argparse.Namespace) -> int:
                     )
                 except profiling.CaptureBusyError as exc:
                     _emit({"op": "profile", "ok": False, "error": "busy",
-                           "message": str(exc)})
+                           "code": "busy", "message": str(exc)})
                 except profiling.CaptureUnavailableError as exc:
                     _emit({"op": "profile", "ok": False, "error": "unavailable",
-                           "message": str(exc)})
+                           "code": "unavailable", "message": str(exc)})
                 except (ValueError, TypeError) as exc:
                     _emit({"op": "profile", "ok": False, "error": "protocol",
-                           "message": str(exc)})
+                           "code": "protocol", "message": str(exc)})
                 else:
                     _emit({"op": "profile", "ok": True, "dir": capture_dir})
             elif op == "warmup":
@@ -430,15 +430,37 @@ async def _amain(args: argparse.Namespace) -> int:
             elif op == "del_dataset":
                 from . import registry
 
-                deleted = registry.delete(msg.get("name"))
-                _emit({"op": "del_dataset", "ok": True,
-                       "name": msg.get("name"), "deleted": bool(deleted)})
+                # same answer-never-crash contract as put_dataset: a
+                # malformed name (unhashable, say) must come back as a
+                # typed protocol answer, not unwind the loop
+                try:
+                    deleted = registry.delete(msg.get("name"))
+                except Exception as exc:  # noqa: FLX006,BLE001
+                    from .. import telemetry
+
+                    telemetry.record_serve_error(exc, what="del_dataset")
+                    _emit({"op": "del_dataset", "ok": False,
+                           "name": msg.get("name"), "error": type(exc).__name__,
+                           "code": "protocol", "message": str(exc)})
+                else:
+                    _emit({"op": "del_dataset", "ok": True,
+                           "name": msg.get("name"), "deleted": bool(deleted)})
             elif op == "list_datasets":
                 from . import registry
 
-                _emit({"op": "list_datasets", "ok": True,
-                       "datasets": registry.list_datasets(),
-                       "stats": registry.registry_stats()})
+                try:
+                    listing = registry.list_datasets()
+                    stats = registry.registry_stats()
+                except Exception as exc:  # noqa: FLX006,BLE001
+                    from .. import telemetry
+
+                    telemetry.record_serve_error(exc, what="list_datasets")
+                    _emit({"op": "list_datasets", "ok": False,
+                           "error": type(exc).__name__,
+                           "code": "execution", "message": str(exc)})
+                else:
+                    _emit({"op": "list_datasets", "ok": True,
+                           "datasets": listing, "stats": stats})
             elif op in ("append", "query", "compact", "list_stores"):
                 # durable incremental aggregation stores (flox_tpu/store.py):
                 # every store op touches the WAL/segments on disk, so each

@@ -55,6 +55,17 @@ import numpy as np
 # options as a module attribute, never from-bound: tests reload
 # flox_tpu.options, and a from-import would read the pre-reload dict
 from . import options, telemetry
+from .metric_names import (
+    CANARY_FAILURES,
+    CANARY_OK,
+    SERVE_BREAKER_FASTFAIL,
+    SERVE_DEADLINE_EXCEEDED,
+    SERVE_DEVICE_LOST,
+    SERVE_REQUEST_MS,
+    SERVE_REQUESTS,
+    SERVE_SHED,
+    SERVE_WATCHDOG_FIRED,
+)
 from .telemetry import CANARY_TENANT, METRICS
 
 __all__ = [
@@ -87,11 +98,11 @@ _STATE_RANK = MappingProxyType({"firing": 0, "pending": 1, "resolved": 2})
 #: the caller) — drain rejections and client protocol errors are excluded
 #: by OMISSION here: they are either planned (drain) or the caller's bug
 AVAILABILITY_BAD_COUNTERS = (
-    "serve.shed",
-    "serve.deadline_exceeded",
-    "serve.breaker_fastfail",
-    "serve.device_lost",
-    "serve.watchdog_fired",
+    SERVE_SHED,
+    SERVE_DEADLINE_EXCEEDED,
+    SERVE_BREAKER_FASTFAIL,
+    SERVE_DEVICE_LOST,
+    SERVE_WATCHDOG_FIRED,
 )
 
 #: the built-in objective set used when OPTIONS["slo_path"] is unset —
@@ -308,9 +319,9 @@ def _now() -> float:
 
 
 def _latency_totals(obj: dict) -> tuple[float, float]:
-    name = "serve.request_ms"
+    name = SERVE_REQUEST_MS
     if obj.get("tenant"):
-        name = f"serve.request_ms|tenant={telemetry.tenant_label(obj['tenant'], register=False)}"
+        name = f"{SERVE_REQUEST_MS}|tenant={telemetry.tenant_label(obj['tenant'], register=False)}"
     hist = METRICS.histograms().get(name)
     if not hist:
         return 0.0, 0.0
@@ -326,12 +337,12 @@ def _latency_totals(obj: dict) -> tuple[float, float]:
 
 def _availability_totals(obj: dict) -> tuple[float, float]:
     bad = float(sum(METRICS.get(c) for c in AVAILABILITY_BAD_COUNTERS))
-    total = float(METRICS.get("serve.requests"))
+    total = float(METRICS.get(SERVE_REQUESTS))
     return max(0.0, total - bad), bad
 
 
 def _correctness_totals(obj: dict) -> tuple[float, float]:
-    return float(METRICS.get("canary.ok")), float(METRICS.get("canary.failures"))
+    return float(METRICS.get(CANARY_OK)), float(METRICS.get(CANARY_FAILURES))
 
 
 def _freshness_totals(obj: dict) -> tuple[float, float]:

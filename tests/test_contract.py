@@ -1,0 +1,240 @@
+"""Runtime conformance harness for the static contract artifact.
+
+The contract compiler (``tools/floxlint/contract.py``) extracts the
+serve/telemetry surface from the AST; these tests prove the artifact
+against a LIVE replica: every contract-declared op is replayed through
+``python -m flox_tpu.serve`` (including error probes — every ``ok:
+false`` answer must carry a ``code`` the contract declares), and every
+contract-declared HTTP endpoint of the exposition server is probed
+in-process with its answered status asserted against the declared set.
+CI runs this file as the conformance leg next to the lint gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from tools.floxlint.contract import (  # noqa: E402
+    cell_tokens,
+    contract_for_paths,
+    parse_contract_tables,
+    validate_contract,
+)
+
+
+@pytest.fixture(scope="module")
+def contract():
+    doc = contract_for_paths([str(REPO / "flox_tpu")])
+    assert validate_contract(doc) == []
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# serve-protocol conformance: replay every declared op against a live replica
+# ---------------------------------------------------------------------------
+
+#: minimal replayable request per declared op. Deliberately includes
+#: error probes (append with no store, profile on a CPU-only runtime):
+#: the conformance property for those is that the failure is TYPED — the
+#: answer carries a contract-declared "code", never a bare stack trace.
+_OP_PROBES = {
+    "reduce": {
+        "id": "reduce", "func": "sum",
+        "array": [1.0, 2.0, 4.0, 8.0], "by": [0, 0, 1, 1],
+    },
+    "warmup": {"op": "warmup"},
+    "stats": {"op": "stats"},
+    "put_dataset": {
+        "op": "put_dataset", "name": "conf_ds",
+        "array": [1.0, 2.0, 3.0], "by": [0, 1, 1],
+    },
+    "list_datasets": {"op": "list_datasets"},
+    "del_dataset": {"op": "del_dataset", "name": "conf_ds"},
+    "append": {"op": "append", "store": "conf_missing"},
+    "query": {"op": "query", "store": "conf_missing"},
+    "compact": {"op": "compact", "store": "conf_missing"},
+    "list_stores": {"op": "list_stores"},
+    "profile": {"op": "profile", "seconds": 0.01},
+    "drain": {"op": "drain"},
+    "shutdown": {"op": "shutdown"},
+}
+
+
+@pytest.fixture(scope="module")
+def replica_records(contract):
+    missing = set(contract["ops"]) - set(_OP_PROBES)
+    assert not missing, f"contract declares ops with no probe: {missing}"
+    # lines are submitted concurrently as read, so the dataset lifecycle
+    # (put -> list -> del) is sequenced with drain barriers; everything
+    # else is order-independent
+    sequenced = ("put_dataset", "list_datasets", "del_dataset",
+                 "drain", "shutdown")
+    probes = [
+        _OP_PROBES[op] for op in contract["ops"] if op not in sequenced
+    ]
+    for op in ("put_dataset", "list_datasets", "del_dataset"):
+        probes += [{"op": "drain"}, _OP_PROBES[op]]
+    probes += [{"op": "drain"}, _OP_PROBES["shutdown"]]
+    lines = "\n".join(json.dumps(p) for p in probes) + "\n"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("FLOX_TPU_TELEMETRY", None)
+    env.pop("FLOX_TPU_TELEMETRY_EXPORT_PATH", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "flox_tpu.serve"],
+        input=lines, cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    records = [
+        json.loads(line) for line in proc.stdout.splitlines() if line.strip()
+    ]
+    assert records, proc.stderr
+    return records
+
+
+def _by_op(records):
+    out = {}
+    for rec in records:
+        key = rec.get("op") or ("reduce" if rec.get("id") == "reduce" else None)
+        if key is not None:
+            out.setdefault(key, rec)
+        elif "warmed" in rec:
+            out.setdefault("warmup", rec)
+    return out
+
+
+def test_every_declared_op_is_dispatched(contract, replica_records):
+    """No probe of a contract-declared op may come back as the unknown-op
+    protocol error — the artifact's op table IS the dispatch table."""
+    for rec in replica_records:
+        message = str(rec.get("message", ""))
+        assert "unknown op" not in message, rec
+
+
+def test_error_answers_carry_declared_codes(contract, replica_records):
+    """Every ok:false answer on the wire carries a machine-readable code
+    the contract declares (the FLX019 property, proven at runtime)."""
+    errors = [r for r in replica_records if r.get("ok") is False]
+    assert errors, "expected at least the append/query/compact error probes"
+    for rec in errors:
+        assert "code" in rec, rec
+        assert rec["code"] in contract["errors"], rec
+
+
+def test_reduce_answer_covers_documented_fields(contract, replica_records):
+    """The docs contract:ops row for reduce promises fields clients will
+    index — the live success answer must produce every one of them."""
+    tables = parse_contract_tables((REPO / "docs" / "serving.md").read_text())
+    rows = {
+        tok: row
+        for row in tables["ops"]
+        for tok in cell_tokens(next(iter(row.values())))
+    }
+    reduce_rec = _by_op(replica_records)["reduce"]
+    assert reduce_rec["ok"] is True
+    documented = set(cell_tokens(rows["reduce"].get("response fields", "")))
+    assert documented, "docs reduce row lost its response-fields cell"
+    missing = documented - set(reduce_rec)
+    assert not missing, f"documented reduce fields absent on the wire: {missing}"
+    assert reduce_rec["result"] == [3.0, 12.0]
+
+
+def test_dataset_and_store_ops_round_trip(replica_records):
+    recs = _by_op(replica_records)
+    assert recs["put_dataset"]["ok"] is True
+    assert recs["del_dataset"]["ok"] is True and recs["del_dataset"]["deleted"]
+    assert recs["list_datasets"]["ok"] is True
+    assert recs["list_stores"]["ok"] is True
+    assert recs["warmup"].get("warmed") == 0  # no manifest: replayed nothing
+    # the store error probes fail TYPED (unknown_store), never with a trace
+    for op in ("append", "query", "compact"):
+        assert recs[op]["ok"] is False
+        assert recs[op]["code"] == "unknown_store", recs[op]
+
+
+# ---------------------------------------------------------------------------
+# endpoint conformance: probe every declared exposition path against a live
+# endpoint. The server runs in a SUBPROCESS: start_metrics_server seeds
+# gauges, starts the saturation sampler, and warms SLO state process-wide,
+# and the registry is a process singleton — booting it inside the pytest
+# process would leak that state into every later test module.
+# ---------------------------------------------------------------------------
+
+_PROBE_SCRIPT = """\
+import json, sys, urllib.error, urllib.request
+from flox_tpu import exposition
+
+port = exposition.start_metrics_server(port=0)
+assert port
+statuses = {}
+for path in json.load(sys.stdin):
+    try:
+        with urllib.request.urlopen(
+            "http://127.0.0.1:%d%s" % (port, path), timeout=30
+        ) as resp:
+            statuses[path] = resp.status
+    except urllib.error.HTTPError as err:
+        statuses[path] = err.code
+with urllib.request.urlopen(
+    "http://127.0.0.1:%d/metrics" % port, timeout=30
+) as resp:
+    body = resp.read().decode()
+json.dump({"statuses": statuses, "metrics_body": body}, sys.stdout)
+"""
+
+
+@pytest.fixture(scope="module")
+def endpoint_probe(contract):
+    paths = sorted(contract["endpoints"]["flox_tpu.exposition"])
+    assert paths, "contract lost the exposition endpoint table"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("FLOX_TPU_TELEMETRY", None)
+    env.pop("FLOX_TPU_TELEMETRY_EXPORT_PATH", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE_SCRIPT],
+        input=json.dumps(paths), cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout)
+
+
+def test_every_declared_endpoint_answers_a_declared_status(
+    contract, endpoint_probe
+):
+    endpoints = contract["endpoints"]["flox_tpu.exposition"]
+    for path, entry in endpoints.items():
+        status = endpoint_probe["statuses"][path]
+        assert status in entry["statuses"], (
+            f"{path} answered {status}, contract declares {entry['statuses']}"
+        )
+
+
+def test_scrape_names_fold_back_to_contract_metrics(contract, endpoint_probe):
+    """Every flox_tpu_* series the live endpoint renders must fold back
+    (prefix/suffix stripped, dots folded) to a contract emit-site name —
+    the exposition renderer cannot invent series the contract misses."""
+    body = endpoint_probe["metrics_body"]
+    folded = {name.replace(".", "_") for name in contract["metrics"]}
+    unmatched = []
+    for line in body.splitlines():
+        if not line.startswith("flox_tpu_"):
+            continue
+        series = line.split(None, 1)[0].partition("{")[0]
+        candidate = series[len("flox_tpu_"):]
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            if candidate.endswith(suffix):
+                candidate = candidate[: -len(suffix)]
+                break
+        if candidate not in folded:
+            unmatched.append(series)
+    assert not unmatched, f"live series with no contract emit: {unmatched}"

@@ -72,6 +72,17 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     parser.add_argument(
+        "--contract",
+        metavar="FILE",
+        help=(
+            "compile the machine-readable serve/telemetry contract (ops, "
+            "error codes, endpoints, metrics, knobs) over the given paths "
+            "(default: flox_tpu/) to FILE as schema-validated JSON ('-' for "
+            "stdout) and exit — the artifact CI publishes next to the SARIF "
+            "upload and the conformance harness replays"
+        ),
+    )
+    parser.add_argument(
         "--lock-graph",
         metavar="FILE",
         help=(
@@ -99,6 +110,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         return 0
     if args.lock_graph:
         return _emit_lock_graph(args.paths, args.lock_graph)
+    if args.contract:
+        return _emit_contract(args.paths, args.contract)
     if not args.paths:
         print("floxlint: no paths given (try: python -m tools.floxlint flox_tpu/)", file=sys.stderr)
         return 2
@@ -174,6 +187,40 @@ def main(argv: Sequence[str] | None = None) -> int:
 
         print(format_human(findings, files_checked=files_checked))
     return 1 if findings or stale else 0
+
+
+def _emit_contract(paths: Sequence[str], out: str) -> int:
+    """``--contract FILE``: compile the serve/telemetry contract over the
+    given paths (default: the flox_tpu package) and write it as canonical
+    JSON. The emitted artifact is schema-checked before writing — a
+    contract the compiler itself cannot validate never ships."""
+    from .contract import contract_for_paths, render_contract, validate_contract
+
+    try:
+        doc = contract_for_paths(list(paths) or ["flox_tpu"])
+    except (LintError, ValueError) as exc:
+        sys.stderr.write(f"floxlint: {exc}\n")
+        return 2
+    problems = validate_contract(doc)
+    if problems:
+        for p in problems:
+            sys.stderr.write(f"floxlint: contract schema: {p}\n")
+        return 2
+    payload = render_contract(doc)
+    if out == "-":
+        sys.stdout.write(payload)
+    else:
+        with open(out, "w") as fh:
+            fh.write(payload)
+    sys.stderr.write(
+        "floxlint: contract: "
+        f"{len(doc['ops'])} op(s), {len(doc['errors'])} error code(s), "
+        f"{sum(len(p) for p in doc['endpoints'].values())} endpoint(s), "
+        f"{len(doc['metrics'])} metric(s), {len(doc['knobs'])} knob(s)"
+        + ("" if out == "-" else f" -> {out}")
+        + "\n"
+    )
+    return 0
 
 
 def _emit_lock_graph(paths: Sequence[str], out: str) -> int:

@@ -1,0 +1,1258 @@
+"""Static contract compiler (floxlint v4).
+
+The system's external surface — serve-protocol ops, the typed
+``ServeError`` hierarchy, the HTTP endpoints, every metric name the
+telemetry registry can emit, and the OPTIONS knob table — used to live
+only in hand-written docs tables and brittle CI greps. This module
+factorizes that contract ONCE, from the AST (the flox move applied to
+static analysis), into a versioned, schema-validated, deterministic
+``contract.json`` that every consumer reduces over: the FLX017–FLX020
+drift rules, the docs tables in ``docs/serving.md``, the runtime
+conformance harness (``tests/test_contract.py``), and — per ROADMAP
+item 1 — the future fleet router's client stub.
+
+Extraction anchors (all pure AST, nothing is imported):
+
+* **ops** — a *protocol module* is any module defining a top-level
+  ``_REQUEST_FIELDS`` set of strings. Its op-dispatch chain
+  (``op == "stats"`` / ``op in ("append", ...)`` comparisons on a value
+  read via ``.get("op")``) yields one op per comparison; the inline
+  aggregation path is the implicit ``reduce`` op. Per op we record the
+  ``msg.get("...")`` request fields and the string keys of every response
+  dict literal in the branch (the *envelope* fields — spread payloads
+  like ``**info`` add dynamic keys on top, which is why conformance
+  checks ``envelope ⊆ observed``, never equality).
+* **errors** — every class whose base chain reaches a class named
+  ``ServeError`` and that sets a string ``code`` class attribute; plus
+  *synthesized* codes (``"code": "protocol"`` string literals in
+  protocol-module response dicts that match no class). Constructor call
+  sites tell us whether a code ever carries ``retry_after_ms`` /
+  ``program``; the serve call graph tells us which functions raise it.
+* **endpoints** — every ``do_GET`` handler's ``path == "/x"`` chain, with
+  query params (``params.get("...")``) and status codes (integer
+  constants in 100–599) collected from the branch and, transitively,
+  the same-module helpers it calls.
+* **metrics** — every name reachable through ``METRICS.inc`` /
+  ``METRICS.observe`` / ``METRICS.set_gauge`` / ``telemetry.count`` call
+  sites, including the ``name|key=value`` label convention (f-string
+  prefixes resolve to the base name + label keys). Module-level
+  ``*_GAUGES`` string tuples mark seeded-at-start gauges.
+* **knobs** — the FLX010 triangle, machine-readable: every ``OPTIONS``
+  field with its ``FLOX_TPU_*`` env mirror and ``_VALIDATORS`` presence.
+
+The serve-escape graph (:func:`build_serve_graph`) is shared with FLX020:
+call edges inside the serve package, with ``self.method`` receivers,
+``asyncio.to_thread/create_task/ensure_future`` wrappers unwrapped, and
+each edge annotated *contained* when the call site sits inside a ``try``
+whose handlers catch broadly — the lexical boundary an untyped exception
+cannot cross.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterator, Sequence
+
+from .rules.common import dotted_name
+
+CONTRACT_VERSION = 1
+
+#: call wrappers whose first argument is the real callee (the serve plane
+#: runs every disk/CPU-bound path off the loop through these)
+_ASYNC_WRAPPERS = frozenset(
+    {"asyncio.to_thread", "asyncio.create_task", "asyncio.ensure_future"}
+)
+
+_BROAD_EXC = frozenset({"Exception", "BaseException"})
+
+#: Python builtins that mark a raise site as untyped for FLX020 (anything
+#: unresolvable is skipped — conservatively, never guessed)
+_BUILTIN_EXCEPTIONS = frozenset(
+    {
+        "Exception", "BaseException", "ValueError", "TypeError", "KeyError",
+        "IndexError", "RuntimeError", "OSError", "IOError", "LookupError",
+        "AttributeError", "NotImplementedError", "AssertionError",
+        "ArithmeticError", "ZeroDivisionError", "OverflowError",
+        "FileNotFoundError", "PermissionError", "StopIteration",
+        "StopAsyncIteration", "MemoryError", "EOFError",
+    }
+)
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _str_consts(node: ast.AST) -> list[str]:
+    return [
+        n.value
+        for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    ]
+
+
+def _own_statements(fn: ast.AST) -> Iterator[ast.AST]:
+    """Every node of a function body, excluding nested function bodies
+    (those are their own graph nodes)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _metric_name_of(arg: ast.AST) -> tuple[str, list[str], bool] | None:
+    """(base name, label keys, dynamic) for a metric-name argument.
+
+    A plain string splits on the ``|key=value`` convention; an f-string
+    resolves to its leading literal prefix (``f"serve.request_ms|tenant=
+    {label}"`` -> base ``serve.request_ms``, labels ``["tenant"]``).
+    None when no leading literal exists (a fully dynamic name).
+    """
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        raw, dynamic = arg.value, False
+    elif isinstance(arg, ast.JoinedStr):
+        prefix = ""
+        for part in arg.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                prefix += part.value
+            else:
+                break
+        if not prefix:
+            return None
+        raw, dynamic = prefix, True
+    else:
+        return None
+    base, _, labelpart = raw.partition("|")
+    labels = []
+    if labelpart:
+        key = labelpart.partition("=")[0].strip()
+        if key:
+            labels.append(key)
+    base = base.strip()
+    if not base or base.endswith("."):
+        # a dynamic name with only a family prefix ("store.") is not a
+        # contract entry — record the site as dynamic instead
+        return None
+    return base, labels, dynamic
+
+
+# ---------------------------------------------------------------------------
+# ops (serve protocol modules)
+# ---------------------------------------------------------------------------
+
+
+def request_fields(mod) -> list[str] | None:
+    """The ``_REQUEST_FIELDS`` string set of a protocol module, or None."""
+    node = mod.definitions.get("_REQUEST_FIELDS")
+    if node is None or not isinstance(node, (ast.Assign, ast.AnnAssign)):
+        return None
+    value = node.value
+    if value is None:
+        return None
+    names = [
+        c.value
+        for n in ast.walk(value)
+        if isinstance(n, (ast.Set, ast.Tuple, ast.List))
+        for c in n.elts
+        if isinstance(c, ast.Constant) and isinstance(c.value, str)
+    ]
+    return sorted(set(names)) if names else None
+
+
+def protocol_modules(index) -> list:
+    return sorted(
+        (m for m in index.modules.values() if request_fields(m) is not None),
+        key=lambda m: m.name,
+    )
+
+
+def _op_dispatch_branches(mod) -> list[tuple[str, ast.If, list[ast.stmt]]]:
+    """(op name, If node, branch body) per op comparison in the module's
+    dispatch chain — ``op == "stats"`` and ``op in ("append", ...)``
+    forms, where the compared name was read via ``.get("op")``."""
+    op_vars: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Attribute)
+            and node.value.func.attr == "get"
+            and node.value.args
+            and isinstance(node.value.args[0], ast.Constant)
+            and node.value.args[0].value == "op"
+        ):
+            op_vars.update(
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            )
+    if not op_vars:
+        return []
+    out: list[tuple[str, ast.If, list[ast.stmt]]] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        if not (
+            isinstance(test, ast.Compare)
+            and isinstance(test.left, ast.Name)
+            and test.left.id in op_vars
+            and len(test.ops) == 1
+        ):
+            continue
+        comp = test.comparators[0]
+        if isinstance(test.ops[0], ast.Eq) and isinstance(comp, ast.Constant):
+            if isinstance(comp.value, str):
+                out.append((comp.value, node, node.body))
+        elif isinstance(test.ops[0], ast.In) and isinstance(
+            comp, (ast.Tuple, ast.List, ast.Set)
+        ):
+            for elt in comp.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    out.append((elt.value, node, node.body))
+    return out
+
+
+def _dict_keys_in(nodes: Sequence[ast.AST]) -> tuple[set[str], bool]:
+    """(string keys of every dict literal / string-subscript assignment,
+    whether a ``**_error_response(...)`` style spread is present)."""
+    keys: set[str] = set()
+    spreads_error_response = False
+    for root in nodes:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        keys.add(k.value)
+                    elif k is None:  # **spread
+                        called = (
+                            dotted_name(v.func) if isinstance(v, ast.Call) else None
+                        )
+                        if called and called.split(".")[-1] == "_error_response":
+                            spreads_error_response = True
+            elif (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Subscript)
+                and isinstance(node.targets[0].slice, ast.Constant)
+                and isinstance(node.targets[0].slice.value, str)
+            ):
+                keys.add(node.targets[0].slice.value)
+    return keys, spreads_error_response
+
+
+def _calls_function(nodes: Sequence[ast.AST], name: str) -> bool:
+    for root in nodes:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                called = dotted_name(node.func)
+                if called and called.split(".")[-1] == name:
+                    return True
+    return False
+
+
+def _msg_get_keys(nodes: Sequence[ast.AST]) -> set[str]:
+    keys: set[str] = set()
+    for root in nodes:
+        for node in ast.walk(root):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                keys.add(node.args[0].value)
+    return keys - {"op"}
+
+
+def _error_response_keys(mod) -> set[str]:
+    """Keys of the shared typed-error envelope helper, when the protocol
+    module defines one (``_error_response``)."""
+    fn = mod.definitions.get("_error_response")
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        keys, _ = _dict_keys_in([fn])
+        return keys
+    return set()
+
+
+def _extract_ops(index, graphs: dict) -> dict:
+    ops: dict[str, dict] = {}
+    for mod in protocol_modules(index):
+        graph = graphs.get(serve_domain_prefix(mod.name))
+        err_keys = _error_response_keys(mod)
+        for op, node, body in _op_dispatch_branches(mod):
+            keys, spreads = _dict_keys_in(body)
+            if spreads or _calls_function(body, "_error_response"):
+                keys |= err_keys
+            codes = _branch_error_codes(index, mod, body, graph)
+            entry = {
+                "module": mod.name,
+                "line": node.lineno,
+                "request_fields": sorted(_msg_get_keys(body) | {"op"}),
+                "response_fields": sorted(keys),
+                "error_codes": sorted(codes),
+            }
+            if op in ops:  # first definition wins; duplicates merge fields
+                prev = ops[op]
+                prev["request_fields"] = sorted(
+                    set(prev["request_fields"]) | set(entry["request_fields"])
+                )
+                prev["response_fields"] = sorted(
+                    set(prev["response_fields"]) | set(entry["response_fields"])
+                )
+                prev["error_codes"] = sorted(
+                    set(prev["error_codes"]) | set(entry["error_codes"])
+                )
+            else:
+                ops[op] = entry
+        # the inline aggregation path: every request line without an "op"
+        fields = request_fields(mod) or []
+        reduce_fns = [
+            fi
+            for fi in mod.functions.values()
+            if any(
+                isinstance(n, ast.Name) and n.id == "_REQUEST_FIELDS"
+                for n in ast.walk(fi.node)
+            )
+        ]
+        keys: set[str] = set()
+        codes: set[str] = set()
+        for fi in reduce_fns:
+            fkeys, spreads = _dict_keys_in([fi.node])
+            keys |= fkeys
+            if spreads or _calls_function([fi.node], "_error_response"):
+                keys |= err_keys
+            codes |= _branch_error_codes(index, mod, [fi.node], graph)
+        if fields and "reduce" not in ops:
+            ops["reduce"] = {
+                "module": mod.name,
+                "line": 1,
+                "request_fields": sorted(set(fields) | {"id"}),
+                "response_fields": sorted(keys),
+                "error_codes": sorted(codes),
+            }
+    return {k: ops[k] for k in sorted(ops)}
+
+
+def _literal_codes(nodes: Sequence[ast.AST]) -> dict[str, int]:
+    """code -> line for every literal ``"code": "<x>"`` dict entry or
+    ``out["code"] = "<x>"`` subscript assignment."""
+    codes: dict[str, int] = {}
+    for root in nodes:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if (
+                        isinstance(k, ast.Constant)
+                        and k.value == "code"
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                    ):
+                        codes.setdefault(v.value, v.lineno)
+            elif (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Subscript)
+                and isinstance(node.targets[0].slice, ast.Constant)
+                and node.targets[0].slice.value == "code"
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                codes.setdefault(node.value.value, node.value.lineno)
+    return codes
+
+
+def _branch_error_codes(index, mod, body, graph) -> set[str]:
+    """Codes a branch can answer: literal ``"code": "<x>"`` emits plus every
+    typed raise reachable through the serve graph from the branch's calls."""
+    codes: set[str] = set(_literal_codes(body))
+    if graph is None:
+        return codes
+    seeds: set[str] = set()
+    for root in body:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                target = graph.resolve_call(mod, node)
+                if target is not None:
+                    seeds.add(target)
+    reachable = graph.reachable_from(seeds)
+    for qual in reachable | seeds:
+        for site in graph.raises.get(qual, ()):
+            if site.code is not None:
+                codes.add(site.code)
+    return codes
+
+
+# ---------------------------------------------------------------------------
+# errors (the typed ServeError hierarchy + synthesized codes)
+# ---------------------------------------------------------------------------
+
+
+def _class_defs(index) -> dict[str, tuple[ast.ClassDef, object]]:
+    """qualname -> (ClassDef, module) for every class at any nesting."""
+    out: dict[str, tuple[ast.ClassDef, object]] = {}
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                out[f"{mod.name}.{node.name}"] = (node, mod)
+    return out
+
+
+def serve_error_classes(index) -> dict[str, tuple[ast.ClassDef, object]]:
+    """qualname -> (node, module) for every class deriving (transitively)
+    from a class named ``ServeError`` — the base itself included."""
+    classes = _class_defs(index)
+    derived: dict[str, tuple[ast.ClassDef, object]] = {
+        q: v for q, v in classes.items() if q.split(".")[-1] == "ServeError"
+    }
+    changed = True
+    while changed:
+        changed = False
+        for qual, (node, mod) in classes.items():
+            if qual in derived:
+                continue
+            for base in node.bases:
+                base_name = dotted_name(base)
+                if base_name is None:
+                    continue
+                leaf = base_name.split(".")[-1]
+                resolved = index.resolve_symbol(mod.name, base_name)
+                if leaf == "ServeError" or (
+                    resolved is not None and resolved in derived
+                ):
+                    derived[qual] = (node, mod)
+                    changed = True
+                    break
+    return derived
+
+
+def _class_code(node: ast.ClassDef) -> str | None:
+    for stmt in node.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id == "code"
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)
+                ):
+                    return stmt.value.value
+    return None
+
+
+def _constructor_kwargs(index, class_name: str) -> set[str]:
+    kwargs: set[str] = set()
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                called = dotted_name(node.func)
+                if called and called.split(".")[-1] == class_name:
+                    kwargs.update(k.arg for k in node.keywords if k.arg)
+    return kwargs
+
+
+def _extract_errors(index, graphs: dict) -> dict:
+    errors: dict[str, dict] = {}
+    raised_in: dict[str, set[str]] = {}
+    for graph in graphs.values():
+        for qual, sites in graph.raises.items():
+            for site in sites:
+                if site.code is not None:
+                    raised_in.setdefault(site.code, set()).add(qual)
+    for qual, (node, mod) in sorted(serve_error_classes(index).items()):
+        name = qual.split(".")[-1]
+        if name == "ServeError":
+            continue  # the abstract base's "serve_error" never goes on the wire
+        code = _class_code(node)
+        if code is None:
+            continue
+        kwargs = _constructor_kwargs(index, name)
+        errors[code] = {
+            "class": name,
+            "module": mod.name,
+            "line": node.lineno,
+            "retry_after_ms": "retry_after_ms" in kwargs,
+            "program": "program" in kwargs,
+            "raised_in": sorted(raised_in.get(code, ())),
+        }
+    # synthesized codes: literal "code" values the protocol layer attaches
+    # without a class (protocol / execution / busy ...)
+    for mod in protocol_modules(index):
+        for code, line in sorted(_literal_codes([mod.tree]).items()):
+            if code not in errors:
+                errors[code] = {
+                    "class": None,
+                    "module": mod.name,
+                    "line": line,
+                    "retry_after_ms": False,
+                    "program": False,
+                    "raised_in": [],
+                }
+    return {k: errors[k] for k in sorted(errors)}
+
+
+# ---------------------------------------------------------------------------
+# endpoints (every do_GET path chain)
+# ---------------------------------------------------------------------------
+
+
+def _fn_param_keys(fn: ast.AST) -> set[str]:
+    keys: set[str] = set()
+    for node in _own_statements(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "params"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            keys.add(node.args[0].value)
+    return keys
+
+
+def _fn_statuses(fn: ast.AST) -> set[int]:
+    return {
+        n.value
+        for n in _own_statements(fn)
+        if isinstance(n, ast.Constant)
+        and isinstance(n.value, int)
+        and not isinstance(n.value, bool)
+        and 100 <= n.value <= 599
+    }
+
+
+def _fn_called_names(fn: ast.AST) -> set[str]:
+    names: set[str] = set()
+    for node in _own_statements(fn):
+        if isinstance(node, ast.Call):
+            called = dotted_name(node.func)
+            if called:
+                names.add(called.split(".")[-1])
+    return names
+
+
+def _extract_endpoints(index) -> dict:
+    endpoints: dict[str, dict] = {}
+    for mod in sorted(index.modules.values(), key=lambda m: m.name):
+        handlers = [
+            n
+            for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name == "do_GET"
+        ]
+        if not handlers:
+            continue
+        # per-function fact tables for the whole module: branch facts union
+        # transitively over same-module helpers (self._costs -> _parse_top)
+        fns: dict[str, ast.AST] = {}
+        for n in ast.walk(mod.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(n.name, n)
+
+        def closure(names: set[str]) -> set[str]:
+            seen: set[str] = set()
+            frontier = {n for n in names if n in fns}
+            while frontier:
+                name = frontier.pop()
+                if name in seen:
+                    continue
+                seen.add(name)
+                frontier |= {
+                    n for n in _fn_called_names(fns[name]) if n in fns
+                } - seen
+            return seen
+
+        mod_paths: dict[str, dict] = {}
+        for handler in handlers:
+            for node in ast.walk(handler):
+                if not isinstance(node, ast.If):
+                    continue
+                test = node.test
+                if not (
+                    isinstance(test, ast.Compare)
+                    and len(test.ops) == 1
+                    and isinstance(test.ops[0], ast.Eq)
+                    and isinstance(test.comparators[0], ast.Constant)
+                    and isinstance(test.comparators[0].value, str)
+                    and test.comparators[0].value.startswith("/")
+                ):
+                    continue
+                path = test.comparators[0].value
+                branch = ast.Module(body=node.body, type_ignores=[])
+                params = _fn_param_keys(branch)
+                statuses = _fn_statuses(branch)
+                for helper in closure(_fn_called_names(branch)):
+                    params |= _fn_param_keys(fns[helper])
+                    statuses |= _fn_statuses(fns[helper])
+                mod_paths[path] = {
+                    "line": node.lineno,
+                    "query_params": sorted(params),
+                    "statuses": sorted(statuses),
+                }
+        if mod_paths:
+            endpoints[mod.name] = {k: mod_paths[k] for k in sorted(mod_paths)}
+    return endpoints
+
+
+# ---------------------------------------------------------------------------
+# metrics (every registry emit site)
+# ---------------------------------------------------------------------------
+
+_EMIT_KINDS = {"inc": "counter", "observe": "histogram", "set_gauge": "gauge"}
+
+
+def _emit_site(node: ast.Call) -> str | None:
+    """The metric kind when this call is a registry emit, else None."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    recv = dotted_name(func.value)
+    if func.attr in _EMIT_KINDS and recv is not None and (
+        recv == "METRICS" or recv.endswith(".METRICS") or recv == "self._metrics"
+    ):
+        return _EMIT_KINDS[func.attr]
+    if func.attr == "count" and recv is not None and (
+        recv == "telemetry" or recv.endswith(".telemetry")
+    ):
+        return "counter"
+    return None
+
+
+def _seeded_gauge_names(mod) -> dict[str, int]:
+    """name -> line for every entry of a module-level ``*_GAUGES`` tuple."""
+    out: dict[str, int] = {}
+    for node in mod.tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (
+            node.targets
+            if isinstance(node, ast.Assign)
+            else [node.target]
+        )
+        if not any(
+            isinstance(t, ast.Name) and t.id.endswith("_GAUGES") for t in targets
+        ):
+            continue
+        value = node.value
+        if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+            for elt in value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    out[elt.value] = elt.lineno
+    return out
+
+
+def _extract_metrics(index) -> tuple[dict, list]:
+    metrics: dict[str, dict] = {}
+    dynamic_sites: list[dict] = []
+    for mod in sorted(index.modules.values(), key=lambda m: m.name):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _emit_site(node)
+            if kind is None or not node.args:
+                continue
+            named = _metric_name_of(node.args[0])
+            if named is None:
+                dynamic_sites.append({"module": mod.name, "line": node.lineno})
+                continue
+            base, labels, _dynamic = named
+            entry = metrics.setdefault(
+                base, {"kinds": [], "labels": [], "modules": [], "seeded": False}
+            )
+            if kind not in entry["kinds"]:
+                entry["kinds"].append(kind)
+            for label in labels:
+                if label not in entry["labels"]:
+                    entry["labels"].append(label)
+            if mod.name not in entry["modules"]:
+                entry["modules"].append(mod.name)
+        for name in _seeded_gauge_names(mod):
+            entry = metrics.setdefault(
+                name, {"kinds": [], "labels": [], "modules": [], "seeded": False}
+            )
+            entry["seeded"] = True
+            if "gauge" not in entry["kinds"]:
+                entry["kinds"].append("gauge")
+    for entry in metrics.values():
+        entry["kinds"].sort()
+        entry["labels"].sort()
+        entry["modules"].sort()
+    dynamic_sites.sort(key=lambda d: (d["module"], d["line"]))
+    return {k: metrics[k] for k in sorted(metrics)}, dynamic_sites
+
+
+# ---------------------------------------------------------------------------
+# knobs (the FLX010 triangle, machine-readable)
+# ---------------------------------------------------------------------------
+
+
+def _extract_knobs(index) -> dict:
+    from .rules.flx010_options_drift import _toplevel_dict
+
+    knobs: dict[str, dict] = {}
+    for mod in sorted(index.modules.values(), key=lambda m: m.name):
+        options = _toplevel_dict(mod.tree, "OPTIONS")
+        validators = _toplevel_dict(mod.tree, "_VALIDATORS")
+        if options is None or validators is None:
+            continue
+        validated = {
+            k.value
+            for k in validators.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        }
+        for key, value in zip(options.keys, options.values):
+            if not (isinstance(key, ast.Constant) and isinstance(key.value, str)):
+                continue
+            env = next(
+                (s for s in _str_consts(value) if s.startswith("FLOX_TPU_")), None
+            )
+            knobs[key.value] = {
+                "module": mod.name,
+                "line": key.lineno,
+                "env": env,
+                "validated": key.value in validated,
+            }
+    return {k: knobs[k] for k in sorted(knobs)}
+
+
+# ---------------------------------------------------------------------------
+# the serve-escape graph (shared with FLX020)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RaiseSite:
+    """One ``raise X(...)`` statement inside a serve-package function."""
+
+    qualname: str  #: the raising function
+    path: str
+    line: int
+    exc_name: str  #: last component of the raised class name
+    code: str | None  #: the ServeError code when typed, else None
+    contained: bool  #: lexically inside a try whose handlers catch this type
+    typed: bool  #: raises a ServeError subclass
+    builtin: bool  #: raises a Python builtin exception
+
+
+@dataclass
+class ServeGraph:
+    """Call edges + raise sites over one serve package.
+
+    Each edge carries the exception names its call site's enclosing
+    ``try`` frames catch (``"*"`` for bare / ``Exception`` /
+    ``BaseException``): an exception of a caught type cannot propagate
+    across that edge, so escape traversal stops there — which is how a
+    json-protocol helper whose TypeError is caught narrowly at its only
+    call site stays clean.
+    """
+
+    index: object
+    domain: str
+    #: caller -> [(callee, names caught around the call site)]
+    edges: dict[str, list[tuple[str, frozenset[str]]]] = field(
+        default_factory=dict
+    )
+    raises: dict[str, list[RaiseSite]] = field(default_factory=dict)
+    entries: list[str] = field(default_factory=list)
+    error_codes: dict[str, str] = field(default_factory=dict)  #: class -> code
+    _class_lower: dict[str, str] = field(default_factory=dict)
+
+    def resolve_call(self, mod, node: ast.Call) -> str | None:
+        """Canonical qualname of a call's target inside the domain, or
+        None. Unwraps ``asyncio.to_thread(fn, ...)`` style wrappers,
+        resolves ``self.method`` against the enclosing class, and matches
+        ``dispatcher.submit`` style receiver-named-after-class calls."""
+        called = dotted_name(node.func)
+        if called in _ASYNC_WRAPPERS and node.args:
+            inner = node.args[0]
+            target = inner.func if isinstance(inner, ast.Call) else inner
+            called = dotted_name(target)
+        if called is None:
+            return None
+        head, _, rest = called.partition(".")
+        if head == "self" and rest:
+            return None  # handled by the caller, which knows its class
+        resolved = self.index.resolve_symbol(mod.name, called)
+        if resolved is not None and self._in_domain(resolved):
+            if self.index.function(resolved) is not None:
+                return resolved
+        # receiver named after a domain class: dispatcher.submit ->
+        # <module>.Dispatcher.submit
+        if rest and "." not in rest:
+            cls = self._class_lower.get(head)
+            if cls is not None:
+                candidate = f"{cls}.{rest}"
+                if self.index.function(candidate) is not None:
+                    return candidate
+        return None
+
+    def _in_domain(self, qualname: str) -> bool:
+        return qualname == self.domain or qualname.startswith(self.domain + ".")
+
+    def reachable_from(self, seeds: set[str]) -> set[str]:
+        """Every function reachable from ``seeds`` over all edges (the
+        which-ops-can-answer-which-codes attribution — a caught ServeError
+        still becomes an error response, so catch frames don't stop it)."""
+        seen: set[str] = set()
+        frontier = list(seeds)
+        while frontier:
+            qual = frontier.pop()
+            for callee, _caught in self.edges.get(qual, ()):
+                if callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+        return seen
+
+    def _reachable_passing(self, exc_name: str) -> set[str]:
+        """Functions reachable from the entries over edges whose catch
+        frames would NOT stop ``exc_name`` on its way back up."""
+        seen = set(self.entries)
+        frontier = list(self.entries)
+        while frontier:
+            qual = frontier.pop()
+            for callee, caught in self.edges.get(qual, ()):
+                if "*" in caught or exc_name in caught:
+                    continue
+                if callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+        return seen
+
+    def escapes(self) -> list[RaiseSite]:
+        """FLX020's answer: raise sites of non-ServeError exceptions that
+        can propagate all the way to a serve entry — not caught around the
+        raise itself, and reachable over edges that don't catch the type."""
+        candidates: dict[str, list[RaiseSite]] = {}
+        for qual, sites in self.raises.items():
+            for site in sites:
+                if site.contained or site.typed:
+                    continue
+                candidates.setdefault(site.exc_name, []).append(site)
+        out = []
+        for exc_name, sites in candidates.items():
+            reachable = self._reachable_passing(exc_name)
+            out.extend(s for s in sites if s.qualname in reachable)
+        out.sort(key=lambda s: (s.path, s.line))
+        return out
+
+
+def serve_domain_prefix(module_name: str) -> str:
+    """The package prefix escape analysis stays inside — up to and
+    including the ``serve`` component when one exists."""
+    parts = module_name.split(".")
+    if "serve" in parts:
+        return ".".join(parts[: parts.index("serve") + 1])
+    return parts[0]
+
+
+def serve_domains(index) -> list[str]:
+    """Every domain carrying a serve entry — protocol modules and
+    ``Dispatcher._execute`` methods each anchor one."""
+    domains = {serve_domain_prefix(m.name) for m in protocol_modules(index)}
+    for mod in index.modules.values():
+        for fi in mod.functions.values():
+            if fi.qualname.endswith(".Dispatcher._execute"):
+                domains.add(serve_domain_prefix(mod.name))
+    return sorted(domains)
+
+
+def build_serve_graphs(index) -> dict[str, "ServeGraph"]:
+    return {d: build_serve_graph(index, d) for d in serve_domains(index)}
+
+
+def build_serve_graph(index, domain: str) -> ServeGraph:
+    graph = ServeGraph(index=index, domain=domain)
+    error_classes = serve_error_classes(index)
+    typed_names = {q.split(".")[-1] for q in error_classes}
+    for qual, (node, _mod) in error_classes.items():
+        code = _class_code(node)
+        if code is not None:
+            graph.error_codes[qual.split(".")[-1]] = code
+    domain_mods = [
+        m
+        for m in index.modules.values()
+        if m.name == domain or m.name.startswith(domain + ".")
+    ]
+    for mod in domain_mods:
+        for name, defn in mod.definitions.items():
+            if isinstance(defn, ast.ClassDef):
+                graph._class_lower.setdefault(name.lower(), f"{mod.name}.{name}")
+    for mod in domain_mods:
+        for fi in mod.functions.values():
+            class_prefix = None
+            parts = fi.qualname[len(mod.name) + 1 :].split(".")
+            if len(parts) >= 2:
+                owner = parts[-2]
+                if isinstance(mod.definitions.get(owner), ast.ClassDef):
+                    class_prefix = f"{mod.name}.{owner}"
+            _walk_function(graph, mod, fi, class_prefix, typed_names)
+            if fi.name == "_amain" or (
+                fi.name == "_execute"
+                and class_prefix is not None
+                and class_prefix.split(".")[-1] == "Dispatcher"
+            ):
+                graph.entries.append(fi.qualname)
+    graph.entries.sort()
+    return graph
+
+
+def _handler_names(handlers: list[ast.ExceptHandler]) -> frozenset[str]:
+    """Leaf names a Try's handlers catch; ``"*"`` for bare/broad handlers.
+    Exception *hierarchies* are not modelled — only an exact leaf-name
+    match (or a broad handler) counts as catching, which under-catches and
+    therefore over-reports; the broad-handler case covers the idiomatic
+    serve guards."""
+    names: set[str] = set()
+    for handler in handlers:
+        if handler.type is None:
+            names.add("*")
+            continue
+        types = (
+            handler.type.elts
+            if isinstance(handler.type, ast.Tuple)
+            else [handler.type]
+        )
+        for t in types:
+            name = dotted_name(t)
+            if name is None:
+                continue
+            leaf = name.split(".")[-1]
+            names.add("*" if leaf in _BROAD_EXC else leaf)
+    return frozenset(names)
+
+
+def _walk_function(graph, mod, fi, class_prefix, typed_names) -> None:
+    edges: list[tuple[str, frozenset[str]]] = []
+    raises: list[RaiseSite] = []
+
+    def visit(stmts, caught: frozenset[str]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.Try):
+                visit(stmt.body, caught | _handler_names(stmt.handlers))
+                for h in stmt.handlers:
+                    visit(h.body, caught)
+                visit(stmt.orelse, caught)
+                visit(stmt.finalbody, caught)
+                continue
+            if isinstance(stmt, ast.Raise):
+                _record_raise(stmt, caught)
+            for _name, value in ast.iter_fields(stmt):
+                if isinstance(value, list):
+                    if value and isinstance(value[0], ast.stmt):
+                        visit(value, caught)
+                    else:
+                        for v in value:
+                            if isinstance(v, ast.AST):
+                                _visit_expr(v, caught)
+                elif isinstance(value, ast.AST):
+                    _visit_expr(value, caught)
+
+    def _visit_expr(node, caught: frozenset[str]) -> None:
+        stack = [node]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue  # nested defs are their own graph nodes
+            if isinstance(sub, ast.Call):
+                target = _resolve(sub)
+                if target is not None:
+                    edges.append((target, caught))
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _resolve(call: ast.Call) -> str | None:
+        called = dotted_name(call.func)
+        if called and called.startswith("self.") and class_prefix:
+            meth = called[len("self.") :]
+            if "." not in meth:
+                candidate = f"{class_prefix}.{meth}"
+                if graph.index.function(candidate) is not None:
+                    return candidate
+            return None
+        return graph.resolve_call(mod, call)
+
+    def _record_raise(stmt: ast.Raise, caught: frozenset[str]) -> None:
+        exc = stmt.exc
+        if exc is None:
+            return  # bare re-raise: the original type propagates
+        target = exc.func if isinstance(exc, ast.Call) else exc
+        name = dotted_name(target)
+        if name is None:
+            return
+        leaf = name.split(".")[-1]
+        resolved = graph.index.resolve_symbol(mod.name, name)
+        typed = leaf in typed_names or (
+            resolved is not None and resolved.split(".")[-1] in typed_names
+        )
+        builtin = leaf in _BUILTIN_EXCEPTIONS
+        if not typed and not builtin and resolved is None:
+            return  # unresolvable foreign class: never guessed
+        raises.append(
+            RaiseSite(
+                qualname=fi.qualname,
+                path=str(fi.path),
+                line=stmt.lineno,
+                exc_name=leaf,
+                code=graph.error_codes.get(leaf),
+                contained="*" in caught or leaf in caught,
+                typed=typed,
+                builtin=builtin,
+            )
+        )
+
+    visit(fi.node.body, frozenset())
+    if edges:
+        graph.edges[fi.qualname] = edges
+    if raises:
+        graph.raises[fi.qualname] = raises
+
+
+# ---------------------------------------------------------------------------
+# assembly, schema, rendering
+# ---------------------------------------------------------------------------
+
+
+def build_contract(index) -> dict:
+    """The full contract over one :class:`ProjectIndex` — deterministic:
+    every mapping is key-sorted and every list value sorted or
+    insertion-ordered from a sorted walk, so two builds over a
+    byte-identical tree render byte-identical JSON."""
+    from .sarif import _TOOL_VERSION
+
+    protos = protocol_modules(index)
+    graphs = build_serve_graphs(index)
+    metrics, dynamic_sites = _extract_metrics(index)
+    doc = {
+        "contract_version": CONTRACT_VERSION,
+        "generated_by": {"tool": "floxlint", "version": _TOOL_VERSION},
+        "request_fields": sorted(
+            set().union(*(request_fields(m) or [] for m in protos))
+        )
+        if protos
+        else [],
+        "ops": _extract_ops(index, graphs),
+        "errors": _extract_errors(index, graphs),
+        "endpoints": _extract_endpoints(index),
+        "metrics": metrics,
+        "dynamic_metric_sites": dynamic_sites,
+        "knobs": _extract_knobs(index),
+    }
+    return doc
+
+
+#: the artifact schema, hand-checked by :func:`validate_contract` (no
+#: jsonschema dependency in the minimal container) and mirrored in
+#: docs/implementation.md "Contract compiler"
+CONTRACT_SCHEMA = {
+    "contract_version": int,
+    "generated_by": {"tool": str, "version": str},
+    "request_fields": [str],
+    "ops": {
+        "*": {
+            "module": str,
+            "line": int,
+            "request_fields": [str],
+            "response_fields": [str],
+            "error_codes": [str],
+        }
+    },
+    "errors": {
+        "*": {
+            "class": (str, type(None)),
+            "module": str,
+            "line": int,
+            "retry_after_ms": bool,
+            "program": bool,
+            "raised_in": [str],
+        }
+    },
+    "endpoints": {
+        "*": {"*": {"line": int, "query_params": [str], "statuses": [int]}}
+    },
+    "metrics": {
+        "*": {"kinds": [str], "labels": [str], "modules": [str], "seeded": bool}
+    },
+    "dynamic_metric_sites": [{"module": str, "line": int}],
+    "knobs": {
+        "*": {
+            "module": str,
+            "line": int,
+            "env": (str, type(None)),
+            "validated": bool,
+        }
+    },
+}
+
+
+def validate_contract(doc: dict) -> list[str]:
+    """Structural schema check; returns problems (empty = valid)."""
+    problems: list[str] = []
+
+    def check(value, schema, where: str) -> None:
+        if isinstance(schema, dict):
+            if not isinstance(value, dict):
+                problems.append(f"{where}: expected object")
+                return
+            if "*" in schema:
+                for k, v in value.items():
+                    if not isinstance(k, str):
+                        problems.append(f"{where}: non-string key {k!r}")
+                    check(v, schema["*"], f"{where}.{k}")
+            else:
+                for k, sub in schema.items():
+                    if k not in value:
+                        problems.append(f"{where}: missing key {k!r}")
+                    else:
+                        check(value[k], sub, f"{where}.{k}")
+        elif isinstance(schema, list):
+            if not isinstance(value, list):
+                problems.append(f"{where}: expected array")
+                return
+            for i, item in enumerate(value):
+                check(item, schema[0], f"{where}[{i}]")
+        else:
+            types = schema if isinstance(schema, tuple) else (schema,)
+            if bool in types and isinstance(value, bool):
+                return
+            if isinstance(value, bool) and bool not in types:
+                problems.append(f"{where}: expected {types}, got bool")
+                return
+            if not isinstance(value, types):
+                problems.append(
+                    f"{where}: expected {types}, got {type(value).__name__}"
+                )
+
+    check(doc, CONTRACT_SCHEMA, "$")
+    if not problems and doc.get("contract_version") != CONTRACT_VERSION:
+        problems.append(
+            f"$.contract_version: expected {CONTRACT_VERSION}, "
+            f"got {doc.get('contract_version')}"
+        )
+    return problems
+
+
+def render_contract(doc: dict) -> str:
+    """Canonical byte form: key-sorted, 2-space indented, newline-terminated
+    — two builds over an identical tree must compare byte-equal."""
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+def contract_for_paths(paths: Sequence[str | Path]) -> dict:
+    """Build the contract over explicit paths (the ``--contract`` CLI)."""
+    from .core import iter_python_files
+    from .index import ProjectIndex
+
+    groups: dict[Path, list[Path]] = {}
+    for f, root in iter_python_files(paths):
+        groups.setdefault(root, []).append(f)
+    if not groups:
+        raise ValueError("no Python files under the given paths")
+    # one index over the union; the root is the first (sorted) lint root
+    root = sorted(groups)[0]
+    files = [f for fs in groups.values() for f in fs]
+    index = ProjectIndex.build(files, root)
+    return build_contract(index)
+
+
+def cached_contract(pctx) -> dict:
+    """The contract for a lint run's project index, built once per index
+    (FLX017–FLX020 all reduce over the same artifact)."""
+    cached = getattr(pctx.index, "_floxlint_contract", None)
+    if cached is None:
+        cached = build_contract(pctx.index)
+        try:
+            pctx.index._floxlint_contract = cached
+        except AttributeError:
+            pass
+    return cached
+
+
+def cached_serve_graphs(pctx) -> dict[str, "ServeGraph"]:
+    """The per-domain serve-escape graphs for a lint run, built once per
+    index (FLX020 and the contract build share them)."""
+    cached = getattr(pctx.index, "_floxlint_serve_graphs", None)
+    if cached is None:
+        cached = build_serve_graphs(pctx.index)
+        try:
+            pctx.index._floxlint_serve_graphs = cached
+        except AttributeError:
+            pass
+    return cached
+
+
+# ---------------------------------------------------------------------------
+# docs tables (shared by FLX017/FLX018/FLX019)
+# ---------------------------------------------------------------------------
+
+
+def find_docs_file(mod_path: Path, filename: str = "serving.md") -> Path | None:
+    """Nearest ``docs/<filename>`` climbing from the module's directory —
+    fixture packages carry their own ``docs/`` beside the code; the real
+    tree resolves to the repo-level ``docs/``."""
+    d = Path(mod_path).resolve().parent
+    for _ in range(8):
+        candidate = d / "docs" / filename
+        if candidate.is_file():
+            return candidate
+        if d.parent == d:
+            break
+        d = d.parent
+    return None
+
+
+def parse_contract_tables(text: str) -> dict[str, list[dict]]:
+    """``<!-- contract:<section> -->`` … ``<!-- /contract:<section> -->``
+    delimited markdown tables -> section -> row dicts (header-keyed, raw
+    cells; pull tokens out of a cell with :func:`cell_tokens`)."""
+    import re
+
+    out: dict[str, list[dict]] = {}
+    for m in re.finditer(
+        r"<!--\s*contract:([a-z_]+)\s*-->(.*?)<!--\s*/contract:\1\s*-->",
+        text,
+        re.DOTALL,
+    ):
+        section, body = m.group(1), m.group(2)
+        rows: list[dict] = []
+        header: list[str] | None = None
+        for line in body.splitlines():
+            line = line.strip()
+            if not line.startswith("|"):
+                continue
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            if header is None:
+                header = [c.strip("`").lower() for c in cells]
+                continue
+            if all(set(c) <= set("-: ") for c in cells):
+                continue  # the |---|---| separator
+            rows.append(dict(zip(header, cells)))
+        out[section] = rows
+    return out
+
+
+def cell_tokens(cell: str) -> list[str]:
+    """The code tokens of one table cell: backticked spans when present
+    (``` `append` / `query` ``` -> both), else comma/slash-separated
+    words. ``—`` / ``-`` / empty cells yield nothing."""
+    import re
+
+    ticked = re.findall(r"`([^`]+)`", cell)
+    if ticked:
+        return [t.strip() for t in ticked if t.strip()]
+    out = []
+    for part in re.split(r"[,/]", cell):
+        part = part.strip()
+        if part and part not in {"—", "-", "–"}:
+            out.append(part)
+    return out

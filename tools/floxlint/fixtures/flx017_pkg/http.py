@@ -1,0 +1,10 @@
+"""Fixture: the endpoint surface for the FLX017 contract-endpoints diff."""
+
+
+def do_GET(self):
+    path = self.path
+    if path == "/healthz":
+        return self._send(200)
+    if path == "/metrics":  # expect: FLX017
+        return self._send(200)
+    return self._send(404)

@@ -1,0 +1,24 @@
+"""Fixture: FLX017 contract-docs drift — doc-side findings anchor here."""  # expect: FLX017
+
+_REQUEST_FIELDS = {"func", "array", "by"}
+
+
+class ServeError(Exception):
+    code = "serve_error"
+
+
+class ShedGate(ServeError):
+    code = "f17_shed"
+
+
+class DrainGate(ServeError):  # expect: FLX017
+    code = "f17_drain"
+
+
+async def _amain(msg: dict) -> dict | None:
+    op = msg.get("op")
+    if op == "stats":
+        return {"op": "stats", "ok": True}
+    if op == "profile":  # expect: FLX017
+        return {"op": "profile", "ok": True, "dir": msg.get("dir")}
+    return None

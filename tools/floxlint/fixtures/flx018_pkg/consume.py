@@ -1,0 +1,16 @@
+"""Fixture: FLX018 consumer side — registry reads and scrape literals."""
+
+from .emit import METRICS
+
+
+def snapshot() -> dict:
+    return {
+        "requests": METRICS.get("f18.requests"),
+        "missing": METRICS.get("f18.missing"),  # expect: FLX018
+    }
+
+
+def pick(row: dict) -> bool:
+    if row.get("name") == "flox_tpu_f18_requests_total":
+        return True
+    return row.get("name") == "flox_tpu_f18_request_total"  # expect: FLX018

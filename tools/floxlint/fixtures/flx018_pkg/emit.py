@@ -1,0 +1,13 @@
+"""Fixture: FLX018 producer side — the docs-table drift anchors here."""  # expect: FLX018
+
+METRICS = None
+
+_SEED_GAUGES = (
+    "f18.depth",
+    "f18.ghost_gauge",  # expect: FLX018
+)
+
+
+def serve_one() -> None:
+    METRICS.inc("f18.requests")
+    METRICS.set_gauge("f18.depth", 0)

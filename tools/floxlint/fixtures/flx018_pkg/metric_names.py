@@ -1,0 +1,4 @@
+"""Fixture: shared-constants module — every name must resolve to an emit."""
+
+F18_REQUESTS = "f18.requests"
+F18_BOGUS = "f18.bogus"  # expect: FLX018

@@ -1,0 +1,37 @@
+"""Fixture: FLX019 response-shape drift; implicit reduce op undocumented."""  # expect: FLX017
+
+_REQUEST_FIELDS = {"func", "array"}
+
+
+async def _amain(msg: dict) -> dict | None:
+    op = msg.get("op")
+    if op == "lookup":  # expect: FLX019
+        return {"op": "lookup", "ok": True, "value": 1}
+    return _handle_line(msg)
+
+
+def _handle_line(msg: dict) -> dict:
+    payload = {k: msg[k] for k in _REQUEST_FIELDS if k in msg}
+    return {"id": msg.get("id"), "ok": True, "result": payload}
+
+
+def _fail_untyped(rid: str) -> dict:
+    return {"id": rid, "ok": False, "error": "boom"}  # expect: FLX019
+
+
+def _fail_typed(rid: str) -> dict:
+    return {"id": rid, "ok": False, "error": "boom", "code": "f19_bad"}
+
+
+def _fail_subscript(rid: str) -> dict:
+    out = {"id": rid, "ok": False, "error": "boom"}
+    out["code"] = "f19_bad"
+    return out
+
+
+def _error_response(exc: Exception) -> dict:
+    return {"ok": False, "error": type(exc).__name__, "code": "f19_env"}
+
+
+def _fail_spread(rid: str, exc: Exception) -> dict:
+    return {"id": rid, **_error_response(exc)}

@@ -1,0 +1,49 @@
+"""Fixture: FLX020 untyped-escape analysis over the serve call graph."""
+
+
+class ServeError(Exception):
+    code = "f20_base"
+
+
+class BoomError(ServeError):
+    code = "f20_boom"
+
+
+class Dispatcher:
+    def _execute(self, msg: dict) -> dict:
+        self._validate(msg)
+        narrow = self._guarded(msg)
+        broad = self._screened(msg)
+        self._typed(msg)
+        return {"ok": narrow, "broad": broad}
+
+    def _validate(self, msg: dict) -> None:
+        if "op" not in msg:
+            raise ValueError("missing op")  # expect: FLX020
+
+    def _guarded(self, msg: dict) -> bool:
+        try:
+            self._parse(msg)
+        except KeyError:
+            return False
+        return True
+
+    def _parse(self, msg: dict) -> None:
+        raise KeyError("contained: the only caller catches KeyError")
+
+    def _screened(self, msg: dict) -> bool:
+        try:
+            return self._risky(msg)
+        except Exception as exc:
+            classify_error(exc)
+            return False
+
+    def _risky(self, msg: dict) -> bool:
+        raise RuntimeError("contained: the only caller screens broadly")
+
+    def _typed(self, msg: dict) -> None:
+        raise BoomError("typed raises become wire answers, never escapes")
+
+
+def classify_error(exc: Exception) -> str:
+    return type(exc).__name__

@@ -18,6 +18,10 @@ from .rules.flx013_unlocked_shared_write import UnlockedSharedWriteRule
 from .rules.flx014_lock_order import LockOrderInversionRule
 from .rules.flx015_async_blocking import AsyncBlockingRule
 from .rules.flx016_signal_unsafe import SignalUnsafeRule
+from .rules.flx017_contract_docs import ContractDocsDriftRule
+from .rules.flx018_metric_drift import MetricDriftRule
+from .rules.flx019_response_shape import ResponseShapeDriftRule
+from .rules.flx020_untyped_escape import UntypedEscapeRule
 
 #: id -> rule instance, in id order
 RULES = {
@@ -39,6 +43,10 @@ RULES = {
         LockOrderInversionRule(),
         AsyncBlockingRule(),
         SignalUnsafeRule(),
+        ContractDocsDriftRule(),
+        MetricDriftRule(),
+        ResponseShapeDriftRule(),
+        UntypedEscapeRule(),
     )
 }
 

@@ -1,0 +1,195 @@
+"""FLX017 — undeclared or undocumented protocol surface.
+
+The serve plane's external surface — protocol ops, machine-readable error
+codes, HTTP endpoints — is contract-checked against the marker-delimited
+tables in ``docs/serving.md`` (``<!-- contract:ops -->``,
+``<!-- contract:errors -->``, ``<!-- contract:endpoints -->``). The
+contract compiler (``tools/floxlint/contract.py``) extracts the code-side
+surface from the AST; this rule diffs it against the doc tables in **both
+directions**:
+
+* an op / error code / endpoint implemented in code but absent from its
+  table is *undocumented* — a client cannot discover it, and the fleet
+  router (ROADMAP item 1) cannot generate a stub for it;
+* a table row with no implementation is *undeclared* — clients coded
+  against the doc will get ``unknown op`` answers at runtime.
+
+Anchoring: the rule runs once per package that contains a *protocol
+module* (a module defining a top-level ``_REQUEST_FIELDS`` string set)
+and resolves the nearest ``docs/serving.md`` climbing from that module —
+so fixture corpora carry their own ``docs/`` and the real tree resolves
+to the repo-level one. Packages without a protocol module (tools, tests)
+skip entirely. Code-side findings anchor at the drifting surface's
+definition line; doc-side findings anchor at line 1 of the protocol
+module (the owner of the surface the doc over-promises).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterator
+
+from ..core import Finding
+from ..contract import (
+    cached_contract,
+    cell_tokens,
+    find_docs_file,
+    parse_contract_tables,
+    protocol_modules,
+)
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..core import ProjectContext
+
+
+class ContractDocsDriftRule:
+    id = "FLX017"
+    name = "contract-docs-drift"
+    description = (
+        "a serve op, error code, or HTTP endpoint drifted between the code "
+        "surface and the docs/serving.md contract tables"
+    )
+    scope = "project"
+    example = (
+        'docs/serving.md contract:ops table documents op `ghost` but no\n'
+        "dispatch branch implements it; op `profile` is dispatched in\n"
+        "serve/__main__.py but has no table row"
+    )
+    fix_hint = (
+        "regenerate the table row from the artifact\n"
+        "(python -m tools.floxlint --contract -) or remove the dead row;\n"
+        "never hand-edit a surface into docs without implementing it"
+    )
+
+    def check_project(self, pctx: "ProjectContext") -> Iterator[Finding]:
+        contract = cached_contract(pctx)
+        anchors: dict[str, object] = {}
+        for mod in protocol_modules(pctx.index):
+            anchors.setdefault(mod.package, mod)
+        for pkg in sorted(anchors):
+            anchor = anchors[pkg]
+            docs = find_docs_file(anchor.path)
+            if docs is None:
+                continue
+            try:
+                tables = parse_contract_tables(docs.read_text())
+            except OSError:
+                continue
+            yield from self._check_ops(pctx, pkg, anchor, docs, tables, contract)
+            yield from self._check_errors(pctx, pkg, anchor, docs, tables, contract)
+            yield from self._check_endpoints(pctx, pkg, anchor, docs, tables, contract)
+
+    # -- sections -----------------------------------------------------------
+
+    def _check_ops(self, pctx, pkg, anchor, docs, tables, contract):
+        code_ops = {
+            op: entry
+            for op, entry in contract["ops"].items()
+            if entry["module"].partition(".")[0] == pkg
+        }
+        if "ops" not in tables:
+            yield self._doc_finding(
+                anchor,
+                f"{docs.name} has no <!-- contract:ops --> table — the "
+                f"{len(code_ops)} serve op(s) of package {pkg!r} are "
+                "undocumented",
+            )
+            return
+        doc_ops = _first_column(tables["ops"])
+        for op in sorted(set(code_ops) - doc_ops):
+            entry = code_ops[op]
+            yield self._code_finding(
+                pctx, entry["module"], entry["line"],
+                f"serve op {op!r} is dispatched here but has no row in the "
+                f"{docs.name} contract:ops table — undocumented surface",
+            )
+        for op in sorted(doc_ops - set(code_ops)):
+            yield self._doc_finding(
+                anchor,
+                f"{docs.name} contract:ops table documents op {op!r} but no "
+                "dispatch branch implements it — undeclared surface",
+            )
+
+    def _check_errors(self, pctx, pkg, anchor, docs, tables, contract):
+        code_errors = {
+            code: entry
+            for code, entry in contract["errors"].items()
+            if entry["module"].partition(".")[0] == pkg
+        }
+        if "errors" not in tables:
+            if code_errors:
+                yield self._doc_finding(
+                    anchor,
+                    f"{docs.name} has no <!-- contract:errors --> table — "
+                    f"the {len(code_errors)} error code(s) of package "
+                    f"{pkg!r} are undocumented",
+                )
+            return
+        doc_codes = _first_column(tables["errors"])
+        for code in sorted(set(code_errors) - doc_codes):
+            entry = code_errors[code]
+            yield self._code_finding(
+                pctx, entry["module"], entry["line"],
+                f"error code {code!r} "
+                f"({entry['class'] or 'synthesized'}) is answered on the "
+                f"wire but has no row in the {docs.name} contract:errors "
+                "table — clients cannot classify it",
+            )
+        for code in sorted(doc_codes - set(code_errors)):
+            yield self._doc_finding(
+                anchor,
+                f"{docs.name} contract:errors table documents code {code!r} "
+                "but nothing in the package raises or answers it",
+            )
+
+    def _check_endpoints(self, pctx, pkg, anchor, docs, tables, contract):
+        code_paths: dict[str, tuple[str, int]] = {}
+        for module, paths in contract["endpoints"].items():
+            if module.partition(".")[0] != pkg:
+                continue
+            for path, entry in paths.items():
+                code_paths.setdefault(path, (module, entry["line"]))
+        if "endpoints" not in tables:
+            if code_paths:
+                yield self._doc_finding(
+                    anchor,
+                    f"{docs.name} has no <!-- contract:endpoints --> table — "
+                    f"the {len(code_paths)} HTTP endpoint(s) of package "
+                    f"{pkg!r} are undocumented",
+                )
+            return
+        doc_paths = _first_column(tables["endpoints"])
+        for path in sorted(set(code_paths) - doc_paths):
+            module, line = code_paths[path]
+            yield self._code_finding(
+                pctx, module, line,
+                f"HTTP endpoint {path!r} is served here but has no row in "
+                f"the {docs.name} contract:endpoints table",
+            )
+        for path in sorted(doc_paths - set(code_paths)):
+            yield self._doc_finding(
+                anchor,
+                f"{docs.name} contract:endpoints table documents {path!r} "
+                "but no handler serves it",
+            )
+
+    # -- finding constructors ----------------------------------------------
+
+    def _code_finding(self, pctx, module: str, line: int, message: str) -> Finding:
+        mod = pctx.index.modules.get(module)
+        path = str(mod.path) if mod is not None else module
+        return Finding(path=path, line=line, col=0, rule=self.id, message=message)
+
+    def _doc_finding(self, anchor, message: str) -> Finding:
+        return Finding(
+            path=str(anchor.path), line=1, col=0, rule=self.id, message=message
+        )
+
+
+def _first_column(rows: list[dict]) -> set[str]:
+    out: set[str] = set()
+    for row in rows:
+        if not row:
+            continue
+        first = next(iter(row.values()))
+        out.update(cell_tokens(first))
+    return out

@@ -1,0 +1,260 @@
+"""FLX018 — metric-name drift.
+
+A metric name is only real if a producer emits it. Three drift shapes,
+all checked against the contract compiler's emit-site table (every
+constant name reaching ``METRICS.inc/observe/set_gauge`` or
+``telemetry.count``):
+
+* **documented-not-emitted** — a name in the ``docs/serving.md``
+  ``<!-- contract:metrics -->`` table that no producer emits: dashboards
+  built from the doc chart a flat line forever;
+* **seeded-not-emitted** — a gauge listed in a module-level ``*_GAUGES``
+  seed tuple (exported as 0 from metrics-server start so scrapes never
+  404) with no runtime emit site anywhere: the seed *hides* the missing
+  producer behind a permanently-zero series;
+* **consumer-unresolved** — a consumer referencing a name nothing emits:
+  ``METRICS.get("...")`` / ``METRICS.percentile("...")`` call sites, the
+  constants of a shared ``metric_names`` module, and raw
+  ``flox_tpu_*`` Prometheus literals (folded back through the exposition
+  rename: ``flox_tpu_`` prefix, ``.`` -> ``_``, counters append
+  ``_total``). This replaces the old CI grep assertions with resolved
+  symbols — a scrape-name typo in the fleet federator becomes a lint
+  error, not a silently-empty column.
+
+Anchoring: the rule runs per package that has at least one constant-name
+emit site, so tools/ and test trees skip. Label conventions fold:
+``name|key=value`` emits register the base name, and consumers of the
+base match it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import TYPE_CHECKING, Iterator
+
+from ..core import Finding
+from .common import dotted_name
+from ..contract import (
+    _emit_site,
+    _metric_name_of,
+    _seeded_gauge_names,
+    cached_contract,
+    cell_tokens,
+    find_docs_file,
+    parse_contract_tables,
+)
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..core import ProjectContext
+
+_PROM_PREFIX = "flox_tpu_"
+
+
+class MetricDriftRule:
+    id = "FLX018"
+    name = "metric-name-drift"
+    description = (
+        "a metric name is documented, seeded, or consumed that no producer "
+        "emits (or a consumer literal fails to resolve against the contract)"
+    )
+    scope = "project"
+    example = (
+        'fleet.py reads `flox_tpu_serve_request_total` (typo: the counter\n'
+        "renders as flox_tpu_serve_requests_total) — the fleet-top column\n"
+        "stays empty on every replica"
+    )
+    fix_hint = (
+        "consume names through the shared flox_tpu.metric_names constants\n"
+        "(prom_name() for the Prometheus rendering) so the contract checks\n"
+        "them; for seeded gauges, add the runtime set_gauge() producer or\n"
+        "drop the name from the seed tuple"
+    )
+
+    def check_project(self, pctx: "ProjectContext") -> Iterator[Finding]:
+        contract = cached_contract(pctx)
+        emitted_by_pkg: dict[str, set[str]] = {}
+        for name, entry in contract["metrics"].items():
+            for module in entry["modules"]:
+                emitted_by_pkg.setdefault(module.partition(".")[0], set()).add(name)
+        for pkg in sorted(emitted_by_pkg):
+            emitted = emitted_by_pkg[pkg]
+            mods = sorted(
+                (m for m in pctx.index.modules.values() if m.package == pkg),
+                key=lambda m: m.name,
+            )
+            yield from self._check_docs(pkg, mods, emitted, contract)
+            yield from self._check_seeded(mods, contract)
+            yield from self._check_consumers(mods, emitted)
+
+    # -- documented-not-emitted --------------------------------------------
+
+    def _check_docs(self, pkg, mods, emitted, contract):
+        anchor = next(
+            (
+                m
+                for m in mods
+                if any(
+                    m.name in contract["metrics"][n]["modules"] for n in emitted
+                )
+            ),
+            None,
+        )
+        if anchor is None:
+            return
+        docs = find_docs_file(anchor.path)
+        if docs is None:
+            return
+        try:
+            tables = parse_contract_tables(docs.read_text())
+        except OSError:
+            return
+        for row in tables.get("metrics", ()):
+            if not row:
+                continue
+            for token in cell_tokens(next(iter(row.values()))):
+                base = token.partition("|")[0]
+                if base not in emitted:
+                    yield Finding(
+                        path=str(anchor.path), line=1, col=0, rule=self.id,
+                        message=(
+                            f"{docs.name} contract:metrics table documents "
+                            f"{token!r} but no producer in package {pkg!r} "
+                            "emits it — the documented series is dead"
+                        ),
+                    )
+
+    # -- seeded-not-emitted -------------------------------------------------
+
+    def _check_seeded(self, mods, contract):
+        for mod in mods:
+            for name, line in sorted(_seeded_gauge_names(mod).items()):
+                entry = contract["metrics"].get(name)
+                if entry is None or not entry["modules"]:
+                    yield Finding(
+                        path=str(mod.path), line=line, col=0, rule=self.id,
+                        message=(
+                            f"gauge {name!r} is seeded at metrics-server "
+                            "start but has no runtime emit site — the seed "
+                            "exports a permanently-zero series that hides "
+                            "the missing producer"
+                        ),
+                    )
+
+    # -- consumer-unresolved ------------------------------------------------
+
+    def _check_consumers(self, mods, emitted):
+        folded = {_fold(n): n for n in emitted}
+        for mod in mods:
+            for node in ast.walk(mod.tree):
+                yield from self._check_reader_call(mod, node, emitted)
+            for literal in _prom_read_literals(mod.tree):
+                yield from self._check_prom_literal(mod, literal, folded)
+            if mod.name.split(".")[-1] == "metric_names":
+                yield from self._check_names_module(mod, emitted)
+
+    def _check_reader_call(self, mod, node, emitted):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("get", "percentile")
+            and node.args
+        ):
+            return
+        recv = dotted_name(node.func.value)
+        if recv is None or not (recv == "METRICS" or recv.endswith(".METRICS")):
+            return
+        named = _metric_name_of(node.args[0])
+        if named is None:
+            return
+        base, _labels, _dynamic = named
+        if base not in emitted:
+            yield Finding(
+                path=str(mod.path), line=node.lineno, col=node.col_offset,
+                rule=self.id,
+                message=(
+                    f"METRICS.{node.func.attr}({base!r}) reads a metric no "
+                    "producer emits — the consumer will only ever see the "
+                    "zero default"
+                ),
+            )
+
+    def _check_prom_literal(self, mod, node, folded):
+        value = node.value
+        candidate = value[len(_PROM_PREFIX):].partition("{")[0]
+        options = {candidate}
+        if candidate.endswith("_total"):
+            options.add(candidate[: -len("_total")])
+        if not any(opt in folded for opt in options):
+            yield Finding(
+                path=str(mod.path), line=node.lineno, col=node.col_offset,
+                rule=self.id,
+                message=(
+                    f"Prometheus literal {value!r} folds back to no emitted "
+                    "metric — the scrape consumer reads a series no replica "
+                    "produces (use flox_tpu.metric_names.prom_name())"
+                ),
+            )
+
+    def _check_names_module(self, mod, emitted):
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (
+                isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                continue
+            name = node.value.value
+            base = name.partition("|")[0]
+            if base and not base.startswith(_PROM_PREFIX) and base not in emitted:
+                yield Finding(
+                    path=str(mod.path), line=node.lineno, col=node.col_offset,
+                    rule=self.id,
+                    message=(
+                        f"metric_names constant {name!r} names a metric no "
+                        "producer emits — fix the producer or drop the "
+                        "constant"
+                    ),
+                )
+
+
+def _fold(registry_name: str) -> str:
+    """The exposition rename minus prefix/suffix: ``serve.request_ms`` ->
+    ``serve_request_ms``."""
+    return registry_name.replace(".", "_")
+
+
+def _prom_read_literals(tree: ast.Module) -> list[ast.Constant]:
+    """``flox_tpu_*`` string constants in *read* positions — ``.get(...)``
+    arguments, subscript keys, comparison operands (directly or inside a
+    tuple key). Literals merely embedded in rendered output (f-strings,
+    ``# TYPE`` lines) or naming contextvars are emit/annotation sites, not
+    scrape consumers, and are not checked."""
+
+    def prom_constants(node: ast.AST) -> list[ast.Constant]:
+        roots = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+        return [
+            n
+            for n in roots
+            if isinstance(n, ast.Constant)
+            and isinstance(n.value, str)
+            and n.value.startswith(_PROM_PREFIX)
+            and len(n.value) > len(_PROM_PREFIX)
+            and not n.value.endswith("_")
+        ]
+
+    out: list[ast.Constant] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+        ):
+            for arg in node.args[:1]:
+                out.extend(prom_constants(arg))
+        elif isinstance(node, ast.Subscript):
+            out.extend(prom_constants(node.slice))
+        elif isinstance(node, ast.Compare):
+            for operand in [node.left, *node.comparators]:
+                out.extend(prom_constants(operand))
+    return out

@@ -1,0 +1,194 @@
+"""FLX019 — response-shape drift.
+
+The serve protocol's error envelope is load-bearing: a router retries on
+``code == "load_shed"`` with ``retry_after_ms`` backoff, sheds on
+``circuit_open``, and re-resolves on ``unknown_dataset`` — so an error
+answer that lacks a machine-readable ``code`` silently downgrades every
+client to string-matching ``message``. And the documented per-op response
+rows are the client's deserialization guide: a field the doc promises
+that the handler never produces is a KeyError waiting in every consumer.
+
+Two checks, both scoped to *protocol modules* (modules defining a
+top-level ``_REQUEST_FIELDS`` set — nothing outside the wire layer is a
+response envelope, so helper dicts elsewhere never match):
+
+* an error-response dict literal (``"ok": False``) that carries no
+  ``"code"`` key — exempt when the enclosing function spreads
+  ``**_error_response(...)`` into it or assigns ``var["code"] = ...``
+  (the shared-envelope construction pattern);
+* a response field documented in the ``docs/serving.md`` contract:ops
+  table that the op's handler never produces. (One direction only:
+  handlers legitimately spread dynamic payloads — ``**info`` — so
+  produced-but-undocumented fields are not knowable statically.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import TYPE_CHECKING, Iterator
+
+from ..core import Finding
+from .common import dotted_name
+from ..contract import (
+    cached_contract,
+    cell_tokens,
+    find_docs_file,
+    parse_contract_tables,
+    protocol_modules,
+)
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..core import ProjectContext
+
+
+class ResponseShapeDriftRule:
+    id = "FLX019"
+    name = "response-shape-drift"
+    description = (
+        "an error response lacks the machine-readable 'code' field, or a "
+        "documented response field is never produced by the op's handler"
+    )
+    scope = "project"
+    example = (
+        'answer({"id": rid, "ok": False, "message": "profiler busy"}) — no\n'
+        '"code": the router cannot classify the failure and falls back to\n'
+        "string-matching the message"
+    )
+    fix_hint = (
+        "build error answers through _error_response(rid, exc) (spreads the\n"
+        'typed envelope) or add an explicit "code" literal; for doc drift,\n'
+        "regenerate the contract:ops row from the artifact"
+    )
+
+    def check_project(self, pctx: "ProjectContext") -> Iterator[Finding]:
+        contract = cached_contract(pctx)
+        seen_docs: set[str] = set()
+        for mod in protocol_modules(pctx.index):
+            yield from self._check_error_envelopes(mod)
+            docs = find_docs_file(mod.path)
+            if docs is None or str(docs) in seen_docs:
+                continue
+            seen_docs.add(str(docs))
+            yield from self._check_documented_fields(
+                pctx, mod.package, docs, contract
+            )
+
+    # -- "ok": False without "code" ----------------------------------------
+
+    def _check_error_envelopes(self, mod) -> Iterator[Finding]:
+        for fn_node, dicts in _dicts_by_function(mod.tree):
+            exempt = fn_node is not None and _assigns_code_subscript(fn_node)
+            for node in dicts:
+                keys = {
+                    k.value
+                    for k in node.keys
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                }
+                if "code" in keys:
+                    continue
+                if not _is_error_envelope(node):
+                    continue
+                if exempt or _spreads_error_response(node):
+                    continue
+                yield Finding(
+                    path=str(mod.path), line=node.lineno, col=node.col_offset,
+                    rule=self.id,
+                    message=(
+                        'error response ("ok": False) carries no '
+                        'machine-readable "code" — clients fall back to '
+                        "string-matching; route it through _error_response() "
+                        "or add an explicit code literal"
+                    ),
+                )
+
+    # -- documented fields the handler never produces ----------------------
+
+    def _check_documented_fields(self, pctx, pkg, docs, contract):
+        try:
+            tables = parse_contract_tables(docs.read_text())
+        except OSError:
+            return
+        for row in tables.get("ops", ()):
+            cells = list(row.items())
+            if not cells:
+                continue
+            op_tokens = cell_tokens(cells[0][1])
+            fields_cell = row.get("response fields", "")
+            for op in op_tokens:
+                entry = contract["ops"].get(op)
+                if entry is None or entry["module"].partition(".")[0] != pkg:
+                    continue  # undeclared ops are FLX017's finding
+                produced = set(entry["response_fields"])
+                for token in cell_tokens(fields_cell):
+                    if token not in produced:
+                        mod = pctx.index.modules.get(entry["module"])
+                        yield Finding(
+                            path=str(mod.path) if mod else entry["module"],
+                            line=entry["line"], col=0, rule=self.id,
+                            message=(
+                                f"{docs.name} documents response field "
+                                f"{token!r} for op {op!r} but the handler "
+                                "never produces it — clients indexing the "
+                                "field will KeyError"
+                            ),
+                        )
+
+
+def _dicts_by_function(tree: ast.Module):
+    """(enclosing function or None, dict literals) pairs covering the whole
+    module, each dict attributed to its innermost function."""
+    owner: dict[int, ast.AST | None] = {}
+
+    def mark(node, fn):
+        for child in ast.iter_child_nodes(node):
+            inner = (
+                child
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else fn
+            )
+            if isinstance(child, ast.Dict):
+                owner[id(child)] = fn
+            mark(child, inner)
+
+    mark(tree, None)
+    groups: dict[int, tuple[ast.AST | None, list[ast.Dict]]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            fn = owner.get(id(node))
+            key = id(fn) if fn is not None else 0
+            groups.setdefault(key, (fn, []))[1].append(node)
+    return list(groups.values())
+
+
+def _is_error_envelope(node: ast.Dict) -> bool:
+    for k, v in zip(node.keys, node.values):
+        if (
+            isinstance(k, ast.Constant)
+            and k.value == "ok"
+            and isinstance(v, ast.Constant)
+            and v.value is False
+        ):
+            return True
+    return False
+
+
+def _spreads_error_response(node: ast.Dict) -> bool:
+    for k, v in zip(node.keys, node.values):
+        if k is None and isinstance(v, ast.Call):
+            called = dotted_name(v.func)
+            if called and called.split(".")[-1] == "_error_response":
+                return True
+    return False
+
+
+def _assigns_code_subscript(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Subscript)
+            and isinstance(node.targets[0].slice, ast.Constant)
+            and node.targets[0].slice.value == "code"
+        ):
+            return True
+    return False

@@ -1,0 +1,79 @@
+"""FLX020 — untyped exception escape from the serve plane.
+
+The serve loop's promise (docs/serving.md): one bad client line must
+never take the replica down, and every failure a client sees carries a
+machine-readable ``code``. FLX012 checks the *except* side of that
+promise file-locally; this rule checks the *raise* side
+interprocedurally: a ``raise`` of anything that is not a ``ServeError``
+subclass, sitting on a call path from a serve entry point
+(``_amain`` / ``Dispatcher._execute``) with no catch frame in between,
+escapes as an untyped exception — at best it becomes a generic
+``"execution"`` envelope with no retry semantics, at worst it unwinds
+the loop.
+
+The analysis runs on the per-domain serve graph built by the contract
+compiler: call edges inside the serve package (``self.method`` receivers
+resolved, ``asyncio.to_thread``/``create_task`` wrappers unwrapped),
+each annotated with the exception names its call site's ``try`` frames
+catch. A raise site is flagged only when its exception type can cross
+*every* frame back to an entry — so a json-protocol helper whose
+``TypeError`` is caught narrowly at its only call site is clean, and so
+is anything under a broad ``except Exception`` guard. Unresolvable
+exception classes are skipped, never guessed.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterator
+
+from ..core import Finding
+from ..contract import cached_serve_graphs
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..core import ProjectContext
+
+
+class UntypedEscapeRule:
+    id = "FLX020"
+    name = "untyped-serve-escape"
+    description = (
+        "an untyped (non-ServeError) raise can propagate uncaught to the "
+        "serve loop / dispatcher entry"
+    )
+    scope = "project"
+    example = (
+        "def _load_slab(path):          # called from Dispatcher._execute\n"
+        '    raise ValueError("bad slab header")   # no catch frame between\n'
+        "                                          # here and the entry"
+    )
+    fix_hint = (
+        "raise a ServeError subclass with a code (the client can classify\n"
+        "it), or catch-and-classify at the boundary:\n"
+        "    except Exception as exc:\n"
+        "        telemetry.record_serve_error(exc, what=...)\n"
+        "        answer(**_error_response(rid, exc))"
+    )
+
+    def check_project(self, pctx: "ProjectContext") -> Iterator[Finding]:
+        for domain, graph in sorted(cached_serve_graphs(pctx).items()):
+            entries = ", ".join(_short(q) for q in graph.entries)
+            for site in graph.escapes():
+                fn = site.qualname[len(domain) + 1:] or site.qualname
+                yield Finding(
+                    path=site.path, line=site.line, col=0, rule=self.id,
+                    message=(
+                        f"untyped {site.exc_name} raised in {fn} can escape "
+                        f"uncaught to the serve entry ({entries}) — raise a "
+                        "ServeError subclass or add a catch frame on the "
+                        "call path"
+                    ),
+                )
+
+
+def _short(qualname: str) -> str:
+    """``pkg.serve.dispatcher.Dispatcher._execute`` -> ``Dispatcher._execute``,
+    ``pkg.serve.__main__._amain`` -> ``_amain``."""
+    parts = qualname.split(".")
+    if len(parts) >= 2 and parts[-2][:1].isupper():
+        return ".".join(parts[-2:])
+    return parts[-1]

@@ -22,7 +22,7 @@ SARIF_SCHEMA = (
     "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
     "Schemata/sarif-schema-2.1.0.json"
 )
-_TOOL_VERSION = "3.0.0"  # floxlint v3: interprocedural concurrency/effect rules
+_TOOL_VERSION = "4.0.0"  # floxlint v4: static contract compiler + drift rules
 
 
 def _relative_uri(path: str) -> str:

@@ -19,6 +19,14 @@ python -m tools.floxlint flox_tpu/ tools/ tests_tpu/ \
     --index-cache .floxlint-index.pickle || rc=1
 
 echo
+echo "== contract artifact =="
+# the static contract compiler: schema-validated before writing, byte-
+# deterministic, diffable between commits. CI uploads it next to the
+# SARIF; the runtime conformance leg (tests/test_contract.py) replays it
+# against a live replica.
+python -m tools.floxlint --contract contract.json flox_tpu/ || rc=1
+
+echo
 echo "== ruff =="
 if python -c "import ruff" >/dev/null 2>&1; then
     python -m ruff check flox_tpu/ tools/floxlint/ tests/test_floxlint.py || rc=1
